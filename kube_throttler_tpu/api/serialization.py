@@ -1,0 +1,165 @@
+"""Manifest (de)serialization — YAML/JSON dicts ↔ typed API objects.
+
+Accepts the same manifest shapes as the reference CRDs (see
+/root/reference/example/*.yaml and deploy/crd.yaml): ``spec.throttlerName``,
+``spec.selector.selectorTerms[].podSelector/namespaceSelector`` (matchLabels +
+matchExpressions), ``spec.threshold.resourceCounts.pod`` /
+``.resourceRequests``, and ``spec.temporaryThresholdOverrides[].begin/end/
+threshold``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+from ..quantity import parse_quantity
+from .pod import Container, Pod, PodSpec, PodStatus
+from .types import (
+    ClusterThrottle,
+    ClusterThrottleSelector,
+    ClusterThrottleSelectorTerm,
+    ClusterThrottleSpec,
+    LabelSelector,
+    LabelSelectorRequirement,
+    ResourceAmount,
+    TemporaryThresholdOverride,
+    Throttle,
+    ThrottleSelector,
+    ThrottleSelectorTerm,
+    ThrottleSpec,
+)
+
+
+def resource_amount_from_dict(d: Optional[Mapping[str, Any]]) -> ResourceAmount:
+    if not d:
+        return ResourceAmount()
+    counts = d.get("resourceCounts")
+    requests = d.get("resourceRequests")
+    # presence of the resourceCounts *object* is what matters: Go unmarshals
+    # `resourceCounts: {}` to &ResourceCounts{Pod: 0} — an active zero
+    # pod-count threshold that blocks every pod, not an absent dimension
+    return ResourceAmount(
+        resource_counts=int(counts.get("pod", 0)) if counts is not None else None,
+        resource_requests=(
+            {str(k): parse_quantity(v) for k, v in requests.items()}
+            if requests is not None
+            else None
+        ),
+    )
+
+
+def label_selector_from_dict(d: Optional[Mapping[str, Any]]) -> LabelSelector:
+    if not d:
+        return LabelSelector()
+    exprs = tuple(
+        LabelSelectorRequirement(
+            key=str(e["key"]),
+            operator=str(e.get("operator", "")),
+            values=tuple(str(v) for v in e.get("values", []) or []),
+        )
+        for e in d.get("matchExpressions", []) or []
+    )
+    return LabelSelector(
+        match_labels={str(k): str(v) for k, v in (d.get("matchLabels") or {}).items()},
+        match_expressions=exprs,
+    )
+
+
+def _overrides_from_list(items: Optional[List[Mapping[str, Any]]]):
+    return tuple(
+        TemporaryThresholdOverride(
+            begin=str(o.get("begin", "") or ""),
+            end=str(o.get("end", "") or ""),
+            threshold=resource_amount_from_dict(o.get("threshold")),
+        )
+        for o in (items or [])
+    )
+
+
+def throttle_from_dict(d: Mapping[str, Any]) -> Throttle:
+    meta = d.get("metadata", {})
+    spec = d.get("spec", {})
+    selector = spec.get("selector", {}) or {}
+    terms = tuple(
+        ThrottleSelectorTerm(pod_selector=label_selector_from_dict(t.get("podSelector")))
+        for t in (selector.get("selectorTerms") or selector.get("selecterTerms") or [])
+    )
+    return Throttle(
+        name=str(meta.get("name", "")),
+        namespace=str(meta.get("namespace", "default") or "default"),
+        uid=str(meta.get("uid", "")),
+        spec=ThrottleSpec(
+            throttler_name=str(spec.get("throttlerName", "")),
+            threshold=resource_amount_from_dict(spec.get("threshold")),
+            temporary_threshold_overrides=_overrides_from_list(
+                spec.get("temporaryThresholdOverrides")
+            ),
+            selector=ThrottleSelector(selector_terms=terms),
+        ),
+    )
+
+
+def cluster_throttle_from_dict(d: Mapping[str, Any]) -> ClusterThrottle:
+    meta = d.get("metadata", {})
+    spec = d.get("spec", {})
+    selector = spec.get("selector", {}) or {}
+    terms = tuple(
+        ClusterThrottleSelectorTerm(
+            pod_selector=label_selector_from_dict(t.get("podSelector")),
+            namespace_selector=label_selector_from_dict(t.get("namespaceSelector")),
+        )
+        for t in (selector.get("selectorTerms") or selector.get("selecterTerms") or [])
+    )
+    return ClusterThrottle(
+        name=str(meta.get("name", "")),
+        uid=str(meta.get("uid", "")),
+        spec=ClusterThrottleSpec(
+            throttler_name=str(spec.get("throttlerName", "")),
+            threshold=resource_amount_from_dict(spec.get("threshold")),
+            temporary_threshold_overrides=_overrides_from_list(
+                spec.get("temporaryThresholdOverrides")
+            ),
+            selector=ClusterThrottleSelector(selector_terms=terms),
+        ),
+    )
+
+
+def pod_from_dict(d: Mapping[str, Any]) -> Pod:
+    meta = d.get("metadata", {})
+    spec = d.get("spec", {})
+    status = d.get("status", {})
+
+    def containers(key: str) -> List[Container]:
+        out = []
+        for c in spec.get(key, []) or []:
+            reqs = (c.get("resources", {}) or {}).get("requests", {}) or {}
+            out.append(Container.of(reqs, name=str(c.get("name", ""))))
+        return out
+
+    overhead = spec.get("overhead")
+    return Pod(
+        name=str(meta.get("name", "")),
+        namespace=str(meta.get("namespace", "default") or "default"),
+        labels={str(k): str(v) for k, v in (meta.get("labels") or {}).items()},
+        spec=PodSpec(
+            scheduler_name=str(spec.get("schedulerName", "")),
+            node_name=str(spec.get("nodeName", "") or ""),
+            containers=containers("containers"),
+            init_containers=containers("initContainers"),
+            overhead={k: parse_quantity(v) for k, v in overhead.items()}
+            if overhead
+            else None,
+        ),
+        status=PodStatus(phase=str(status.get("phase", "Pending") or "Pending")),
+    )
+
+
+def object_from_dict(d: Mapping[str, Any]):
+    kind = d.get("kind", "")
+    if kind == "Throttle":
+        return throttle_from_dict(d)
+    if kind == "ClusterThrottle":
+        return cluster_throttle_from_dict(d)
+    if kind == "Pod":
+        return pod_from_dict(d)
+    raise ValueError(f"unsupported kind: {kind!r}")

@@ -1,0 +1,15 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run against
+XLA's host-platform device partitioning instead (same SPMD partitioner the
+TPU path uses).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
