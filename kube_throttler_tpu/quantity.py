@@ -65,6 +65,8 @@ def parse_quantity(s: Union[str, int, float]) -> Fraction:
     Accepts ints/floats too (YAML often yields bare numbers for thresholds);
     floats go through ``str()`` so ``0.1`` means decimal 0.1.
     """
+    if isinstance(s, Fraction):
+        return s
     if isinstance(s, int):
         return Fraction(s)
     if isinstance(s, float):

@@ -7,7 +7,9 @@ TPU path uses).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, not setdefault: the ambient environment may point JAX_PLATFORMS at
+# real TPU hardware, and unit tests must be deterministic CPU runs
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

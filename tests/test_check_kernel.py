@@ -1,0 +1,162 @@
+"""Property tests: the batched XLA check kernel must agree cell-for-cell
+with the pure-Python oracle (api.types.check_throttled_for) across presence
+and equality-boundary edge cases."""
+
+import random
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from kube_throttler_tpu.api import (
+    ClusterThrottle,
+    ClusterThrottleSpec,
+    IsResourceAmountThrottled,
+    ResourceAmount,
+    Throttle,
+    ThrottleSpec,
+)
+from kube_throttler_tpu.api.pod import make_pod
+from kube_throttler_tpu.api.types import CalculatedThreshold, ThrottleStatus
+from kube_throttler_tpu.ops import (
+    CHECK_NOT_AFFECTED,
+    STATUS_NAMES,
+    DimRegistry,
+    check_pods,
+    check_pods_compact,
+    encode_pods,
+    encode_throttle_state,
+)
+
+NOW = datetime(2024, 1, 15, tzinfo=timezone.utc)
+RESOURCES = ["cpu", "memory", "nvidia.com/gpu"]
+# values chosen to sit on comparison boundaries (milli-units as strings)
+BOUNDARY_VALUES = ["0", "100m", "200m", "300m", "1"]
+
+
+def _random_amount(rng, allow_nil_counts=True) -> ResourceAmount:
+    counts = None
+    if not allow_nil_counts or rng.random() < 0.7:
+        counts = rng.choice([0, 1, 2, 3, 5])
+    requests = None
+    if rng.random() < 0.85:
+        requests = {}
+        for r in RESOURCES:
+            if rng.random() < 0.6:
+                requests[r] = rng.choice(BOUNDARY_VALUES)
+    return ResourceAmount.of(pod=counts, requests=requests)
+
+
+def _random_flags(rng) -> IsResourceAmountThrottled:
+    req = None
+    if rng.random() < 0.7:
+        req = {r: rng.random() < 0.3 for r in RESOURCES if rng.random() < 0.6}
+    return IsResourceAmountThrottled(
+        resource_counts_pod=rng.random() < 0.2, resource_requests=req
+    )
+
+
+def _random_status(rng) -> ThrottleStatus:
+    calc = CalculatedThreshold()
+    if rng.random() < 0.5:
+        calc = CalculatedThreshold(threshold=_random_amount(rng), calculated_at=NOW)
+    return ThrottleStatus(
+        calculated_threshold=calc,
+        throttled=_random_flags(rng),
+        used=_random_amount(rng),
+    )
+
+
+def _build_objects(rng, n_throttles, n_pods, kind):
+    throttles = []
+    reserved = []
+    for i in range(n_throttles):
+        if kind == "throttle":
+            throttles.append(
+                Throttle(
+                    name=f"t{i}",
+                    spec=ThrottleSpec(threshold=_random_amount(rng)),
+                    status=_random_status(rng),
+                )
+            )
+        else:
+            throttles.append(
+                ClusterThrottle(
+                    name=f"c{i}",
+                    spec=ClusterThrottleSpec(threshold=_random_amount(rng)),
+                    status=_random_status(rng),
+                )
+            )
+        reserved.append(
+            _random_amount(rng) if rng.random() < 0.6 else ResourceAmount()
+        )
+    pods = []
+    for i in range(n_pods):
+        reqs = {}
+        for r in RESOURCES:
+            if rng.random() < 0.6:
+                reqs[r] = rng.choice(BOUNDARY_VALUES)
+        pods.append(make_pod(f"p{i}", requests=reqs))
+    return throttles, reserved, pods
+
+
+@pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
+@pytest.mark.parametrize("on_equal", [False, True])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_kernel_matches_oracle(kind, on_equal, seed):
+    rng = random.Random(seed)
+    throttles, reserved, pods = _build_objects(rng, n_throttles=40, n_pods=30, kind=kind)
+
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims, reserved=reserved)
+    batch = encode_pods(pods, dims)
+    mask = np.asarray(rng.choices([True, False], k=len(pods) * len(throttles))).reshape(
+        len(pods), len(throttles)
+    )
+
+    step3 = True if kind == "throttle" else on_equal
+    got = np.asarray(check_pods(state, batch, mask, on_equal=on_equal, step3_on_equal=step3))
+
+    for i, pod in enumerate(pods):
+        for j, thr in enumerate(throttles):
+            if not mask[i, j]:
+                assert got[i, j] == CHECK_NOT_AFFECTED
+                continue
+            want = thr.check_throttled_for(pod, reserved[j], on_equal)
+            assert STATUS_NAMES[int(got[i, j])] == want, (
+                f"seed={seed} kind={kind} on_equal={on_equal} pod={i} thr={j}: "
+                f"kernel={STATUS_NAMES[int(got[i, j])]} oracle={want} "
+                f"thr={thr} pod_req={pod.spec.containers[0].requests} reserved={reserved[j]}"
+            )
+
+
+def test_compact_counts_match_full():
+    rng = random.Random(7)
+    throttles, reserved, pods = _build_objects(rng, 25, 20, "throttle")
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims, reserved=reserved)
+    batch = encode_pods(pods, dims)
+    mask = np.ones((20, 25), dtype=bool)
+
+    full = np.asarray(check_pods(state, batch, mask))
+    counts, schedulable = check_pods_compact(state, batch, mask)
+    counts = np.asarray(counts)
+    schedulable = np.asarray(schedulable)
+    for i in range(20):
+        for c in range(4):
+            assert counts[i, c] == np.sum(full[i] == c)
+        assert schedulable[i] == (np.sum((full[i] > 0)) == 0)
+
+
+def test_padding_rows_are_not_affected():
+    throttles = [Throttle(name="t0", spec=ThrottleSpec(threshold=ResourceAmount.of(pod=1)))]
+    pods = [make_pod("p0", requests={"cpu": "1"})]
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims, capacity=8)
+    batch = encode_pods(pods, dims, capacity=4)
+    mask = np.ones((4, 8), dtype=bool)
+    got = np.asarray(check_pods(state, batch, mask))
+    assert got.shape == (4, 8)
+    assert (got[1:, :] == CHECK_NOT_AFFECTED).all()
+    assert (got[:, 1:] == CHECK_NOT_AFFECTED).all()
+    assert got[0, 0] != CHECK_NOT_AFFECTED

@@ -1,0 +1,90 @@
+"""Manual TPU compatibility smoke: run every device kernel on real hardware.
+
+Usage: python tools/tpu_smoke.py   (no env overrides — uses ambient platform)
+
+Catches TPU-only lowering gaps (e.g. the X64 rewriter has no s64 dot_general)
+that CPU-only unit tests cannot see.
+"""
+
+import random
+import sys
+import time
+from datetime import datetime, timedelta, timezone
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from kube_throttler_tpu.api import ResourceAmount, TemporaryThresholdOverride, Throttle, ThrottleSpec
+from kube_throttler_tpu.api.pod import make_pod
+from kube_throttler_tpu.api.types import ThrottleSpecBase
+from kube_throttler_tpu.ops import DimRegistry, check_pods, check_pods_compact, encode_pods, encode_throttle_state
+from kube_throttler_tpu.ops.aggregate import aggregate_used, apply_pod_delta, throttled_flags
+from kube_throttler_tpu.ops.overrides import calculate_thresholds, encode_override_schedule
+
+NOW = datetime(2024, 1, 15, tzinfo=timezone.utc)
+
+
+def main():
+    print("devices:", jax.devices())
+    rng = random.Random(0)
+    throttles = [
+        Throttle(name=f"t{i}", spec=ThrottleSpec(threshold=ResourceAmount.of(pod=3, requests={"cpu": "1", "memory": "4Gi"})))
+        for i in range(64)
+    ]
+    pods = [make_pod(f"p{i}", requests={"cpu": "100m", "memory": "256Mi"}) for i in range(256)]
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims)
+    batch = encode_pods(pods, dims)
+    mask = np.asarray(rng.choices([True, False], k=256 * 64)).reshape(256, 64)
+
+    t0 = time.perf_counter()
+    full = check_pods(state, batch, mask)
+    full.block_until_ready()
+    print(f"check_pods compile+run: {time.perf_counter()-t0:.2f}s, result counts:",
+          {int(v): int(c) for v, c in zip(*np.unique(np.asarray(full), return_counts=True))})
+
+    counts, sched_ok = check_pods_compact(state, batch, mask)
+    jax.block_until_ready((counts, sched_ok))
+    print("compact ok; schedulable:", int(np.asarray(sched_ok).sum()))
+
+    counted = np.ones(256, dtype=bool)
+    used_cnt, used_req, contrib = aggregate_used(batch, mask, counted)
+    jax.block_until_ready((used_cnt, used_req, contrib))
+    print("aggregate ok; max used_req:", int(np.asarray(used_req).max()))
+
+    ids = np.array([0, 1, 64], dtype=np.int32)
+    sign = np.array([1, -1, 0], dtype=np.int64)
+    out = apply_pod_delta(used_cnt, used_req, contrib, ids, sign,
+                          np.asarray(batch.req[0]), np.asarray(batch.req_present[0]))
+    jax.block_until_ready(out)
+    print("scatter delta ok")
+
+    flags = throttled_flags(state.thr_cnt, state.thr_cnt_present, state.thr_req,
+                            state.thr_req_present, used_cnt, used_cnt > 0, used_req, contrib > 0)
+    jax.block_until_ready(flags)
+    print("throttled_flags ok")
+
+    specs = [
+        ThrottleSpecBase(
+            threshold=ResourceAmount.of(pod=3, requests={"cpu": "500m"}),
+            temporary_threshold_overrides=(
+                TemporaryThresholdOverride(
+                    begin=(NOW - timedelta(hours=1)).strftime("%Y-%m-%dT%H:%M:%SZ"),
+                    end=(NOW + timedelta(hours=1)).strftime("%Y-%m-%dT%H:%M:%SZ"),
+                    threshold=ResourceAmount.of(requests={"cpu": "2"}),
+                ),
+            ),
+        )
+        for _ in range(64)
+    ]
+    sched = encode_override_schedule(specs, dims)
+    out = calculate_thresholds(sched, np.int64(int(NOW.timestamp() * 1e9)))
+    jax.block_until_ready(out)
+    print("calculate_thresholds ok")
+    print("ALL TPU KERNELS OK")
+
+
+if __name__ == "__main__":
+    main()
