@@ -486,6 +486,16 @@ class CheckThrottleStatus:
     POD_REQUESTS_EXCEEDS_THRESHOLD = "pod-requests-exceeds-threshold"
 
 
+def effective_threshold(spec_threshold: ResourceAmount, status: ThrottleStatus) -> ResourceAmount:
+    """The threshold a check actually uses: status.calculatedThreshold once a
+    reconcile has stamped calculatedAt, else spec.threshold
+    (throttle_types.go:129-132). Single source of truth — the host oracle,
+    the standalone tensor encoder, and the live device mirror all call this."""
+    if status.calculated_threshold.calculated_at is not None:
+        return status.calculated_threshold.threshold
+    return spec_threshold
+
+
 def _check_throttled_for(
     spec_threshold: ResourceAmount,
     status: ThrottleStatus,
@@ -500,9 +510,7 @@ def _check_throttled_for(
     and ``is_throttled_on_equal`` for ClusterThrottle
     (clusterthrottle_types.go:45) — the one asymmetry between the kinds.
     """
-    threshold = spec_threshold
-    if status.calculated_threshold.calculated_at is not None:
-        threshold = status.calculated_threshold.threshold
+    threshold = effective_threshold(spec_threshold, status)
 
     pod_amount = resource_amount_of_pod(pod)
 

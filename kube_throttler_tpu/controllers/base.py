@@ -1,0 +1,101 @@
+"""Shared controller worker-pool (reference controller.go:34-122).
+
+N worker threads drain a rate-limiting workqueue; reconcile errors re-queue
+with exponential backoff (controller.go:106-108); success forgets the key.
+``enqueue_after`` drives override-boundary self-wakeups.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from datetime import timedelta
+from typing import Callable, List, Optional
+
+from ..engine.workqueue import RateLimitingQueue, ShutDown
+from ..utils.clock import Clock, RealClock
+
+logger = logging.getLogger(__name__)
+
+
+class ControllerBase:
+    def __init__(
+        self,
+        name: str,
+        target_kind: str,
+        throttler_name: str,
+        target_scheduler_name: str,
+        clock: Optional[Clock] = None,
+        threadiness: int = 1,
+    ):
+        self.name = name
+        self.target_kind = target_kind
+        self.throttler_name = throttler_name
+        self.target_scheduler_name = target_scheduler_name
+        self.clock = clock or RealClock()
+        self.threadiness = threadiness
+        self.workqueue = RateLimitingQueue(name, clock=self.clock)
+        self.reconcile_func: Callable[[str], None] = lambda key: None
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for i in range(self.threadiness):
+            t = threading.Thread(
+                target=self._run_worker, name=f"{self.name}-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        logger.info("Started %s workers name=%s threadiness=%d", self.name, self.throttler_name, self.threadiness)
+
+    def stop(self) -> None:
+        self.workqueue.shut_down()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        self._started = False
+
+    def enqueue(self, key: str) -> None:
+        self.workqueue.add(key)
+
+    def enqueue_after(self, key: str, duration: timedelta) -> None:
+        self.workqueue.add_after(key, duration)
+
+    def _run_worker(self) -> None:
+        while True:
+            try:
+                key = self.workqueue.get()
+            except ShutDown:
+                return
+            try:
+                self.reconcile_func(key)
+            except Exception:
+                # error → rate-limited requeue (controller.go:106-108)
+                self.workqueue.add_rate_limited(key)
+                logger.exception("error reconciling %r, requeuing", key)
+            else:
+                self.workqueue.forget(key)
+            finally:
+                self.workqueue.done(key)
+
+    def run_pending_once(self, max_items: int = 10000) -> int:
+        """Synchronously drain currently-ready queue items on the calling
+        thread (deterministic tests / single-threaded embedding). Returns the
+        number of reconciles executed."""
+        n = 0
+        while len(self.workqueue) > 0 and n < max_items:
+            key = self.workqueue.get(timeout=0.01)
+            try:
+                self.reconcile_func(key)
+            except Exception:
+                self.workqueue.add_rate_limited(key)
+                logger.exception("error reconciling %r, requeuing", key)
+            else:
+                self.workqueue.forget(key)
+            finally:
+                self.workqueue.done(key)
+            n += 1
+        return n

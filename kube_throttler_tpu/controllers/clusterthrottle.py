@@ -1,0 +1,276 @@
+"""ClusterThrottleController — cluster-scoped twin (reference
+clusterthrottle_controller.go).
+
+Differences from ThrottleController, all mirrored from the reference:
+
+- selector terms AND a namespaceSelector (affected_pods iterates matched
+  namespaces — clusterthrottle_controller.go:224-270);
+- ``affected_cluster_throttles`` requires the pod's Namespace object; a
+  missing namespace is an error, not a silent no-match (273-276);
+- ``check_throttled`` passes the caller's onEqual through to step 3 of the
+  4-state check (via ClusterThrottle.check_throttled_for —
+  clusterthrottle_types.go:45);
+- the namespace informer is watched with NO handlers (429) — namespace
+  label changes do not trigger reconciles.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from ..api.pod import Pod
+from ..api.types import (
+    ClusterThrottle,
+    ResourceAmount,
+    ThrottleStatus,
+    resource_amount_of_pod,
+)
+from ..engine.devicestate import DeviceStateManager
+from ..engine.reservations import ReservedResourceAmounts
+from ..engine.store import Event, EventType, NotFoundError, Store
+from ..utils.clock import Clock
+from .base import ControllerBase
+
+logger = logging.getLogger(__name__)
+
+
+class ClusterThrottleController(ControllerBase):
+    KIND = "clusterthrottle"
+
+    def __init__(
+        self,
+        throttler_name: str,
+        target_scheduler_name: str,
+        store: Store,
+        clock: Optional[Clock] = None,
+        threadiness: int = 1,
+        num_key_mutex: int = 128,
+        device_manager: Optional[DeviceStateManager] = None,
+        metrics_recorder=None,
+    ):
+        super().__init__(
+            name="ClusterThrottleController",
+            target_kind="ClusterThrottle",
+            throttler_name=throttler_name,
+            target_scheduler_name=target_scheduler_name,
+            clock=clock,
+            threadiness=threadiness,
+        )
+        self.store = store
+        self.cache = ReservedResourceAmounts(num_key_mutex)
+        self.device_manager = device_manager
+        self.metrics_recorder = metrics_recorder
+        self.reconcile_func = self.reconcile
+        self._setup_event_handlers()
+
+    def is_responsible_for(self, thr: ClusterThrottle) -> bool:
+        return self.throttler_name == thr.spec.throttler_name
+
+    def should_count_in(self, pod: Pod) -> bool:
+        return (
+            pod.spec.scheduler_name == self.target_scheduler_name and pod.is_scheduled()
+        )
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, key: str) -> None:
+        now = self.clock.now()
+        try:
+            thr = self.store.get_cluster_throttle(key.lstrip("/"))
+        except NotFoundError:
+            return
+
+        non_terminated, terminated = self.affected_pods(thr)
+
+        used = ResourceAmount()
+        for p in non_terminated:
+            used = used.add(resource_amount_of_pod(p))
+
+        calculated = thr.spec.calculate_threshold(now)
+        new_calculated = thr.status.calculated_threshold
+        if (
+            thr.status.calculated_threshold.threshold != calculated.threshold
+            or thr.status.calculated_threshold.messages != calculated.messages
+        ):
+            new_calculated = calculated
+
+        throttled = new_calculated.threshold.is_throttled(used, True)
+        new_status = ThrottleStatus(
+            calculated_threshold=new_calculated, throttled=throttled, used=used
+        )
+
+        def unreserve_affected() -> None:
+            for p in non_terminated + terminated:
+                self.unreserve_on_throttle(p, thr)
+
+        if new_status != thr.status:
+            self.store.update_cluster_throttle_status(thr.with_status(new_status))
+            if self.metrics_recorder is not None:
+                self.metrics_recorder.record(thr.with_status(new_status))
+            unreserve_affected()
+        else:
+            if self.metrics_recorder is not None:
+                self.metrics_recorder.record(thr)
+            unreserve_affected()
+
+        next_in = thr.spec.next_override_happens_in(now)
+        if next_in is not None:
+            self.enqueue_after(key, next_in)
+
+    # ----------------------------------------------------------- collections
+
+    def affected_pods(self, thr: ClusterThrottle) -> Tuple[List[Pod], List[Pod]]:
+        ns_map = {}
+        pods: List[Pod] = []
+        for ns in self.store.list_namespaces():
+            if not thr.spec.selector.matches_to_namespace(ns):
+                continue
+            ns_map[ns.name] = ns
+            pods.extend(self.store.list_pods(ns.name))
+
+        non_terminated: List[Pod] = []
+        terminated: List[Pod] = []
+        for pod in pods:
+            if not self.should_count_in(pod):
+                continue
+            if not thr.spec.selector.matches_to_pod(pod, ns_map[pod.namespace]):
+                continue
+            if pod.is_not_finished():
+                non_terminated.append(pod)
+            else:
+                terminated.append(pod)
+        return non_terminated, terminated
+
+    def affected_cluster_throttles(self, pod: Pod) -> List[ClusterThrottle]:
+        ns = self.store.get_namespace(pod.namespace)
+        if ns is None:
+            # Go: lister Get error propagates (clusterthrottle_controller.go:273-276)
+            raise NotFoundError(f"namespace {pod.namespace!r} not found")
+        affected = []
+        for thr in self.store.list_cluster_throttles():
+            if not self.is_responsible_for(thr):
+                continue
+            if thr.spec.selector.matches_to_pod(pod, ns):
+                affected.append(thr)
+        return affected
+
+    # ----------------------------------------------------------- reservation
+
+    def reserve(self, pod: Pod) -> None:
+        for thr in self.affected_cluster_throttles(pod):
+            self.reserve_on_throttle(pod, thr)
+
+    def reserve_on_throttle(self, pod: Pod, thr: ClusterThrottle) -> bool:
+        added = self.cache.add_pod(thr.key, pod)
+        if added and self.device_manager is not None:
+            self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
+        return added
+
+    def unreserve(self, pod: Pod) -> None:
+        for thr in self.affected_cluster_throttles(pod):
+            self.unreserve_on_throttle(pod, thr)
+
+    def unreserve_on_throttle(self, pod: Pod, thr: ClusterThrottle) -> bool:
+        removed = self.cache.remove_pod(thr.key, pod)
+        if removed and self.device_manager is not None:
+            self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
+        return removed
+
+    # ----------------------------------------------------------------- check
+
+    def check_throttled(
+        self, pod: Pod, is_throttled_on_equal: bool
+    ) -> Tuple[
+        List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle]
+    ]:
+        if self.device_manager is not None:
+            # the missing-namespace error contract holds on the device path
+            # too (clusterthrottle_controller.go:273-276)
+            if self.store.get_namespace(pod.namespace) is None:
+                raise NotFoundError(f"namespace {pod.namespace!r} not found")
+            results = self.device_manager.check_pod(pod, self.KIND, is_throttled_on_equal)
+            active, insufficient, exceeds, affected = [], [], [], []
+            for key, status in results.items():
+                thr = self.store.get_cluster_throttle(key.lstrip("/"))
+                affected.append(thr)
+                if status == "active":
+                    active.append(thr)
+                elif status == "insufficient":
+                    insufficient.append(thr)
+                elif status == "pod-requests-exceeds-threshold":
+                    exceeds.append(thr)
+            return active, insufficient, exceeds, affected
+        throttles = self.affected_cluster_throttles(pod)
+        active: List[ClusterThrottle] = []
+        insufficient: List[ClusterThrottle] = []
+        exceeds: List[ClusterThrottle] = []
+        for thr in throttles:
+            reserved, _ = self.cache.reserved_resource_amount(thr.key)
+            status = thr.check_throttled_for(pod, reserved, is_throttled_on_equal)
+            if status == "active":
+                active.append(thr)
+            elif status == "insufficient":
+                insufficient.append(thr)
+            elif status == "pod-requests-exceeds-threshold":
+                exceeds.append(thr)
+        return active, insufficient, exceeds, throttles
+
+    # ---------------------------------------------------------- event wiring
+
+    def _setup_event_handlers(self) -> None:
+        self.store.add_event_handler("ClusterThrottle", self._on_throttle_event)
+        self.store.add_event_handler("Pod", self._on_pod_event)
+        # namespace informer: watched but NO handlers — mirror of
+        # clusterthrottle_controller.go:429
+
+    def _on_throttle_event(self, event: Event) -> None:
+        thr = event.obj
+        if not self.is_responsible_for(thr):
+            return
+        self.enqueue(thr.key)
+
+    def _on_pod_event(self, event: Event) -> None:
+        if event.type == EventType.ADDED:
+            pod = event.obj
+            if not self.should_count_in(pod):
+                return
+            for thr in self._affected_or_log(pod):
+                self.enqueue(thr.key)
+        elif event.type == EventType.MODIFIED:
+            old_pod, new_pod = event.old_obj, event.obj
+            if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
+                return
+            try:
+                old_keys = {t.key for t in self.affected_cluster_throttles(old_pod)}
+                new_keys = {t.key for t in self.affected_cluster_throttles(new_pod)}
+            except NotFoundError:
+                logger.exception("failed to get affected clusterthrottles for %s", new_pod.key)
+                return
+            moved_from = old_keys - new_keys
+            moved_to = new_keys - old_keys
+            if moved_from or moved_to:
+                self.cache.move_throttle_assignment(new_pod, moved_from, moved_to)
+                if self.device_manager is not None:
+                    for key in moved_from | moved_to:
+                        self.device_manager.on_reservation_change(self.KIND, key, self.cache)
+            for key in old_keys | new_keys:
+                self.enqueue(key)
+        else:  # DELETED
+            pod = event.obj
+            if not self.should_count_in(pod):
+                return
+            if pod.is_scheduled():
+                try:
+                    self.unreserve(pod)
+                except Exception:
+                    logger.exception("failed to unreserve deleted pod %s", pod.key)
+            for thr in self._affected_or_log(pod):
+                self.enqueue(thr.key)
+
+    def _affected_or_log(self, pod: Pod) -> List[ClusterThrottle]:
+        try:
+            return self.affected_cluster_throttles(pod)
+        except NotFoundError:
+            logger.exception("failed to get affected clusterthrottles for %s", pod.key)
+            return []

@@ -1,0 +1,396 @@
+"""Host→device state mirror: keeps the check kernel's inputs current.
+
+The reference's PreFilter reads informer caches synchronously per pod
+attempt (plugin.go:148-215). Here the equivalent read path is a device
+kernel over mirrored tensors, so this manager maintains, per kind:
+
+- a ``SelectorIndex`` (the [P,T] mask),
+- pod staging rows (effective requests, int64 milli),
+- throttle staging rows (effective threshold, status.used, status.throttled
+  flags — i.e. exactly the fields ``check_throttled_for`` reads from the
+  CRD object) plus the reservation mirror,
+
+all as numpy staging arrays with dirty tracking; ``_sync`` uploads to device
+only what changed. Stable padded capacities mean the jitted kernels never
+recompile on object churn (they recompile only on capacity growth, which is
+geometric and rare).
+
+Writes arrive synchronously from store watch events (cheap row updates —
+same contract as informer handlers); reads (``check_pod``,
+``check_batch``) are served from device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.pod import Pod
+from ..api.types import ClusterThrottle, ResourceAmount, Throttle
+from ..quantity import to_milli
+from ..resourcelist import pod_request_resource_list
+from .index import SelectorIndex
+from .reservations import ReservedResourceAmounts
+from .store import Event, EventType, Store
+from ..ops.check import CHECK_NOT_AFFECTED, STATUS_NAMES, check_pods, check_pods_compact
+from ..ops.schema import DimRegistry, PodBatch, ThrottleState
+
+AnyThrottle = Union[Throttle, ClusterThrottle]
+
+
+class _KindState:
+    """Staging arrays + index for one kind."""
+
+    def __init__(self, kind: str, dims: DimRegistry):
+        self.kind = kind
+        self.dims = dims
+        self.index = SelectorIndex(kind)
+        self.R = dims.capacity
+        pcap, tcap = self.index.capacities
+        self._alloc_pods(pcap)
+        self._alloc_throttles(tcap)
+        self.dirty_pods = True
+        self.dirty_throttles = True
+        self._device_state: Optional[ThrottleState] = None
+        self._device_pods: Optional[PodBatch] = None
+        self._device_mask = None
+
+    def _alloc_pods(self, pcap: int) -> None:
+        self.pod_req = np.zeros((pcap, self.R), dtype=np.int64)
+        self.pod_present = np.zeros((pcap, self.R), dtype=bool)
+        self.pod_valid = np.zeros(pcap, dtype=bool)
+        self.pcap = pcap
+
+    def _alloc_throttles(self, tcap: int) -> None:
+        z64 = lambda *s: np.zeros(s, dtype=np.int64)
+        zb = lambda *s: np.zeros(s, dtype=bool)
+        R = self.R
+        self.thr_cnt, self.thr_cnt_present = z64(tcap), zb(tcap)
+        self.thr_req, self.thr_req_present = z64(tcap, R), zb(tcap, R)
+        self.used_cnt, self.used_cnt_present = z64(tcap), zb(tcap)
+        self.used_req, self.used_req_present = z64(tcap, R), zb(tcap, R)
+        self.res_cnt, self.res_cnt_present = z64(tcap), zb(tcap)
+        self.res_req, self.res_req_present = z64(tcap, R), zb(tcap, R)
+        self.st_cnt_throttled = zb(tcap)
+        self.st_req_throttled = zb(tcap, R)
+        self.st_req_flag_present = zb(tcap, R)
+        self.thr_valid = zb(tcap)
+        self.tcap = tcap
+
+    # -- growth -----------------------------------------------------------
+
+    def _pad_cols(self, arr: np.ndarray, new_r: int) -> np.ndarray:
+        out = np.zeros(arr.shape[:-1] + (new_r,), dtype=arr.dtype)
+        out[..., : arr.shape[-1]] = arr
+        return out
+
+    def ensure_capacity(self) -> None:
+        """Grow staging to match index capacities / dim registry."""
+        if self.dims.capacity != self.R:
+            new_r = self.dims.capacity
+            for name in (
+                "pod_req", "pod_present", "thr_req", "thr_req_present",
+                "used_req", "used_req_present", "res_req", "res_req_present",
+                "st_req_throttled", "st_req_flag_present",
+            ):
+                setattr(self, name, self._pad_cols(getattr(self, name), new_r))
+            self.R = new_r
+            self.dirty_pods = self.dirty_throttles = True
+        pcap, tcap = self.index.capacities
+        if pcap != self.pcap:
+            for name in ("pod_req", "pod_present"):
+                arr = getattr(self, name)
+                grown = np.zeros((pcap,) + arr.shape[1:], dtype=arr.dtype)
+                grown[: arr.shape[0]] = arr
+                setattr(self, name, grown)
+            grown_valid = np.zeros(pcap, dtype=bool)
+            grown_valid[: self.pod_valid.shape[0]] = self.pod_valid
+            self.pod_valid = grown_valid
+            self.pcap = pcap
+            self.dirty_pods = True
+        if tcap != self.tcap:
+            old = self.tcap
+            for name in (
+                "thr_cnt", "thr_cnt_present", "used_cnt", "used_cnt_present",
+                "res_cnt", "res_cnt_present", "st_cnt_throttled", "thr_valid",
+            ):
+                arr = getattr(self, name)
+                grown = np.zeros(tcap, dtype=arr.dtype)
+                grown[:old] = arr
+                setattr(self, name, grown)
+            for name in (
+                "thr_req", "thr_req_present", "used_req", "used_req_present",
+                "res_req", "res_req_present", "st_req_throttled", "st_req_flag_present",
+            ):
+                arr = getattr(self, name)
+                grown = np.zeros((tcap, self.R), dtype=arr.dtype)
+                grown[:old] = arr
+                setattr(self, name, grown)
+            self.tcap = tcap
+            self.dirty_throttles = True
+
+    # -- row updates ------------------------------------------------------
+
+    def _amount_into_row(
+        self,
+        amount: Optional[ResourceAmount],
+        cnt: np.ndarray,
+        cnt_present: np.ndarray,
+        req: np.ndarray,
+        req_present: np.ndarray,
+        i: int,
+    ) -> None:
+        if amount is None:
+            amount = ResourceAmount()
+        if amount.resource_counts is not None:
+            cnt[i] = amount.resource_counts
+            cnt_present[i] = True
+        else:
+            cnt[i] = 0
+            cnt_present[i] = False
+        req[i, :] = 0
+        req_present[i, :] = False
+        for name, q in (amount.resource_requests or {}).items():
+            j = self.dims.index_of(name)
+            if j >= self.R:
+                self.ensure_capacity()
+            req[i, j] = to_milli(q)
+            req_present[i, j] = True
+
+    def set_throttle_row(self, thr: AnyThrottle) -> None:
+        from ..api.types import effective_threshold
+
+        col = self.index.upsert_throttle(thr)
+        self.ensure_capacity()
+        eff = effective_threshold(thr.spec.threshold, thr.status)
+        self._amount_into_row(eff, self.thr_cnt, self.thr_cnt_present, self.thr_req, self.thr_req_present, col)
+        self._amount_into_row(
+            thr.status.used, self.used_cnt, self.used_cnt_present, self.used_req, self.used_req_present, col
+        )
+        st = thr.status.throttled
+        self.st_cnt_throttled[col] = st.resource_counts_pod
+        self.st_req_throttled[col, :] = False
+        self.st_req_flag_present[col, :] = False
+        for name, flag in (st.resource_requests or {}).items():
+            j = self.dims.index_of(name)
+            if j >= self.R:
+                self.ensure_capacity()
+            self.st_req_flag_present[col, j] = True
+            self.st_req_throttled[col, j] = flag
+        self.thr_valid[col] = True
+        self.dirty_throttles = True
+
+    def remove_throttle_row(self, key: str) -> None:
+        col = self.index.throttle_col(key)
+        self.index.remove_throttle(key)
+        if col is not None:
+            self.thr_valid[col] = False
+            self.res_cnt[col] = 0
+            self.res_cnt_present[col] = False
+            self.res_req[col, :] = 0
+            self.res_req_present[col, :] = False
+            self.dirty_throttles = True
+
+    def set_reserved_row(self, key: str, amount: ResourceAmount) -> None:
+        col = self.index.throttle_col(key)
+        if col is None:
+            return
+        self._amount_into_row(amount, self.res_cnt, self.res_cnt_present, self.res_req, self.res_req_present, col)
+        self.dirty_throttles = True
+
+    def encode_pod_requests_into(
+        self, req: np.ndarray, present: np.ndarray, i: int, pod: Pod
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Canonical pod-request row encoding (shared by the mirror rows and
+        ad-hoc single-pod batches). Returns possibly-regrown arrays."""
+        req[i, :] = 0
+        present[i, :] = False
+        for name, q in pod_request_resource_list(pod).items():
+            j = self.dims.index_of(name)
+            if j >= req.shape[1]:
+                self.ensure_capacity()
+                req = np.pad(req, ((0, 0), (0, self.R - req.shape[1])))
+                present = np.pad(present, ((0, 0), (0, self.R - present.shape[1])))
+            req[i, j] = to_milli(q)
+            present[i, j] = True
+        return req, present
+
+    def set_pod_row(self, pod: Pod) -> None:
+        row = self.index.upsert_pod(pod)
+        self.ensure_capacity()
+        self.pod_req, self.pod_present = self.encode_pod_requests_into(
+            self.pod_req, self.pod_present, row, pod
+        )
+        self.pod_valid[row] = True
+        self.dirty_pods = True
+
+    def remove_pod_row(self, key: str) -> None:
+        row = self.index.pod_row(key)
+        self.index.remove_pod(key)
+        if row is not None:
+            self.pod_valid[row] = False
+            self.dirty_pods = True
+
+    # -- device sync ------------------------------------------------------
+
+    def device_state(self) -> ThrottleState:
+        self.ensure_capacity()
+        if self.dirty_throttles or self._device_state is None:
+            self._device_state = ThrottleState(
+                valid=jnp.asarray(self.thr_valid),
+                thr_cnt=jnp.asarray(self.thr_cnt),
+                thr_cnt_present=jnp.asarray(self.thr_cnt_present),
+                thr_req=jnp.asarray(self.thr_req),
+                thr_req_present=jnp.asarray(self.thr_req_present),
+                used_cnt=jnp.asarray(self.used_cnt),
+                used_cnt_present=jnp.asarray(self.used_cnt_present),
+                used_req=jnp.asarray(self.used_req),
+                used_req_present=jnp.asarray(self.used_req_present),
+                res_cnt=jnp.asarray(self.res_cnt),
+                res_cnt_present=jnp.asarray(self.res_cnt_present),
+                res_req=jnp.asarray(self.res_req),
+                res_req_present=jnp.asarray(self.res_req_present),
+                st_cnt_throttled=jnp.asarray(self.st_cnt_throttled),
+                st_req_throttled=jnp.asarray(self.st_req_throttled),
+                st_req_flag_present=jnp.asarray(self.st_req_flag_present),
+            )
+            self.dirty_throttles = False
+        return self._device_state
+
+    def device_pods(self) -> Tuple[PodBatch, jnp.ndarray]:
+        self.ensure_capacity()
+        if self.dirty_pods or self._device_pods is None:
+            self._device_pods = PodBatch(
+                valid=jnp.asarray(self.pod_valid),
+                req=jnp.asarray(self.pod_req),
+                req_present=jnp.asarray(self.pod_present),
+            )
+            self._device_mask = jnp.asarray(self.index.mask)
+            self.dirty_pods = False
+        elif self._device_mask is None or self._device_mask.shape != self.index.mask.shape:
+            self._device_mask = jnp.asarray(self.index.mask)
+        return self._device_pods, self._device_mask
+
+    def refresh_mask(self) -> None:
+        self._device_mask = None
+
+
+class DeviceStateManager:
+    """Wires both kinds' staging to a Store and serves batched checks."""
+
+    def __init__(
+        self,
+        store: Store,
+        throttler_name: str,
+        target_scheduler_name: str,
+        dims: Optional[DimRegistry] = None,
+    ):
+        self.store = store
+        self.throttler_name = throttler_name
+        self.target_scheduler_name = target_scheduler_name
+        self.dims = dims or DimRegistry()
+        self._lock = threading.RLock()
+        self.throttle = _KindState("throttle", self.dims)
+        self.clusterthrottle = _KindState("clusterthrottle", self.dims)
+
+        store.add_event_handler("Namespace", self._on_namespace)
+        store.add_event_handler("Pod", self._on_pod)
+        store.add_event_handler("Throttle", self._on_throttle)
+        store.add_event_handler("ClusterThrottle", self._on_cluster_throttle)
+
+    # -- event wiring -----------------------------------------------------
+
+    def _on_namespace(self, event: Event) -> None:
+        with self._lock:
+            for ks in (self.throttle, self.clusterthrottle):
+                ks.index.upsert_namespace(event.obj)
+                ks.refresh_mask()
+
+    def _on_pod(self, event: Event) -> None:
+        with self._lock:
+            for ks in (self.throttle, self.clusterthrottle):
+                if event.type == EventType.DELETED:
+                    ks.remove_pod_row(event.obj.key)
+                else:
+                    ks.set_pod_row(event.obj)
+                ks.refresh_mask()
+
+    def _on_any_throttle(self, ks: _KindState, event: Event) -> None:
+        thr = event.obj
+        responsible = thr.spec.throttler_name == self.throttler_name
+        with self._lock:
+            if event.type == EventType.DELETED or not responsible:
+                # also handles a throttlerName edit AWAY from this throttler:
+                # the mirrored row must disappear, or it would keep blocking
+                # pods this throttler no longer governs
+                ks.remove_throttle_row(thr.key)
+            else:
+                ks.set_throttle_row(thr)
+            ks.refresh_mask()
+
+    def _on_throttle(self, event: Event) -> None:
+        self._on_any_throttle(self.throttle, event)
+
+    def _on_cluster_throttle(self, event: Event) -> None:
+        self._on_any_throttle(self.clusterthrottle, event)
+
+    def on_reservation_change(
+        self, kind: str, throttle_key: str, cache: ReservedResourceAmounts
+    ) -> None:
+        amount, _ = cache.reserved_resource_amount(throttle_key)
+        with self._lock:
+            ks = self.throttle if kind == "throttle" else self.clusterthrottle
+            ks.set_reserved_row(throttle_key, amount)
+
+    # -- queries ----------------------------------------------------------
+
+    def check_pod(self, pod: Pod, kind: str, on_equal: bool = False) -> Dict[str, str]:
+        """Single-pod check → {throttle_key: status_name} over affected
+        throttles. The device kernel sees a 1-row pod batch + its mask row."""
+        with self._lock:
+            ks = self.throttle if kind == "throttle" else self.clusterthrottle
+            ks.ensure_capacity()
+            row_req = np.zeros((1, ks.R), dtype=np.int64)
+            row_present = np.zeros((1, ks.R), dtype=bool)
+            row_req, row_present = ks.encode_pod_requests_into(row_req, row_present, 0, pod)
+            prow = ks.index.pod_row(pod.key)
+            if prow is not None:
+                mask_row = ks.index.mask[prow : prow + 1, :].copy()
+            else:
+                # pod not (yet) in the store: compute its mask row on the fly
+                mask_row = np.zeros((1, ks.tcap), dtype=bool)
+                for key in ks.index._thr_cols:  # noqa: SLF001 — same-package access
+                    col = ks.index.throttle_col(key)
+                    thr = ks.index._col_thrs[col]
+                    mask_row[0, col] = ks.index._match_one(thr, pod)
+
+            batch = PodBatch(
+                valid=np.ones(1, dtype=bool), req=row_req, req_present=row_present
+            )
+            state = ks.device_state()
+            step3 = True if kind == "throttle" else on_equal
+            out = np.asarray(
+                check_pods(state, batch, mask_row, on_equal=on_equal, step3_on_equal=step3)
+            )[0]
+            result = {}
+            for key, col in ks.index._thr_cols.items():
+                if out[col] != CHECK_NOT_AFFECTED:
+                    result[key] = STATUS_NAMES[int(out[col])]
+            return result
+
+    def check_batch(self, kind: str, on_equal: bool = False):
+        """All stored pods vs all stored throttles (bench / bulk admission).
+        Returns (counts int32[P,4], schedulable bool[P], row→pod-key map)."""
+        with self._lock:
+            ks = self.throttle if kind == "throttle" else self.clusterthrottle
+            state = ks.device_state()
+            pods, mask = ks.device_pods()
+            step3 = True if kind == "throttle" else on_equal
+            counts, schedulable = check_pods_compact(
+                state, pods, mask, on_equal=on_equal, step3_on_equal=step3
+            )
+            row_map = dict(ks.index._pod_rows)
+            return counts, schedulable, row_map

@@ -174,14 +174,12 @@ def encode_throttle_state(
     ``reserved`` optionally supplies per-throttle reserved ResourceAmounts
     (as ``api.types.ResourceAmount``); defaults to empty.
     """
+    from ..api.types import effective_threshold
+
     n = len(throttles)
     # register every name first so R is final before array allocation
     for thr in throttles:
-        eff = (
-            thr.status.calculated_threshold.threshold
-            if thr.status.calculated_threshold.calculated_at is not None
-            else thr.spec.threshold
-        )
+        eff = effective_threshold(thr.spec.threshold, thr.status)
         for name in (eff.resource_requests or {}):
             dims.index_of(name)
         for name in (thr.status.used.resource_requests or {}):
@@ -216,11 +214,7 @@ def encode_throttle_state(
 
     for i, thr in enumerate(throttles):
         valid[i] = True
-        eff = (
-            thr.status.calculated_threshold.threshold
-            if thr.status.calculated_threshold.calculated_at is not None
-            else thr.spec.threshold
-        )
+        eff = effective_threshold(thr.spec.threshold, thr.status)
         if eff.resource_counts is not None:
             thr_cnt[i] = eff.resource_counts
             thr_cnt_present[i] = True

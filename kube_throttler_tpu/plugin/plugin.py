@@ -1,0 +1,186 @@
+"""KubeThrottler plugin: the admission front-end (reference plugin.go).
+
+PreFilter gates pods on both controllers' check results with the reference's
+exact result statuses, reason-string formats, and Warning-event emission
+(plugin.go:148-215); Reserve/Unreserve book-keep scheduler-cycle
+reservations (217-257); EventsToRegister mirrors the requeue hints (263-279).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+from ..api.pod import Pod
+from ..api.types import cluster_throttle_names, throttle_names
+from ..controllers import ClusterThrottleController, ThrottleController
+from ..engine.devicestate import DeviceStateManager
+from ..engine.store import Store
+from ..utils.clock import Clock, RealClock
+from .args import KubeThrottlerPluginArgs
+from .framework import ClusterEvent, EventRecorder, Status, StatusCode
+
+logger = logging.getLogger(__name__)
+
+PLUGIN_NAME = "kube-throttler"
+
+SCHEME_GROUP = "schedule.k8s.everpeace.github.com"
+SCHEME_VERSION = "v1alpha1"
+
+
+class KubeThrottler:
+    """Implements PreFilter / Reserve / Unreserve / EventsToRegister."""
+
+    def __init__(
+        self,
+        args: KubeThrottlerPluginArgs,
+        store: Store,
+        clock: Optional[Clock] = None,
+        event_recorder: Optional[EventRecorder] = None,
+        use_device: bool = True,
+        start_workers: bool = False,
+    ):
+        clock = clock or RealClock()
+        self.args = args
+        self.store = store
+        self.event_recorder = event_recorder
+        self.device_manager = (
+            DeviceStateManager(store, args.name, args.target_scheduler_name)
+            if use_device
+            else None
+        )
+        self.throttle_ctr = ThrottleController(
+            throttler_name=args.name,
+            target_scheduler_name=args.target_scheduler_name,
+            store=store,
+            clock=clock,
+            threadiness=args.controller_threadiness,
+            num_key_mutex=args.num_key_mutex,
+            device_manager=self.device_manager,
+        )
+        self.cluster_throttle_ctr = ClusterThrottleController(
+            throttler_name=args.name,
+            target_scheduler_name=args.target_scheduler_name,
+            store=store,
+            clock=clock,
+            threadiness=args.controller_threadiness,
+            num_key_mutex=args.num_key_mutex,
+            device_manager=self.device_manager,
+        )
+        if start_workers:
+            self.throttle_ctr.start()
+            self.cluster_throttle_ctr.start()
+
+    @property
+    def name(self) -> str:
+        return PLUGIN_NAME
+
+    # -------------------------------------------------------------- prefilter
+
+    def pre_filter(self, pod: Pod) -> Status:
+        try:
+            thr_active, thr_insufficient, thr_exceeds, thr_affected = (
+                self.throttle_ctr.check_throttled(pod, False)
+            )
+        except Exception as e:
+            return Status(StatusCode.ERROR, (str(e),))
+
+        try:
+            clthr_active, clthr_insufficient, clthr_exceeds, clthr_affected = (
+                self.cluster_throttle_ctr.check_throttled(pod, False)
+            )
+        except Exception as e:
+            return Status(StatusCode.ERROR, (str(e),))
+
+        if (
+            len(thr_active) + len(thr_insufficient) + len(thr_exceeds)
+            + len(clthr_active) + len(clthr_insufficient) + len(clthr_exceeds)
+            == 0
+        ):
+            return Status(StatusCode.SUCCESS)
+
+        # reason ordering mirrors plugin.go:182-214 exactly
+        reasons: List[str] = []
+        if clthr_exceeds:
+            reasons.append(
+                f"clusterthrottle[pod-requests-exceeds-threshold]={','.join(cluster_throttle_names(clthr_exceeds))}"
+            )
+        if thr_exceeds:
+            reasons.append(
+                f"throttle[pod-requests-exceeds-threshold]={','.join(throttle_names(thr_exceeds))}"
+            )
+        if (clthr_exceeds or thr_exceeds) and self.event_recorder is not None:
+            names = cluster_throttle_names(clthr_exceeds) + throttle_names(thr_exceeds)
+            self.event_recorder.eventf(
+                pod.key,
+                "Warning",
+                "ResourceRequestsExceedsThrottleThreshold",
+                self.name,
+                "It won't be scheduled unless decreasing resource requests or "
+                "increasing ClusterThrottle/Throttle threshold because its "
+                f"resource requests exceeds their thresholds: {','.join(names)}",
+            )
+        if clthr_active:
+            reasons.append(f"clusterthrottle[active]={','.join(cluster_throttle_names(clthr_active))}")
+        if thr_active:
+            reasons.append(f"throttle[active]={','.join(throttle_names(thr_active))}")
+        if clthr_insufficient:
+            reasons.append(
+                f"clusterthrottle[insufficient]={','.join(cluster_throttle_names(clthr_insufficient))}"
+            )
+        if thr_insufficient:
+            reasons.append(f"throttle[insufficient]={','.join(throttle_names(thr_insufficient))}")
+        return Status(StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE, tuple(reasons))
+
+    # ---------------------------------------------------------------- reserve
+
+    def reserve(self, pod: Pod, node: str = "") -> Status:
+        errs: List[str] = []
+        try:
+            self.throttle_ctr.reserve(pod)
+        except Exception as e:
+            errs.append(f"Failed to reserve pod={pod.key} in ThrottleController: {e}")
+        try:
+            self.cluster_throttle_ctr.reserve(pod)
+        except Exception as e:
+            errs.append(f"Failed to reserve pod={pod.key} in ClusterThrottleController: {e}")
+        if errs:
+            return Status(StatusCode.ERROR, tuple(errs))
+        return Status(StatusCode.SUCCESS)
+
+    def unreserve(self, pod: Pod, node: str = "") -> None:
+        try:
+            self.throttle_ctr.unreserve(pod)
+        except Exception:
+            logger.exception("Failed to unreserve pod %s in ThrottleController", pod.key)
+        try:
+            self.cluster_throttle_ctr.unreserve(pod)
+        except Exception:
+            logger.exception("Failed to unreserve pod %s in ClusterThrottleController", pod.key)
+
+    # ----------------------------------------------------------------- events
+
+    def events_to_register(self) -> Sequence[ClusterEvent]:
+        return (
+            ClusterEvent("Node"),
+            ClusterEvent("Pod"),
+            ClusterEvent(f"throttles.{SCHEME_VERSION}.{SCHEME_GROUP}"),
+            ClusterEvent(f"clusterthrottles.{SCHEME_VERSION}.{SCHEME_GROUP}"),
+        )
+
+    def pre_filter_extensions(self) -> None:
+        return None  # plugin.go:259-261
+
+    # ---------------------------------------------------------------- control
+
+    def start(self) -> None:
+        self.throttle_ctr.start()
+        self.cluster_throttle_ctr.start()
+
+    def stop(self) -> None:
+        self.throttle_ctr.stop()
+        self.cluster_throttle_ctr.stop()
+
+    def run_pending_once(self) -> int:
+        """Deterministic single-threaded drain (tests / embedding)."""
+        return self.throttle_ctr.run_pending_once() + self.cluster_throttle_ctr.run_pending_once()

@@ -1,0 +1,40 @@
+"""Injectable clock (the reference threads k8s.io/utils/clock through its
+controllers for exactly this reason — deterministic override-boundary tests,
+plugin.go:97/109)."""
+
+from __future__ import annotations
+
+import threading
+from datetime import datetime, timedelta, timezone
+
+
+class Clock:
+    def now(self) -> datetime:  # pragma: no cover — interface
+        raise NotImplementedError
+
+
+class RealClock(Clock):
+    def now(self) -> datetime:
+        return datetime.now(timezone.utc)
+
+
+class FakeClock(Clock):
+    """Settable clock for tests; ``advance`` wakes pollers via condition."""
+
+    def __init__(self, start: datetime):
+        self._now = start
+        self._cond = threading.Condition()
+
+    def now(self) -> datetime:
+        with self._cond:
+            return self._now
+
+    def advance(self, delta: timedelta) -> None:
+        with self._cond:
+            self._now += delta
+            self._cond.notify_all()
+
+    def set(self, t: datetime) -> None:
+        with self._cond:
+            self._now = t
+            self._cond.notify_all()

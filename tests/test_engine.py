@@ -1,0 +1,340 @@
+"""State-engine components: store/watch, workqueue, reservations, index."""
+
+import random
+import threading
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from kube_throttler_tpu.api import (
+    ClusterThrottle,
+    ClusterThrottleSelector,
+    ClusterThrottleSelectorTerm,
+    ClusterThrottleSpec,
+    LabelSelector,
+    Namespace,
+    ResourceAmount,
+    Throttle,
+    ThrottleSelector,
+    ThrottleSelectorTerm,
+    ThrottleSpec,
+)
+from kube_throttler_tpu.api.pod import make_pod
+from kube_throttler_tpu.api.types import LabelSelectorRequirement
+from kube_throttler_tpu.engine import RateLimitingQueue, ReservedResourceAmounts, Store
+from kube_throttler_tpu.engine.index import SelectorIndex
+from kube_throttler_tpu.engine.store import ConflictError, Event, EventType
+from kube_throttler_tpu.utils.clock import FakeClock
+
+
+class TestStore:
+    def test_watch_events_and_replay(self):
+        store = Store()
+        events = []
+        pod = make_pod("p1")
+        store.create_pod(pod)
+        store.add_event_handler("Pod", events.append)  # replay existing
+        store.update_pod(make_pod("p1", labels={"a": "b"}))
+        store.delete_pod("default", "p1")
+        assert [e.type for e in events] == [
+            EventType.ADDED,
+            EventType.MODIFIED,
+            EventType.DELETED,
+        ]
+        assert events[1].old_obj.labels == {}
+
+    def test_status_update_optimistic_concurrency(self):
+        store = Store()
+        thr = Throttle(name="t1", spec=ThrottleSpec(threshold=ResourceAmount.of(pod=1)))
+        store.create_throttle(thr)
+        rv = store.resource_version("Throttle", "default/t1")
+        from kube_throttler_tpu.api.types import ThrottleStatus
+
+        updated = thr.with_status(ThrottleStatus(used=ResourceAmount.of(pod=1)))
+        store.update_throttle_status(updated, expected_version=rv)
+        with pytest.raises(ConflictError):
+            store.update_throttle_status(updated, expected_version=rv)
+        # spec is preserved on status write
+        assert store.get_throttle("default", "t1").spec.threshold.resource_counts == 1
+
+
+class TestWorkqueue:
+    def test_dedup_while_queued(self):
+        q = RateLimitingQueue("test")
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert len(q) == 2
+
+    def test_requeue_if_added_while_processing(self):
+        q = RateLimitingQueue("test")
+        q.add("a")
+        item = q.get()
+        q.add("a")  # while processing → dirty, not queued
+        assert len(q) == 0
+        q.done(item)
+        assert len(q) == 1
+
+    def test_add_after_with_fake_clock(self):
+        clock = FakeClock(datetime(2024, 1, 1, tzinfo=timezone.utc))
+        q = RateLimitingQueue("test", clock=clock)
+        q.add_after("x", timedelta(seconds=60))
+        import time
+
+        time.sleep(0.02)
+        assert len(q) == 0
+        clock.advance(timedelta(seconds=61))
+        deadline = time.time() + 2
+        while len(q) == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert len(q) == 1
+
+    def test_rate_limited_backoff_and_forget(self):
+        q = RateLimitingQueue("test")
+        q.add_rate_limited("k")  # 5ms
+        import time
+
+        time.sleep(0.1)
+        assert len(q) == 1
+        assert q.num_requeues("k") == 1
+        q.forget("k")
+        assert q.num_requeues("k") == 0
+
+
+class TestReservations:
+    def test_idempotent_add_remove(self):
+        cache = ReservedResourceAmounts(8)
+        pod = make_pod("p1", requests={"cpu": "100m"})
+        assert cache.add_pod("default/t1", pod)
+        assert not cache.add_pod("default/t1", pod)  # overwrite, not new
+        amt, keys = cache.reserved_resource_amount("default/t1")
+        assert amt.resource_counts == 1 and keys == {"default/p1"}
+        assert cache.remove_pod("default/t1", pod)
+        assert not cache.remove_pod("default/t1", pod)
+        amt, keys = cache.reserved_resource_amount("default/t1")
+        assert amt == ResourceAmount() and keys == set()
+
+    def test_move_assignment(self):
+        cache = ReservedResourceAmounts(8)
+        pod = make_pod("p1", requests={"cpu": "100m"})
+        cache.add_pod("default/t1", pod)
+        cache.move_throttle_assignment(pod, ["default/t1"], ["default/t2"])
+        assert cache.reserved_pod_keys("default/t1") == set()
+        assert cache.reserved_pod_keys("default/t2") == {"default/p1"}
+
+    def test_concurrent_stress(self):
+        # reserved_resource_amounts_test.go:33-108, scaled to Python threads
+        cache = ReservedResourceAmounts(16)
+        pods = [make_pod(f"p{i}", requests={"cpu": "1"}) for i in range(50)]
+        keys = [f"default/t{i}" for i in range(8)]
+        errors = []
+
+        def worker(seed):
+            rng = random.Random(seed)
+            try:
+                for _ in range(200):
+                    key = rng.choice(keys)
+                    pod = rng.choice(pods)
+                    op = rng.random()
+                    if op < 0.45:
+                        cache.add_pod(key, pod)
+                    elif op < 0.9:
+                        cache.remove_pod(key, pod)
+                    else:
+                        cache.reserved_resource_amount(key)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # ledger remains consistent: every remaining entry sums correctly
+        for key in keys:
+            amt, pod_keys = cache.reserved_resource_amount(key)
+            assert (amt.resource_counts or 0) == len(pod_keys)
+
+
+def _random_label(rng):
+    return {f"k{rng.randrange(3)}": f"v{rng.randrange(3)}"}
+
+
+class TestSelectorIndex:
+    def _oracle_mask(self, index, pods, throttles, namespaces):
+        out = {}
+        for pk, pod in pods.items():
+            for tk, thr in throttles.items():
+                if isinstance(thr, Throttle):
+                    want = thr.namespace == pod.namespace and thr.spec.selector.matches_to_pod(pod)
+                else:
+                    ns = namespaces.get(pod.namespace)
+                    want = ns is not None and thr.spec.selector.matches_to_pod(pod, ns)
+                out[(pk, tk)] = want
+        return out
+
+    @pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
+    def test_random_churn_matches_oracle(self, kind):
+        rng = random.Random(42)
+        index = SelectorIndex(kind, pod_capacity=4, throttle_capacity=2)  # force growth
+        pods, throttles, namespaces = {}, {}, {}
+
+        for name in ("ns1", "ns2", "ns3"):
+            ns = Namespace(name, labels=_random_label(rng))
+            namespaces[name] = ns
+            index.upsert_namespace(ns)
+
+        def rand_throttle(i):
+            n_terms = rng.randrange(0, 3)
+            if kind == "throttle":
+                terms = tuple(
+                    ThrottleSelectorTerm(LabelSelector(match_labels=_random_label(rng)))
+                    for _ in range(n_terms)
+                )
+                # occasionally a matchExpressions (general-tier) term
+                if rng.random() < 0.3:
+                    terms += (
+                        ThrottleSelectorTerm(
+                            LabelSelector(
+                                match_expressions=(
+                                    LabelSelectorRequirement(f"k{rng.randrange(3)}", "Exists"),
+                                )
+                            )
+                        ),
+                    )
+                return Throttle(
+                    name=f"t{i}",
+                    namespace=rng.choice(["ns1", "ns2", "ns3"]),
+                    spec=ThrottleSpec(selector=ThrottleSelector(selector_terms=terms)),
+                )
+            terms = tuple(
+                ClusterThrottleSelectorTerm(
+                    pod_selector=LabelSelector(match_labels=_random_label(rng)),
+                    namespace_selector=LabelSelector(match_labels=_random_label(rng))
+                    if rng.random() < 0.7
+                    else LabelSelector(),
+                )
+                for _ in range(n_terms)
+            )
+            return ClusterThrottle(
+                name=f"c{i}", spec=ClusterThrottleSpec(selector=ClusterThrottleSelector(selector_terms=terms))
+            )
+
+        for step in range(300):
+            op = rng.random()
+            if op < 0.35:
+                pod = make_pod(
+                    f"p{rng.randrange(20)}",
+                    namespace=rng.choice(["ns1", "ns2", "ns3"]),
+                    labels=_random_label(rng) if rng.random() < 0.8 else {},
+                )
+                pods[pod.key] = pod
+                index.upsert_pod(pod)
+            elif op < 0.5 and pods:
+                key = rng.choice(list(pods))
+                del pods[key]
+                index.remove_pod(key)
+            elif op < 0.8:
+                thr = rand_throttle(rng.randrange(6))
+                throttles[thr.key] = thr
+                index.upsert_throttle(thr)
+            elif op < 0.9 and throttles:
+                key = rng.choice(list(throttles))
+                del throttles[key]
+                index.remove_throttle(key)
+            else:
+                name = rng.choice(["ns1", "ns2", "ns3"])
+                ns = Namespace(name, labels=_random_label(rng))
+                namespaces[name] = ns
+                index.upsert_namespace(ns)
+                # ns label change can flip throttle matches for its pods
+                # (handled inside upsert_namespace)
+
+        oracle = self._oracle_mask(index, pods, throttles, namespaces)
+        for (pk, tk), want in oracle.items():
+            row = index.pod_row(pk)
+            col = index.throttle_col(tk)
+            got = bool(index.mask[row, col])
+            assert got == want, f"({pk},{tk}): index={got} oracle={want}"
+        # affected queries agree with the mask
+        for pk in pods:
+            got = set(index.affected_throttle_keys(pk))
+            want_keys = {tk for tk in throttles if oracle[(pk, tk)]}
+            assert got == want_keys
+
+
+class TestDeviceMirrorRegressions:
+    """Round-1 review findings on the device mirror."""
+
+    def _manager(self):
+        from kube_throttler_tpu.engine.devicestate import DeviceStateManager
+
+        store = Store()
+        store.create_namespace(Namespace("default"))
+        mgr = DeviceStateManager(store, "kube-throttler", "my-scheduler")
+        return store, mgr
+
+    def _throttle(self, name, namespace="default", label="x", throttler="kube-throttler"):
+        return Throttle(
+            name=name,
+            namespace=namespace,
+            spec=ThrottleSpec(
+                throttler_name=throttler,
+                threshold=ResourceAmount.of(requests={"cpu": "100m"}),
+                selector=ThrottleSelector(
+                    selector_terms=(
+                        ThrottleSelectorTerm(LabelSelector(match_labels={"throttle": label})),
+                    )
+                ),
+            ),
+        )
+
+    def test_unknown_pod_fallback_respects_throttle_namespace(self):
+        store, mgr = self._manager()
+        store.create_namespace(Namespace("other"))
+        store.create_throttle(self._throttle("t1", namespace="other"))
+        # pod NOT in the store → fallback mask path
+        pod = make_pod("ghost", namespace="default", labels={"throttle": "x"}, requests={"cpu": "1"})
+        assert mgr.check_pod(pod, "throttle") == {}
+
+    def test_throttler_name_change_removes_device_row(self):
+        from dataclasses import replace
+
+        store, mgr = self._manager()
+        thr = self._throttle("t2")
+        store.create_throttle(thr)
+        pod = make_pod("p", labels={"throttle": "x"}, requests={"cpu": "1"})
+        store.create_pod(pod)
+        assert "default/t2" in mgr.check_pod(pod, "throttle")
+        # rename the throttler → this throttler no longer governs t2
+        store.update_throttle(replace(thr, spec=replace(thr.spec, throttler_name="someone-else")))
+        assert mgr.check_pod(pod, "throttle") == {}
+
+    def test_missing_namespace_never_matches_clusterthrottle(self):
+        from kube_throttler_tpu.engine.devicestate import DeviceStateManager
+
+        store = Store()  # note: no namespace objects at all
+        mgr = DeviceStateManager(store, "kube-throttler", "my-scheduler")
+        clthr = ClusterThrottle(
+            name="c1",
+            spec=ClusterThrottleSpec(
+                throttler_name="kube-throttler",
+                threshold=ResourceAmount.of(requests={"cpu": "100m"}),
+                selector=ClusterThrottleSelector(
+                    selector_terms=(
+                        ClusterThrottleSelectorTerm(
+                            pod_selector=LabelSelector(match_labels={"throttle": "x"})
+                        ),
+                    )
+                ),
+            ),
+        )
+        store.create_cluster_throttle(clthr)
+        pod = make_pod("p", namespace="ghost", labels={"throttle": "x"}, requests={"cpu": "1"})
+        store.create_pod(pod)
+        assert mgr.check_pod(pod, "clusterthrottle") == {}
+        # once the namespace exists, the match appears
+        store.create_namespace(Namespace("ghost"))
+        assert "/c1" in mgr.check_pod(pod, "clusterthrottle")
