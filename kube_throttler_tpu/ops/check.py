@@ -156,6 +156,24 @@ def check_pods(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
     return _classify(state, pods, mask, on_equal, step3_on_equal)
 
 
+def _compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
+             on_equal: bool, step3_on_equal: bool):
+    statuses = _classify(state, pods, mask, on_equal, step3_on_equal)
+    counts = jnp.stack(
+        [jnp.sum(statuses == c, axis=1, dtype=jnp.int32) for c in range(4)], axis=1
+    )
+    schedulable = (
+        counts[:, CHECK_ACTIVE] + counts[:, CHECK_INSUFFICIENT] + counts[:, CHECK_POD_EXCEEDS]
+    ) == 0
+    return counts, schedulable
+
+
+def check_step(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray):
+    """Un-jitted forward step (PreFilter defaults: onEqual=False, Throttle
+    kind) for embedding under an outer jit — returns (counts, schedulable)."""
+    return _compact(state, pods, mask, False, True)
+
+
 @partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
 def check_pods_compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
                        on_equal: bool = False, step3_on_equal: bool = True):
@@ -167,9 +185,4 @@ def check_pods_compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
     mirrors PreFilter's gate: no active/insufficient/exceeds throttle
     (plugin.go:177-180).
     """
-    statuses = _classify(state, pods, mask, on_equal, step3_on_equal)
-    counts = jnp.stack(
-        [jnp.sum(statuses == c, axis=1, dtype=jnp.int32) for c in range(4)], axis=1
-    )
-    schedulable = (counts[:, CHECK_ACTIVE] + counts[:, CHECK_INSUFFICIENT] + counts[:, CHECK_POD_EXCEEDS]) == 0
-    return counts, schedulable
+    return _compact(state, pods, mask, on_equal, step3_on_equal)

@@ -7,11 +7,32 @@ TPU path uses).
 
 import os
 
-# force, not setdefault: the ambient environment may point JAX_PLATFORMS at
-# real TPU hardware, and unit tests must be deterministic CPU runs
+# force, not setdefault: the ambient environment points JAX_PLATFORMS at real
+# TPU hardware AND preloads jax via sitecustomize, so the env var alone is
+# too late — jax.config must be updated before the first backend init
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import re
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+_want = int(
+    re.search(
+        r"xla_force_host_platform_device_count=(\d+)", os.environ["XLA_FLAGS"]
+    ).group(1)
+)
+assert jax.local_device_count() == _want, (
+    f"expected {_want} virtual CPU devices, got {jax.devices()}; either a "
+    "backend was initialized before conftest could force the CPU platform, "
+    "or the ambient XLA_FLAGS device count disagrees (tests need 8)"
+)
+assert jax.local_device_count() == 8, (
+    f"tests assume an 8-device mesh; ambient XLA_FLAGS pinned "
+    f"{jax.local_device_count()} — unset xla_force_host_platform_device_count"
+)

@@ -1,0 +1,102 @@
+"""Sharded full-update step vs the single-device program on the 8-device
+virtual CPU mesh (same SPMD partitioner as TPU)."""
+
+import random
+from datetime import datetime, timedelta, timezone
+
+import jax
+import numpy as np
+import pytest
+
+from kube_throttler_tpu.api import ResourceAmount, TemporaryThresholdOverride
+from kube_throttler_tpu.api.types import ThrottleSpecBase
+from kube_throttler_tpu.ops.overrides import encode_override_schedule
+from kube_throttler_tpu.ops.schema import DimRegistry, PodBatch
+from kube_throttler_tpu.parallel import full_update_step, make_mesh, sharded_full_update
+
+NOW = datetime(2024, 1, 15, tzinfo=timezone.utc)
+
+
+def rfc(dt):
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _build_inputs(rng, P_, T_, R_used=3):
+    specs = []
+    for i in range(T_):
+        overrides = ()
+        if rng.random() < 0.5:
+            overrides = (
+                TemporaryThresholdOverride(
+                    begin=rfc(NOW - timedelta(hours=1)),
+                    end=rfc(NOW + timedelta(hours=1)),
+                    threshold=ResourceAmount.of(requests={"cpu": f"{rng.randrange(1,9)}00m"}),
+                ),
+            )
+        specs.append(
+            ThrottleSpecBase(
+                threshold=ResourceAmount.of(
+                    pod=rng.randrange(1, 5), requests={"cpu": "500m", "memory": "1Gi"}
+                ),
+                temporary_threshold_overrides=overrides,
+            )
+        )
+    dims = DimRegistry()
+    sched = encode_override_schedule(specs, dims, throttle_capacity=T_)
+
+    pod_req = np.zeros((P_, dims.capacity), dtype=np.int64)
+    pod_present = np.zeros((P_, dims.capacity), dtype=bool)
+    for i in range(P_):
+        for r in range(R_used):
+            if rng.random() < 0.7:
+                pod_req[i, r] = rng.randrange(0, 5) * 100
+                pod_present[i, r] = True
+    pods = PodBatch(
+        valid=np.ones(P_, dtype=bool), req=pod_req, req_present=pod_present
+    )
+    mask = np.asarray(rng.choices([True, False], k=P_ * T_)).reshape(P_, T_)
+    counted = np.asarray(rng.choices([True, False], k=P_))
+    res_cnt = np.zeros(T_, dtype=np.int64)
+    res_cnt_p = np.zeros(T_, dtype=bool)
+    res_req = np.zeros((T_, dims.capacity), dtype=np.int64)
+    res_req_p = np.zeros((T_, dims.capacity), dtype=bool)
+    for t in range(T_):
+        if rng.random() < 0.4:
+            res_cnt[t] = rng.randrange(0, 3)
+            res_cnt_p[t] = True
+            res_req[t, 0] = rng.randrange(0, 3) * 100
+            res_req_p[t, 0] = True
+    thr_valid = np.ones(T_, dtype=bool)
+    now_ns = np.int64(int(NOW.timestamp()) * 10**9)
+    return sched, pods, mask, counted, res_cnt, res_cnt_p, res_req, res_req_p, thr_valid, now_ns
+
+
+def test_sharded_matches_single_device():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    rng = random.Random(0)
+    # P=32 pods over dp=4, T=16 throttles over tp=2
+    inputs = _build_inputs(rng, 32, 16)
+
+    single = full_update_step(*inputs)
+    mesh = make_mesh(8, shape=(4, 2))
+    stepped = sharded_full_update(mesh)(*inputs)
+
+    for got, want in zip(stepped, single):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_mesh_factorization():
+    mesh = make_mesh(8)
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("pods", "throttles")
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (2, 4), (1, 8)])
+def test_all_mesh_shapes(shape):
+    rng = random.Random(1)
+    inputs = _build_inputs(rng, 16, 8)
+    single = full_update_step(*inputs)
+    mesh = make_mesh(8, shape=shape)
+    stepped = sharded_full_update(mesh)(*inputs)
+    for got, want in zip(stepped, single):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
