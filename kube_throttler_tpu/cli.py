@@ -1,0 +1,112 @@
+"""CLI entry point (reference cmd/: cobra root + kube-scheduler + version).
+
+The reference binary embeds upstream kube-scheduler with the plugin
+registered (cmd/kube_scheduler.go:90-106). The standalone TPU framework has
+no scheduler to embed, so ``serve`` runs the throttler as a daemon: the
+in-memory store + controllers + device mirror + the HTTP surface
+(PreFilter/Reserve/Unreserve + object CRUD + /metrics).
+
+Usage:
+    python -m kube_throttler_tpu.cli serve --name kube-throttler \
+        --target-scheduler-name my-scheduler [--port 10259] [--config cfg.yaml]
+    python -m kube_throttler_tpu.cli version
+
+``--config`` accepts a KubeSchedulerConfiguration-style YAML: the args are
+read from ``profiles[*].pluginConfig[name=kube-throttler].args`` (the same
+shape as deploy/config.yaml in the reference) or from a flat mapping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from . import __version__
+from .api.pod import Namespace
+from .engine.store import Store
+from .plugin import KubeThrottler, decode_plugin_args
+from .plugin.framework import RecordingEventRecorder
+from .server import ThrottlerHTTPServer
+
+
+def _args_from_config_file(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    for profile in cfg.get("profiles", []) or []:
+        for pc in profile.get("pluginConfig", []) or []:
+            if pc.get("name") == "kube-throttler":
+                return dict(pc.get("args") or {})
+    if "name" in cfg:
+        return cfg
+    raise SystemExit(f"no kube-throttler pluginConfig found in {path}")
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(prog="kube-throttler-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="run the throttler daemon")
+    serve.add_argument("--config", help="KubeSchedulerConfiguration-style YAML")
+    serve.add_argument("--name", help="throttler name (spec.throttlerName to own)")
+    serve.add_argument("--target-scheduler-name", help="schedulerName of governed pods")
+    serve.add_argument("--controller-threadiness", type=int, default=0)
+    serve.add_argument("--num-key-mutex", type=int, default=0)
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=10259)
+    serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
+
+    sub.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        print(f"kube-throttler-tpu version {__version__}")
+        return 0
+
+    config: Dict[str, Any] = {}
+    if args.config:
+        config = _args_from_config_file(args.config)
+    if args.name:
+        config["name"] = args.name
+    if args.target_scheduler_name:
+        config["targetSchedulerName"] = args.target_scheduler_name
+    if args.controller_threadiness:
+        config["controllerThrediness"] = args.controller_threadiness
+    if args.num_key_mutex:
+        config["numKeyMutex"] = args.num_key_mutex
+
+    plugin_args = decode_plugin_args(config)
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    plugin = KubeThrottler(
+        plugin_args,
+        store,
+        event_recorder=RecordingEventRecorder(),
+        use_device=not args.no_device,
+        start_workers=True,
+    )
+    server = ThrottlerHTTPServer(plugin, host=args.host, port=args.port)
+    server.start()
+    print(
+        f"kube-throttler-tpu serving on {args.host}:{server.port} "
+        f"(throttler={plugin_args.name}, scheduler={plugin_args.target_scheduler_name}, "
+        f"device={'on' if not args.no_device else 'off'})",
+        flush=True,
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    plugin.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

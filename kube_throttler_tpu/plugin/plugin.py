@@ -16,6 +16,7 @@ from ..api.types import cluster_throttle_names, throttle_names
 from ..controllers import ClusterThrottleController, ThrottleController
 from ..engine.devicestate import DeviceStateManager
 from ..engine.store import Store
+from ..metrics import ClusterThrottleMetricsRecorder, Registry, ThrottleMetricsRecorder
 from ..utils.clock import Clock, RealClock
 from .args import KubeThrottlerPluginArgs
 from .framework import ClusterEvent, EventRecorder, Status, StatusCode
@@ -39,11 +40,13 @@ class KubeThrottler:
         event_recorder: Optional[EventRecorder] = None,
         use_device: bool = True,
         start_workers: bool = False,
+        metrics_registry=None,
     ):
         clock = clock or RealClock()
         self.args = args
         self.store = store
         self.event_recorder = event_recorder
+        self.metrics_registry = metrics_registry or Registry()
         self.device_manager = (
             DeviceStateManager(store, args.name, args.target_scheduler_name)
             if use_device
@@ -57,6 +60,7 @@ class KubeThrottler:
             threadiness=args.controller_threadiness,
             num_key_mutex=args.num_key_mutex,
             device_manager=self.device_manager,
+            metrics_recorder=ThrottleMetricsRecorder(self.metrics_registry),
         )
         self.cluster_throttle_ctr = ClusterThrottleController(
             throttler_name=args.name,
@@ -66,6 +70,7 @@ class KubeThrottler:
             threadiness=args.controller_threadiness,
             num_key_mutex=args.num_key_mutex,
             device_manager=self.device_manager,
+            metrics_recorder=ClusterThrottleMetricsRecorder(self.metrics_registry),
         )
         if start_workers:
             self.throttle_ctr.start()
