@@ -80,7 +80,10 @@ def main(argv: Optional[list] = None) -> int:
     if args.num_key_mutex:
         config["numKeyMutex"] = args.num_key_mutex
 
-    plugin_args = decode_plugin_args(config)
+    try:
+        plugin_args = decode_plugin_args(config)
+    except ValueError as e:
+        parser.error(str(e))  # clean usage error, not a traceback
     store = Store()
     store.create_namespace(Namespace("default"))
     plugin = KubeThrottler(

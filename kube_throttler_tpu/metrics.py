@@ -68,6 +68,11 @@ class Registry:
 
     def exposition(self) -> str:
         """Prometheus text format."""
+
+        def esc(v: str) -> str:
+            # label-value escaping per the exposition format: \ " and newline
+            return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
         lines = []
         with self._lock:
             gauges = list(self._gauges.values())
@@ -76,16 +81,13 @@ class Registry:
             lines.append(f"# TYPE {g.name} gauge")
             for key, value in sorted(g.collect().items()):
                 labels = ",".join(
-                    f'{n}="{v}"' for n, v in zip(g.label_names, key)
+                    f'{n}="{esc(v)}"' for n, v in zip(g.label_names, key)
                 )
                 if value == int(value):
                     lines.append(f"{g.name}{{{labels}}} {int(value)}")
                 else:
                     lines.append(f"{g.name}{{{labels}}} {value}")
         return "\n".join(lines) + "\n"
-
-
-DEFAULT_REGISTRY = Registry()
 
 
 def _quantity_metric_value(resource: str, q: Fraction) -> float:
@@ -171,11 +173,13 @@ class _KindRecorder:
 
 
 class ThrottleMetricsRecorder:
-    """throttle_metrics.go:94-197."""
+    """throttle_metrics.go:94-197. The registry is explicit — there is no
+    module-global default, so recorded series are always reachable from
+    whatever serves that registry's /metrics."""
 
-    def __init__(self, registry: Optional[Registry] = None):
+    def __init__(self, registry: Registry):
         self._rec = _KindRecorder(
-            "throttle", ("namespace", "name", "uid", "resource"), registry or DEFAULT_REGISTRY
+            "throttle", ("namespace", "name", "uid", "resource"), registry
         )
 
     def record(self, thr: Throttle) -> None:
@@ -187,9 +191,9 @@ class ThrottleMetricsRecorder:
 class ClusterThrottleMetricsRecorder:
     """clusterthrottle_metrics.go:224-326."""
 
-    def __init__(self, registry: Optional[Registry] = None):
+    def __init__(self, registry: Registry):
         self._rec = _KindRecorder(
-            "clusterthrottle", ("name", "uid", "resource"), registry or DEFAULT_REGISTRY
+            "clusterthrottle", ("name", "uid", "resource"), registry
         )
 
     def record(self, thr: ClusterThrottle) -> None:

@@ -65,6 +65,10 @@ class ThrottlerHTTPServer:
     def __init__(self, plugin: KubeThrottler, host: str = "127.0.0.1", port: int = 10259):
         self.plugin = plugin
         self.store = plugin.store
+        # serializes get-then-update pod mutations (re-apply, bind): the
+        # handler pool is threaded and a lost update here silently unbinds
+        # a running pod
+        self._pod_write_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -178,12 +182,13 @@ class ThrottlerHTTPServer:
                 if kind == "Pod":
                     # a manifest re-apply must not clobber server-owned state:
                     # nodeName (set by bind) and phase live on the stored pod
-                    current = self.store.get_pod(obj.namespace, obj.name)
-                    if not obj.spec.node_name:
-                        obj = replace(obj, spec=replace(obj.spec, node_name=current.spec.node_name))
-                    if "status" not in body:
-                        obj = replace(obj, status=replace(current.status))
-                    self.store.update_pod(obj)
+                    with self._pod_write_lock:
+                        current = self.store.get_pod(obj.namespace, obj.name)
+                        if not obj.spec.node_name:
+                            obj = replace(obj, spec=replace(obj.spec, node_name=current.spec.node_name))
+                        if "status" not in body:
+                            obj = replace(obj, status=replace(current.status))
+                        self.store.update_pod(obj)
                 elif kind == "Throttle":
                     # spec update must not clobber live status
                     current = self.store.get_throttle(obj.namespace, obj.name)
@@ -209,16 +214,17 @@ class ThrottlerHTTPServer:
             h._send(200, {"code": "Success"})
         elif h.path == "/v1/bind":
             namespace, _, name = body["podKey"].partition("/")
-            pod = self.store.get_pod(namespace, name)
-            # replace status as a fresh object: dataclasses.replace is
-            # shallow and mutating pod.status in place would alias the
-            # store's live object outside its lock
-            bound = replace(
-                pod,
-                spec=replace(pod.spec, node_name=body.get("nodeName", "node-1")),
-                status=replace(pod.status, phase="Running"),
-            )
-            self.store.update_pod(bound)
+            with self._pod_write_lock:
+                pod = self.store.get_pod(namespace, name)
+                # replace status as a fresh object: dataclasses.replace is
+                # shallow and mutating pod.status in place would alias the
+                # store's live object outside its lock
+                bound = replace(
+                    pod,
+                    spec=replace(pod.spec, node_name=body.get("nodeName", "node-1")),
+                    status=replace(pod.status, phase="Running"),
+                )
+                self.store.update_pod(bound)
             h._send(200, {"bound": pod.key})
         else:
             h._send(404, {"error": f"unknown path {h.path}"})
@@ -251,5 +257,6 @@ class ThrottlerHTTPServer:
 
     def stop(self) -> None:
         self._httpd.shutdown()
+        self._httpd.server_close()  # release the listening socket fd
         if self._thread:
             self._thread.join(timeout=2)
