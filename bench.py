@@ -196,6 +196,47 @@ def bench_single_pod(rng, state, T, R, label):
     return per_check * 1e3
 
 
+def bench_pallas_sweep(rng, P, T, R, label):
+    """Dense sweep via the tiled Pallas kernel (ops/pallas_check.py):
+    int32-limb compares + VMEM tiling vs the XLA broadcast fusion."""
+    from kube_throttler_tpu.ops.fastcheck import precompute_check_state
+    from kube_throttler_tpu.ops.pallas_check import BP, BT, pallas_check_pods
+
+    P = P + (-P) % BP
+    T = T + (-T) % BT
+    state = synth_state(rng, T, R)
+    batch, mask = synth_pods(rng, P, T, R)
+    device = jax.devices()[0]
+    state = jax.device_put(state, device)
+    batch = jax.device_put(batch, device)
+    mask = jax.device_put(mask, device)
+    pre = precompute_check_state(state)
+    jax.block_until_ready(pre.resid)
+
+    def make(n):
+        @jax.jit
+        def run(pre, batch, mask):
+            def body(i, acc):
+                b = PodBatch(
+                    valid=batch.valid,
+                    req=batch.req + acc % 2 + i,
+                    req_present=batch.req_present,
+                )
+                st = pallas_check_pods.__wrapped__(pre, b, mask, False, True, False)
+                return acc + jnp.sum(st == 1, dtype=jnp.int64)
+
+            return lax.fori_loop(0, n, body, jnp.int64(0))
+
+        return lambda: run(pre, batch, mask)
+
+    per_iter = device_time_per_iter(make, n1=2, n2=8)
+    log(
+        f"[{label}] pallas sweep {P}x{T}x{R}: {per_iter*1e3:.2f}ms/sweep "
+        f"-> {P/per_iter:,.0f} pod-decisions/sec ({P*T/per_iter/1e9:.1f}G pair-cells/sec)"
+    )
+    return per_iter
+
+
 def bench_overrides(rng, T, O, R, label):
     ov_valid = rng.random((T, O)) < 0.8
     ov_begin = np.full((T, O), NS_MIN, dtype=np.int64)
@@ -291,6 +332,10 @@ def main():
     P, T = 100_000 // scale, 10_000 // scale
     bench_overrides(rng, T, 4, R, "cfg4:overrides")
     state, batch, mask, dps, sweep_s = bench_batched(rng, P, T, R, "cfg4:100kx10k")
+    try:
+        bench_pallas_sweep(rng, P, T, R, "cfg4:100kx10k")
+    except Exception as e:  # pallas needs the TPU mosaic path; CPU runs skip
+        log(f"[cfg4:100kx10k] pallas sweep unavailable: {str(e)[:120]}")
     single_ms = bench_single_pod(rng, state, T, R, "cfg4:100kx10k")
 
     # config 5: streaming reconcile

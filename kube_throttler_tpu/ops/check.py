@@ -156,9 +156,10 @@ def check_pods(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
     return _classify(state, pods, mask, on_equal, step3_on_equal)
 
 
-def _compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
-             on_equal: bool, step3_on_equal: bool):
-    statuses = _classify(state, pods, mask, on_equal, step3_on_equal)
+def statuses_to_compact(statuses: jnp.ndarray):
+    """[P,T] statuses → (counts int32[P,4], schedulable bool[P]); the
+    schedulable gate mirrors PreFilter (plugin.go:177-180). Shared by every
+    compact path so the gate can never silently diverge between kernels."""
     counts = jnp.stack(
         [jnp.sum(statuses == c, axis=1, dtype=jnp.int32) for c in range(4)], axis=1
     )
@@ -166,6 +167,11 @@ def _compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
         counts[:, CHECK_ACTIVE] + counts[:, CHECK_INSUFFICIENT] + counts[:, CHECK_POD_EXCEEDS]
     ) == 0
     return counts, schedulable
+
+
+def _compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
+             on_equal: bool, step3_on_equal: bool):
+    return statuses_to_compact(_classify(state, pods, mask, on_equal, step3_on_equal))
 
 
 def check_step(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray):

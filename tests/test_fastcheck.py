@@ -1,0 +1,41 @@
+"""Residual-form fast check must be bit-identical to the direct kernel."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kube_throttler_tpu.ops import DimRegistry, check_pods, encode_pods, encode_throttle_state
+from kube_throttler_tpu.ops.fastcheck import (
+    fast_check_pods,
+    fast_check_pods_compact,
+    precompute_check_state,
+)
+from kube_throttler_tpu.ops.check import check_pods_compact
+
+from tests.test_check_kernel import _build_objects
+
+
+@pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
+@pytest.mark.parametrize("on_equal", [False, True])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fast_matches_direct(kind, on_equal, seed):
+    rng = random.Random(seed)
+    throttles, reserved, pods = _build_objects(rng, n_throttles=40, n_pods=30, kind=kind)
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims, reserved=reserved)
+    batch = encode_pods(pods, dims)
+    mask = np.asarray(rng.choices([True, False], k=len(pods) * len(throttles))).reshape(
+        len(pods), len(throttles)
+    )
+    step3 = True if kind == "throttle" else on_equal
+
+    direct = np.asarray(check_pods(state, batch, mask, on_equal=on_equal, step3_on_equal=step3))
+    pre = precompute_check_state(state)
+    fast = np.asarray(fast_check_pods(pre, batch, mask, on_equal=on_equal, step3_on_equal=step3))
+    np.testing.assert_array_equal(fast, direct)
+
+    dc, ds = check_pods_compact(state, batch, mask, on_equal=on_equal, step3_on_equal=step3)
+    fc, fs = fast_check_pods_compact(pre, batch, mask, on_equal=on_equal, step3_on_equal=step3)
+    np.testing.assert_array_equal(np.asarray(fc), np.asarray(dc))
+    np.testing.assert_array_equal(np.asarray(fs), np.asarray(ds))

@@ -1,0 +1,57 @@
+"""Pallas tiled check kernel (interpret mode on CPU) vs the direct kernel."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kube_throttler_tpu.ops import DimRegistry, check_pods, encode_pods, encode_throttle_state
+from kube_throttler_tpu.ops.fastcheck import precompute_check_state
+from kube_throttler_tpu.ops.pallas_check import BP, BT, pallas_check_pods
+from kube_throttler_tpu.ops.schema import PodBatch
+
+from tests.test_check_kernel import _build_objects
+
+
+@pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
+@pytest.mark.parametrize("on_equal", [False, True])
+def test_pallas_matches_direct(kind, on_equal):
+    rng = random.Random(5)
+    throttles, reserved, pods = _build_objects(rng, n_throttles=60, n_pods=40, kind=kind)
+    dims = DimRegistry()
+    # pad capacities straight to one block
+    state = encode_throttle_state(throttles, dims, reserved=reserved, capacity=BT)
+    batch = encode_pods(pods, dims, capacity=BP)
+    # randomize the FULL padded mask, including bits over invalid/padded pod
+    # and throttle rows — the kernel must report those as NOT_AFFECTED
+    # exactly like check_pods (round-1 review regression)
+    mask = np.asarray(rng.choices([True, False], k=BP * BT)).reshape(BP, BT)
+    step3 = True if kind == "throttle" else on_equal
+
+    direct = np.asarray(check_pods(state, batch, mask, on_equal=on_equal, step3_on_equal=step3))
+    pre = precompute_check_state(state)
+    got = np.asarray(
+        pallas_check_pods(
+            pre, batch, mask, on_equal=on_equal, step3_on_equal=step3, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(got, direct)
+
+
+def test_limb_compare_extremes():
+    """Limb-split compares must hold at int64 extremes and negatives."""
+    import jax.numpy as jnp
+
+    from kube_throttler_tpu.ops.pallas_check import _limb_ge, _limb_gt, _split_limbs
+
+    vals = np.array(
+        [0, 1, -1, 2**31, -(2**31), 2**32, -(2**32), 2**62, -(2**62),
+         2**63 - 1, -(2**63), 123456789012345, -987654321098765],
+        dtype=np.int64,
+    )
+    a = jnp.asarray(vals)[:, None]
+    b = jnp.asarray(vals)[None, :]
+    a_hi, a_lo = _split_limbs(a)
+    b_hi, b_lo = _split_limbs(b)
+    np.testing.assert_array_equal(np.asarray(_limb_gt(a_hi, a_lo, b_hi, b_lo)), vals[:, None] > vals[None, :])
+    np.testing.assert_array_equal(np.asarray(_limb_ge(a_hi, a_lo, b_hi, b_lo)), vals[:, None] >= vals[None, :])
