@@ -10,15 +10,18 @@ threshold``.
 
 from __future__ import annotations
 
+from datetime import timezone
 from typing import Any, Dict, List, Mapping, Optional
 
-from ..quantity import parse_quantity
-from .pod import Container, Pod, PodSpec, PodStatus
+from ..quantity import format_quantity, parse_quantity
+from .pod import Container, Namespace, Pod, PodSpec, PodStatus
 from .types import (
+    CalculatedThreshold,
     ClusterThrottle,
     ClusterThrottleSelector,
     ClusterThrottleSelectorTerm,
     ClusterThrottleSpec,
+    IsResourceAmountThrottled,
     LabelSelector,
     LabelSelectorRequirement,
     ResourceAmount,
@@ -27,7 +30,12 @@ from .types import (
     ThrottleSelector,
     ThrottleSelectorTerm,
     ThrottleSpec,
+    ThrottleStatus,
+    parse_rfc3339,
 )
+
+API_GROUP = "schedule.k8s.everpeace.github.com"
+API_VERSION = f"{API_GROUP}/v1alpha1"
 
 
 def resource_amount_from_dict(d: Optional[Mapping[str, Any]]) -> ResourceAmount:
@@ -76,6 +84,36 @@ def _overrides_from_list(items: Optional[List[Mapping[str, Any]]]):
     )
 
 
+def _throttled_flags_from_dict(d: Optional[Mapping[str, Any]]) -> IsResourceAmountThrottled:
+    if not d:
+        return IsResourceAmountThrottled()
+    counts = d.get("resourceCounts")
+    requests = d.get("resourceRequests")
+    return IsResourceAmountThrottled(
+        resource_counts_pod=bool(counts.get("pod", False)) if counts is not None else False,
+        resource_requests=(
+            {str(k): bool(v) for k, v in requests.items()} if requests is not None else None
+        ),
+    )
+
+
+def status_from_dict(d: Optional[Mapping[str, Any]]) -> ThrottleStatus:
+    """Parse the status subresource (throttle_types.go:113-117 shape)."""
+    if not d:
+        return ThrottleStatus()
+    ct = d.get("calculatedThreshold") or {}
+    calculated_at = ct.get("calculatedAt")
+    return ThrottleStatus(
+        calculated_threshold=CalculatedThreshold(
+            threshold=resource_amount_from_dict(ct.get("threshold")),
+            calculated_at=parse_rfc3339(calculated_at) if calculated_at else None,
+            messages=tuple(str(m) for m in ct.get("messages", []) or []),
+        ),
+        throttled=_throttled_flags_from_dict(d.get("throttled")),
+        used=resource_amount_from_dict(d.get("used")),
+    )
+
+
 def throttle_from_dict(d: Mapping[str, Any]) -> Throttle:
     meta = d.get("metadata", {})
     spec = d.get("spec", {})
@@ -96,6 +134,7 @@ def throttle_from_dict(d: Mapping[str, Any]) -> Throttle:
             ),
             selector=ThrottleSelector(selector_terms=terms),
         ),
+        status=status_from_dict(d.get("status")),
     )
 
 
@@ -121,6 +160,7 @@ def cluster_throttle_from_dict(d: Mapping[str, Any]) -> ClusterThrottle:
             ),
             selector=ClusterThrottleSelector(selector_terms=terms),
         ),
+        status=status_from_dict(d.get("status")),
     )
 
 
@@ -137,10 +177,12 @@ def pod_from_dict(d: Mapping[str, Any]) -> Pod:
         return out
 
     overhead = spec.get("overhead")
+    uid_kwargs = {"uid": str(meta["uid"])} if meta.get("uid") else {}
     return Pod(
         name=str(meta.get("name", "")),
         namespace=str(meta.get("namespace", "default") or "default"),
         labels={str(k): str(v) for k, v in (meta.get("labels") or {}).items()},
+        **uid_kwargs,
         spec=PodSpec(
             scheduler_name=str(spec.get("schedulerName", "")),
             node_name=str(spec.get("nodeName", "") or ""),
@@ -162,4 +204,209 @@ def object_from_dict(d: Mapping[str, Any]):
         return cluster_throttle_from_dict(d)
     if kind == "Pod":
         return pod_from_dict(d)
+    if kind == "Namespace":
+        return namespace_from_dict(d)
     raise ValueError(f"unsupported kind: {kind!r}")
+
+
+def namespace_from_dict(d: Mapping[str, Any]) -> Namespace:
+    meta = d.get("metadata", {})
+    kwargs = {"uid": str(meta["uid"])} if meta.get("uid") else {}
+    return Namespace(
+        name=str(meta.get("name", "")),
+        labels={str(k): str(v) for k, v in (meta.get("labels") or {}).items()},
+        **kwargs,
+    )
+
+
+def normalize_manifest(d: Any) -> Any:
+    """Recursively rewrite the reference API's typo spelling ``selecterTerms``
+    (throttle_selector.go:27 — an accepted input everywhere) to the canonical
+    ``selectorTerms``. Needed before a JSON merge patch: merging a typo-keyed
+    patch into a canonically-keyed document would otherwise leave BOTH keys,
+    and the reader's precedence would pick the stale canonical one."""
+    if isinstance(d, dict):
+        out = {}
+        for k, v in d.items():
+            key = "selectorTerms" if k == "selecterTerms" else k
+            out[key] = normalize_manifest(v)
+        return out
+    if isinstance(d, list):
+        return [normalize_manifest(v) for v in d]
+    return d
+
+
+# ---------------------------------------------------------------------------
+# typed objects → manifest dicts (the serializer half the generated clients'
+# Patch verb needs: round-trippable through *_from_dict above)
+# ---------------------------------------------------------------------------
+
+
+def label_selector_to_dict(sel: LabelSelector) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if sel.match_labels:
+        out["matchLabels"] = dict(sorted(sel.match_labels.items()))
+    if sel.match_expressions:
+        out["matchExpressions"] = [
+            {"key": e.key, "operator": e.operator, **({"values": list(e.values)} if e.values else {})}
+            for e in sel.match_expressions
+        ]
+    return out
+
+
+def _overrides_to_list(overrides) -> List[Dict[str, Any]]:
+    return [
+        {
+            **({"begin": o.begin} if o.begin else {}),
+            **({"end": o.end} if o.end else {}),
+            "threshold": o.threshold.to_dict(),
+        }
+        for o in overrides
+    ]
+
+
+def status_to_dict(status: ThrottleStatus) -> Dict[str, Any]:
+    ct = status.calculated_threshold
+    return {
+        "used": status.used.to_dict(),
+        "throttled": status.throttled.to_dict(),
+        "calculatedThreshold": {
+            "threshold": ct.threshold.to_dict(),
+            "calculatedAt": (
+                # full precision (isoformat keeps microseconds; parse_rfc3339
+                # accepts them) so to_dict/from_dict round-trips clock-stamped
+                # statuses exactly
+                ct.calculated_at.astimezone(timezone.utc).isoformat().replace("+00:00", "Z")
+                if ct.calculated_at
+                else None
+            ),
+            "messages": list(ct.messages),
+        },
+    }
+
+
+def throttle_to_dict(thr: Throttle) -> Dict[str, Any]:
+    return {
+        "apiVersion": API_VERSION,
+        "kind": "Throttle",
+        "metadata": {
+            "name": thr.name,
+            "namespace": thr.namespace,
+            **({"uid": thr.uid} if thr.uid else {}),
+        },
+        "spec": {
+            **({"throttlerName": thr.spec.throttler_name} if thr.spec.throttler_name else {}),
+            "threshold": thr.spec.threshold.to_dict(),
+            **(
+                {
+                    "temporaryThresholdOverrides": _overrides_to_list(
+                        thr.spec.temporary_threshold_overrides
+                    )
+                }
+                if thr.spec.temporary_threshold_overrides
+                else {}
+            ),
+            "selector": {
+                "selectorTerms": [
+                    {"podSelector": label_selector_to_dict(t.pod_selector)}
+                    for t in thr.spec.selector.selector_terms
+                ]
+            },
+        },
+        "status": status_to_dict(thr.status),
+    }
+
+
+def cluster_throttle_to_dict(thr: ClusterThrottle) -> Dict[str, Any]:
+    return {
+        "apiVersion": API_VERSION,
+        "kind": "ClusterThrottle",
+        "metadata": {"name": thr.name, **({"uid": thr.uid} if thr.uid else {})},
+        "spec": {
+            **({"throttlerName": thr.spec.throttler_name} if thr.spec.throttler_name else {}),
+            "threshold": thr.spec.threshold.to_dict(),
+            **(
+                {
+                    "temporaryThresholdOverrides": _overrides_to_list(
+                        thr.spec.temporary_threshold_overrides
+                    )
+                }
+                if thr.spec.temporary_threshold_overrides
+                else {}
+            ),
+            "selector": {
+                "selectorTerms": [
+                    {
+                        "podSelector": label_selector_to_dict(t.pod_selector),
+                        "namespaceSelector": label_selector_to_dict(t.namespace_selector),
+                    }
+                    for t in thr.spec.selector.selector_terms
+                ]
+            },
+        },
+        "status": status_to_dict(thr.status),
+    }
+
+
+def pod_to_dict(pod: Pod) -> Dict[str, Any]:
+    def containers(cs: List[Container]) -> List[Dict[str, Any]]:
+        return [
+            {
+                **({"name": c.name} if c.name else {}),
+                "resources": {
+                    "requests": {k: format_quantity(v) for k, v in sorted(c.requests.items())}
+                },
+            }
+            for c in cs
+        ]
+
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            **({"uid": pod.uid} if pod.uid else {}),
+            **({"labels": dict(sorted(pod.labels.items()))} if pod.labels else {}),
+        },
+        "spec": {
+            **({"schedulerName": pod.spec.scheduler_name} if pod.spec.scheduler_name else {}),
+            **({"nodeName": pod.spec.node_name} if pod.spec.node_name else {}),
+            "containers": containers(pod.spec.containers),
+            **(
+                {"initContainers": containers(pod.spec.init_containers)}
+                if pod.spec.init_containers
+                else {}
+            ),
+            **(
+                {"overhead": {k: format_quantity(v) for k, v in sorted(pod.spec.overhead.items())}}
+                if pod.spec.overhead
+                else {}
+            ),
+        },
+        "status": {"phase": pod.status.phase},
+    }
+
+
+def namespace_to_dict(ns: Namespace) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {
+            "name": ns.name,
+            **({"uid": ns.uid} if ns.uid else {}),
+            **({"labels": dict(sorted(ns.labels.items()))} if ns.labels else {}),
+        },
+    }
+
+
+def object_to_dict(obj) -> Dict[str, Any]:
+    if isinstance(obj, Throttle):
+        return throttle_to_dict(obj)
+    if isinstance(obj, ClusterThrottle):
+        return cluster_throttle_to_dict(obj)
+    if isinstance(obj, Pod):
+        return pod_to_dict(obj)
+    if isinstance(obj, Namespace):
+        return namespace_to_dict(obj)
+    raise ValueError(f"unsupported object: {type(obj).__name__}")

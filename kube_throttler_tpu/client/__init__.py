@@ -1,0 +1,46 @@
+"""Client layer — hand-written analog of the reference's generated API
+machinery (pkg/generated/, SURVEY.md §2.2): typed clientset with the full
+verb set, watch streams, shared informers with resync + indexers, and
+indexer-backed listers, plus a fake clientset for tests.
+"""
+
+from .clientset import (
+    Clientset,
+    ClusterThrottleInterface,
+    CoreV1Client,
+    NamespaceInterface,
+    PodInterface,
+    ScheduleV1alpha1Client,
+    ThrottleInterface,
+    json_merge_patch,
+    new_fake_clientset,
+)
+from .informers import NAMESPACE_INDEX, Indexer, SharedIndexInformer, SharedInformerFactory
+from .listers import (
+    ClusterThrottleLister,
+    NamespaceLister,
+    PodLister,
+    ThrottleLister,
+)
+from .watch import Watch
+
+__all__ = [
+    "Clientset",
+    "ClusterThrottleInterface",
+    "ClusterThrottleLister",
+    "CoreV1Client",
+    "Indexer",
+    "NAMESPACE_INDEX",
+    "NamespaceInterface",
+    "NamespaceLister",
+    "PodInterface",
+    "PodLister",
+    "ScheduleV1alpha1Client",
+    "SharedIndexInformer",
+    "SharedInformerFactory",
+    "ThrottleInterface",
+    "ThrottleLister",
+    "Watch",
+    "json_merge_patch",
+    "new_fake_clientset",
+]

@@ -55,12 +55,14 @@ class Event:
 Handler = Callable[[Event], None]
 
 
-def _key_of(kind: str, obj: KObject) -> str:
-    if kind == "Pod":
-        return f"{obj.namespace}/{obj.name}"
-    if kind == "Throttle":
+def key_of(kind: str, obj: KObject) -> str:
+    """Canonical store/informer cache key for an object of ``kind``."""
+    if kind in ("Pod", "Throttle"):
         return f"{obj.namespace}/{obj.name}"
     return obj.name  # Namespace, ClusterThrottle (cluster-scoped)
+
+
+_key_of = key_of
 
 
 class Store:
@@ -83,9 +85,20 @@ class Store:
         plugin.go:114-130)."""
         with self._lock:
             self._handlers[kind].append(handler)
-            existing = list(self._objects[kind].values()) if replay else []
-        for obj in existing:
-            handler(Event(EventType.ADDED, kind, obj))
+            # replay INSIDE the lock (normal dispatch already runs under it):
+            # otherwise a concurrent DELETED could reach the handler before
+            # the stale replay ADDED, resurrecting a deleted object
+            if replay:
+                for obj in self._objects[kind].values():
+                    handler(Event(EventType.ADDED, kind, obj))
+
+    def remove_event_handler(self, kind: str, handler: Handler) -> None:
+        """Unregister a handler (watch-stream stop)."""
+        with self._lock:
+            try:
+                self._handlers[kind].remove(handler)
+            except ValueError:
+                pass
 
     def _dispatch(self, event: Event) -> None:
         for handler in list(self._handlers[event.kind]):
@@ -212,6 +225,27 @@ class Store:
 
     def list_cluster_throttles(self) -> List[ClusterThrottle]:
         return self._list("ClusterThrottle")
+
+    # -- main-resource update with status-subresource semantics ------------
+
+    def update_throttle_spec(self, thr: Throttle) -> Throttle:
+        """Replace the object but keep the STORED status (the apiserver
+        ignores status changes on main-resource writes when the status
+        subresource is enabled — throttle_types.go:158 marker). Atomic: the
+        status merge happens under the store lock so a concurrent
+        ``update_throttle_status`` can never be reverted by a stale read."""
+        with self._lock:
+            current = self._objects["Throttle"].get(thr.key)
+            if current is None:
+                raise NotFoundError(f"Throttle {thr.key!r} not found")
+            return self._update("Throttle", thr.with_status(current.status))
+
+    def update_cluster_throttle_spec(self, thr: ClusterThrottle) -> ClusterThrottle:
+        with self._lock:
+            current = self._objects["ClusterThrottle"].get(thr.name)
+            if current is None:
+                raise NotFoundError(f"ClusterThrottle {thr.name!r} not found")
+            return self._update("ClusterThrottle", thr.with_status(current.status))
 
     # -- status subresource (optimistic concurrency) ----------------------
 
