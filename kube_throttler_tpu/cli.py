@@ -59,6 +59,15 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--port", type=int, default=10259)
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
+    serve.add_argument(
+        "--nodes",
+        type=int,
+        default=0,
+        help="run the embedded scheduler loop binding pods onto N simulated "
+        "nodes (the reference binary embeds kube-scheduler; 0 = admission "
+        "daemon only, an external scheduler calls /v1/prefilter)",
+    )
+    serve.add_argument("--node-max-pods", type=int, default=300)
 
     sub.add_parser("version", help="print version")
 
@@ -93,12 +102,24 @@ def main(argv: Optional[list] = None) -> int:
         use_device=not args.no_device,
         start_workers=True,
     )
+    scheduler = None
+    if args.nodes > 0:
+        from .scheduler import Node, Scheduler
+
+        scheduler = Scheduler(
+            plugin,
+            store,
+            nodes=[Node(f"node-{i+1}", max_pods=args.node_max_pods) for i in range(args.nodes)],
+        )
+        scheduler.start()
+
     server = ThrottlerHTTPServer(plugin, host=args.host, port=args.port)
     server.start()
     print(
         f"kube-throttler-tpu serving on {args.host}:{server.port} "
         f"(throttler={plugin_args.name}, scheduler={plugin_args.target_scheduler_name}, "
-        f"device={'on' if not args.no_device else 'off'})",
+        f"device={'on' if not args.no_device else 'off'}, "
+        f"embedded-scheduler={'%d nodes' % args.nodes if args.nodes else 'off'})",
         flush=True,
     )
 
@@ -107,6 +128,8 @@ def main(argv: Optional[list] = None) -> int:
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     stop.wait()
     server.stop()
+    if scheduler is not None:
+        scheduler.stop()
     plugin.stop()
     return 0
 

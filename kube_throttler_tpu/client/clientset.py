@@ -30,7 +30,7 @@ from ..api.serialization import (
     throttle_to_dict,
 )
 from ..api.types import ClusterThrottle, Throttle
-from ..engine.store import Store
+from ..engine.store import NotFoundError, Store
 from .watch import Watch
 
 
@@ -82,7 +82,10 @@ class ThrottleInterface:
         deleted = []
         for thr in self.list():
             if predicate is None or predicate(thr):
-                deleted.append(self._store.delete_throttle(self._namespace, thr.name))
+                try:
+                    deleted.append(self._store.delete_throttle(self._namespace, thr.name))
+                except NotFoundError:
+                    pass  # raced with a concurrent delete
         return deleted
 
     def get(self, name: str) -> Throttle:
@@ -98,9 +101,15 @@ class ThrottleInterface:
         )
 
     def patch(self, name: str, patch: Dict[str, Any]) -> Throttle:
-        current = self.get(name)
-        merged = json_merge_patch(throttle_to_dict(current), normalize_manifest(patch))
-        return self._store.update_throttle_spec(self._scoped(throttle_from_dict(merged)))
+        normalized = normalize_manifest(patch)
+
+        def apply(current: Throttle) -> Throttle:
+            merged = json_merge_patch(throttle_to_dict(current), normalized)
+            return self._scoped(throttle_from_dict(merged))
+
+        # atomic get→merge→update under the store lock (MergePatchType is
+        # atomic on a real apiserver; see Store.mutate)
+        return self._store.mutate("Throttle", f"{self._namespace}/{name}", apply)
 
 
 class ClusterThrottleInterface:
@@ -129,7 +138,10 @@ class ClusterThrottleInterface:
         deleted = []
         for thr in self.list():
             if predicate is None or predicate(thr):
-                deleted.append(self._store.delete_cluster_throttle(thr.name))
+                try:
+                    deleted.append(self._store.delete_cluster_throttle(thr.name))
+                except NotFoundError:
+                    pass  # raced with a concurrent delete
         return deleted
 
     def get(self, name: str) -> ClusterThrottle:
@@ -142,9 +154,13 @@ class ClusterThrottleInterface:
         return Watch(self._store, "ClusterThrottle", replay=replay)
 
     def patch(self, name: str, patch: Dict[str, Any]) -> ClusterThrottle:
-        current = self.get(name)
-        merged = json_merge_patch(cluster_throttle_to_dict(current), normalize_manifest(patch))
-        return self._store.update_cluster_throttle_spec(cluster_throttle_from_dict(merged))
+        normalized = normalize_manifest(patch)
+
+        def apply(current: ClusterThrottle) -> ClusterThrottle:
+            merged = json_merge_patch(cluster_throttle_to_dict(current), normalized)
+            return cluster_throttle_from_dict(merged)
+
+        return self._store.mutate("ClusterThrottle", name, apply)
 
 
 class PodInterface:
@@ -172,9 +188,11 @@ class PodInterface:
         return Watch(self._store, "Pod", filter=lambda e: e.obj.namespace == ns, replay=replay)
 
     def patch(self, name: str, patch: Dict[str, Any]) -> Pod:
-        current = self.get(name)
-        merged = json_merge_patch(pod_to_dict(current), patch)
-        return self._store.update_pod(pod_from_dict(merged))
+        def apply(current: Pod) -> Pod:
+            merged = json_merge_patch(pod_to_dict(current), patch)
+            return pod_from_dict(merged)
+
+        return self._store.mutate("Pod", f"{self._namespace}/{name}", apply)
 
 
 class NamespaceInterface:
@@ -197,11 +215,11 @@ class NamespaceInterface:
         return Watch(self._store, "Namespace", replay=replay)
 
     def patch(self, name: str, patch: Dict[str, Any]) -> Namespace:
-        current = self._store.get_namespace(name)
-        if current is None:
-            raise KeyError(f"Namespace {name!r} not found")
-        merged = json_merge_patch(namespace_to_dict(current), patch)
-        return self._store.update_namespace(namespace_from_dict(merged))
+        def apply(current: Namespace) -> Namespace:
+            merged = json_merge_patch(namespace_to_dict(current), patch)
+            return namespace_from_dict(merged)
+
+        return self._store.mutate("Namespace", name, apply)
 
 
 class ScheduleV1alpha1Client:

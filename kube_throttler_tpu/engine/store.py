@@ -226,6 +226,24 @@ class Store:
     def list_cluster_throttles(self) -> List[ClusterThrottle]:
         return self._list("ClusterThrottle")
 
+    # -- atomic read-modify-write (Patch verbs) ----------------------------
+
+    def mutate(self, kind: str, key: str, fn: Callable[[KObject], KObject]) -> KObject:
+        """Apply ``fn(current) -> updated`` atomically under the store lock —
+        the server-side-apply analog a JSON merge patch needs: without it,
+        two concurrent get→merge→update round trips silently lose one
+        write. For Throttle/ClusterThrottle the stored status is preserved
+        (status-subresource semantics). ``fn`` must be pure and fast; it
+        runs under the store lock."""
+        with self._lock:
+            current = self._objects[kind].get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            updated = fn(current)
+            if kind in ("Throttle", "ClusterThrottle"):
+                updated = updated.with_status(current.status)
+            return self._update(kind, updated)
+
     # -- main-resource update with status-subresource semantics ------------
 
     def update_throttle_spec(self, thr: Throttle) -> Throttle:

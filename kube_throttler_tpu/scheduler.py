@@ -1,0 +1,305 @@
+"""Embedded scheduler loop — the analog of the reference's in-binary
+kube-scheduler (cmd/kube_scheduler.go:90-106 registers the plugin into the
+upstream scheduler app; integration tests then run `scheduler.Setup +
+go scheduler.Run` in-process, integration_suite_test.go:87-138).
+
+The framework is standalone, so this module supplies the scheduling loop
+the plugin plugs into:
+
+- a pending queue (active / backoff / unschedulable, mirroring the
+  scheduler's three-queue structure);
+- ``schedule_one``: pop → PreFilter → pick node → Reserve → bind
+  (write ``spec.nodeName`` back through the store) → Unreserve on failure;
+- event-driven requeue per the plugin's ``EventsToRegister`` hints
+  (plugin.go:263-279): Throttle/ClusterThrottle/Pod/Node changes move
+  unschedulable pods back to the active queue, subject to per-pod
+  exponential backoff (the reference integration suite pokes a Node to
+  force exactly this wakeup, util_pod_test.go:206-225);
+- ``FailedScheduling`` Warning events with the plugin's reason string, the
+  same observable the reference's tests assert on (util_pod_test.go:156-180).
+
+Binding sets only ``spec.nodeName`` (phase stays Pending) — that is the
+reference's ``shouldCountIn`` trigger (scheduled ∧ not finished,
+pod_util.go:300-306), so a bound pod immediately counts into
+``status.used`` at the next reconcile and its reservation is released by
+the unreserve-on-observe handshake.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+from .api.pod import Pod
+from .engine.store import Event, EventType, Store
+from .plugin.plugin import KubeThrottler
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Node:
+    """Minimal node model: bind capacity only (the integration fixture is
+    one node with max-pods 300 — hack/integration/kind.conf)."""
+
+    name: str
+    max_pods: int = 300
+
+
+@dataclass
+class _QueuedPod:
+    key: str
+    attempts: int = 0
+    not_before: float = 0.0  # monotonic gate for backoff
+
+
+class Scheduler:
+    """Single-threaded scheduling loop over the store's pending pods.
+
+    Synchronous driving (tests/examples): ``run_until_idle()``.
+    Daemon mode: ``start()`` spawns the loop thread; ``stop()`` joins it.
+    """
+
+    FAILED_SCHEDULING = "FailedScheduling"
+
+    def __init__(
+        self,
+        plugin: KubeThrottler,
+        store: Store,
+        nodes: Optional[List[Node]] = None,
+        backoff_base: float = 0.05,
+        backoff_max: float = 5.0,
+    ) -> None:
+        self.plugin = plugin
+        self.store = store
+        self.nodes = list(nodes) if nodes else [Node("node-1")]
+        self._bound_per_node: Dict[str, int] = {n.name: 0 for n in self.nodes}
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._active: List[_QueuedPod] = []
+        self._unschedulable: Dict[str, _QueuedPod] = {}
+        self._queued_keys: set = set()
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        target = plugin.args.target_scheduler_name
+        self._target = target
+
+        # node occupancy is driven ENTIRELY by pod events (replay covers
+        # pre-existing pods): a pod occupies a node slot while bound and not
+        # finished; deletes and terminal phases free the slot. schedule_one
+        # does NOT increment directly — its bind write's MODIFIED event does,
+        # synchronously on the same thread, so there is no double count.
+        store.add_event_handler("Pod", self._on_pod_event, replay=True)
+        # EventsToRegister: throttle/clusterthrottle/namespace changes retry
+        # unschedulable pods (plugin.go:263-279; Node changes would too, but
+        # nodes live outside the store — poke_nodes() covers that hint)
+        for kind in ("Throttle", "ClusterThrottle", "Namespace"):
+            store.add_event_handler(kind, self._on_cluster_event, replay=False)
+
+    # -- queue management --------------------------------------------------
+
+    def _is_schedulable_target(self, pod: Pod) -> bool:
+        return (
+            pod.spec.scheduler_name == self._target
+            and not pod.is_scheduled()
+            and pod.is_not_finished()
+        )
+
+    def _occupies_node(self, pod: Optional[Pod]) -> Optional[str]:
+        """Node name this pod holds a slot on, or None."""
+        if pod is None or not pod.is_scheduled() or not pod.is_not_finished():
+            return None
+        return pod.spec.node_name if pod.spec.node_name in self._bound_per_node else None
+
+    def _on_pod_event(self, event: Event) -> None:
+        pod = event.obj
+        if event.type == EventType.DELETED:
+            with self._cv:
+                freed = self._occupies_node(pod)
+                if freed is not None:
+                    self._bound_per_node[freed] -= 1
+                self._queued_keys.discard(pod.key)
+                self._unschedulable.pop(pod.key, None)
+                self._active = [q for q in self._active if q.key != pod.key]
+            return
+        if event.type == EventType.ADDED:
+            with self._cv:
+                held = self._occupies_node(pod)
+                if held is not None:
+                    self._bound_per_node[held] += 1
+                elif self._is_schedulable_target(pod) and pod.key not in self._queued_keys:
+                    self._queued_keys.add(pod.key)
+                    self._active.append(_QueuedPod(pod.key))
+                    self._cv.notify_all()
+            return
+        # MODIFIED: adjust occupancy for bind/unbind/termination transitions,
+        # then treat the change as a requeue hint for unschedulable pods
+        with self._cv:
+            before = self._occupies_node(event.old_obj)
+            after = self._occupies_node(pod)
+            if before != after:
+                if before is not None:
+                    self._bound_per_node[before] -= 1
+                if after is not None:
+                    self._bound_per_node[after] += 1
+        self._wake_unschedulable()
+
+    def _on_cluster_event(self, event: Event) -> None:
+        self._wake_unschedulable()
+
+    def _wake_unschedulable(self) -> None:
+        with self._cv:
+            if not self._unschedulable:
+                return
+            for q in self._unschedulable.values():
+                self._active.append(q)
+            self._unschedulable.clear()
+            self._cv.notify_all()
+
+    def poke_nodes(self) -> None:
+        """The Node-change requeue hint (the reference tests' WakeupBackoffPod
+        node-poke, util_pod_test.go:206-225)."""
+        self._wake_unschedulable()
+
+    def _backoff_for(self, attempts: int) -> float:
+        return min(self._backoff_base * (2 ** max(attempts - 1, 0)), self._backoff_max)
+
+    # -- the scheduling cycle ---------------------------------------------
+
+    def _pick_node(self) -> Optional[Node]:
+        with self._cv:
+            for node in self.nodes:
+                if self._bound_per_node[node.name] < node.max_pods:
+                    return node
+        return None
+
+    def schedule_one(self, now: Optional[float] = None) -> Optional[str]:
+        """Run one scheduling cycle. Returns the bound pod's key, or None if
+        nothing was schedulable (queue empty or all gated by backoff)."""
+        now = time.monotonic() if now is None else now
+        with self._cv:
+            idx = next(
+                (i for i, q in enumerate(self._active) if q.not_before <= now), None
+            )
+            if idx is None:
+                return None
+            queued = self._active.pop(idx)
+        try:
+            pod = self.store.get_pod(*queued.key.split("/", 1))
+        except KeyError:
+            with self._cv:
+                self._queued_keys.discard(queued.key)
+            return None
+        if not self._is_schedulable_target(pod):
+            with self._cv:
+                self._queued_keys.discard(queued.key)
+            return None
+
+        queued.attempts += 1
+        status = self.plugin.pre_filter(pod)
+        if not status.is_success():
+            self._record_failed_scheduling(pod, status.message())
+            self._park(queued, now)
+            return None
+
+        node = self._pick_node()
+        if node is None:
+            self._record_failed_scheduling(pod, "0/%d nodes are available" % len(self.nodes))
+            self._park(queued, now)
+            return None
+
+        reserve_status = self.plugin.reserve(pod, node.name)
+        if not reserve_status.is_success():
+            self.plugin.unreserve(pod, node.name)
+            self._park(queued, now)
+            return None
+
+        try:
+            bound = replace(pod, spec=replace(pod.spec, node_name=node.name))
+            # occupancy increments via this write's own MODIFIED event
+            self.store.update_pod(bound)
+        except Exception:
+            logger.exception("bind failed for %s", pod.key)
+            self.plugin.unreserve(pod, node.name)
+            self._park(queued, now)
+            return None
+
+        with self._cv:
+            self._queued_keys.discard(queued.key)
+        logger.debug("scheduled %s -> %s", pod.key, node.name)
+        return pod.key
+
+    def _park(self, queued: _QueuedPod, now: float) -> None:
+        # a sync drain passes now=inf to bypass backoff gates; anchor the
+        # backoff to the real clock so the pod isn't gated forever once a
+        # real-time loop takes over
+        base = now if math.isfinite(now) else time.monotonic()
+        queued.not_before = base + self._backoff_for(queued.attempts)
+        with self._cv:
+            self._unschedulable[queued.key] = queued
+
+    def _record_failed_scheduling(self, pod: Pod, message: str) -> None:
+        if self.plugin.event_recorder is not None:
+            self.plugin.event_recorder.eventf(
+                pod.key, "Warning", self.FAILED_SCHEDULING, "Scheduling", message
+            )
+
+    # -- driving -----------------------------------------------------------
+
+    def run_until_idle(self, max_cycles: int = 10_000, settle: bool = True) -> int:
+        """Synchronously drain the queue: reconcile controllers and schedule
+        until neither makes progress. Backoff gates are ignored (tests drive
+        wall-clock-free). Returns the number of pods bound."""
+        bound = 0
+        for _ in range(max_cycles):
+            progressed = False
+            if self.plugin.run_pending_once():
+                progressed = True
+            # far-future "now" neutralizes backoff gating for sync draining
+            key = self.schedule_one(now=float("inf")) if settle else self.schedule_one()
+            if key is not None:
+                bound += 1
+                progressed = True
+            if not progressed:
+                with self._cv:
+                    if not self._active:
+                        break
+                    # only backoff-parked actives remain and settle is off
+                    if not settle:
+                        break
+        return bound
+
+    def pending_count(self) -> int:
+        with self._cv:
+            return len(self._active) + len(self._unschedulable)
+
+    def start(self, poll_interval: float = 0.01) -> None:
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+
+        def loop() -> None:
+            while not self._stop_event.is_set():
+                key = self.schedule_one()
+                if key is None:
+                    with self._cv:
+                        self._cv.wait(timeout=poll_interval)
+
+        self._thread = threading.Thread(target=loop, name="scheduler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
