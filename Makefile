@@ -1,0 +1,36 @@
+# Dev workflow targets (reference Makefile parity, minus Go/kind).
+PY ?= python
+
+.PHONY: test test-stress lint gen bench bench-quick walkthrough smoke serve clean
+
+test:            ## unit + kernel + integration tiers (8-device virtual CPU mesh)
+	$(PY) -m pytest tests/ -q
+
+test-stress:     ## only the stress/concurrency tier
+	$(PY) -m pytest tests/test_stress.py -q
+
+lint:            ## syntax + import sanity over the package
+	$(PY) -m compileall -q kube_throttler_tpu tools bench.py __graft_entry__.py
+	$(PY) -c "import kube_throttler_tpu"
+
+gen:             ## regenerate deploy/crd.yaml from the typed API model
+	$(PY) tools/gen_crd.py
+
+bench:           ## the five BASELINE.json configs (one JSON line on stdout)
+	$(PY) bench.py
+
+bench-quick:
+	$(PY) bench.py --quick
+
+walkthrough:     ## reference README walkthrough end-to-end
+	$(PY) examples/walkthrough.py
+
+smoke:           ## TPU kernel compatibility smoke on real hardware
+	$(PY) tools/tpu_smoke.py
+
+serve:           ## run the daemon against the sample config
+	$(PY) -m kube_throttler_tpu.cli serve --name kube-throttler \
+		--target-scheduler-name my-scheduler --port 10259
+
+clean:
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -10,7 +10,7 @@ threshold``.
 
 from __future__ import annotations
 
-from datetime import timezone
+from datetime import datetime, timezone
 from typing import Any, Dict, List, Mapping, Optional
 
 from ..quantity import format_quantity, parse_quantity
@@ -73,11 +73,22 @@ def label_selector_from_dict(d: Optional[Mapping[str, Any]]) -> LabelSelector:
     )
 
 
+def _boundary_str(v: Any) -> str:
+    # YAML auto-parses unquoted RFC3339 timestamps into datetime objects;
+    # str() would yield "2024-01-01 00:00:00+09:00" (space, not RFC3339),
+    # so format explicitly.
+    if isinstance(v, datetime):
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=timezone.utc)
+        return v.isoformat().replace("+00:00", "Z")
+    return str(v or "")
+
+
 def _overrides_from_list(items: Optional[List[Mapping[str, Any]]]):
     return tuple(
         TemporaryThresholdOverride(
-            begin=str(o.get("begin", "") or ""),
-            end=str(o.get("end", "") or ""),
+            begin=_boundary_str(o.get("begin", "")),
+            end=_boundary_str(o.get("end", "")),
             threshold=resource_amount_from_dict(o.get("threshold")),
         )
         for o in (items or [])
@@ -224,7 +235,13 @@ def normalize_manifest(d: Any) -> Any:
     (throttle_selector.go:27 — an accepted input everywhere) to the canonical
     ``selectorTerms``. Needed before a JSON merge patch: merging a typo-keyed
     patch into a canonically-keyed document would otherwise leave BOTH keys,
-    and the reader's precedence would pick the stale canonical one."""
+    and the reader's precedence would pick the stale canonical one.
+
+    Also renders YAML's auto-parsed timestamps back to RFC3339 strings —
+    the wire format is JSON, where they are strings (kubectl does the same
+    YAML→JSON conversion before sending)."""
+    if isinstance(d, datetime):
+        return _boundary_str(d)
     if isinstance(d, dict):
         out = {}
         for k, v in d.items():

@@ -1,0 +1,31 @@
+"""Emit deploy/crd.yaml from the typed API model (``make gen``).
+
+The TPU build's equivalent of the reference's controller-gen step
+(Makefile:40-42): schemas are derived in kube_throttler_tpu/api/crd.py from
+the dataclasses in api/types.py, so the CRD can never drift from the code.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import yaml
+
+from kube_throttler_tpu.api import crd
+
+
+def main() -> int:
+    out = Path(__file__).resolve().parent.parent / "deploy" / "crd.yaml"
+    docs = [crd.cluster_throttle_crd(), crd.throttle_crd()]
+    text = "---\n" + "---\n".join(
+        yaml.safe_dump(d, sort_keys=True, default_flow_style=False) for d in docs
+    )
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(text)
+    print(f"wrote {out} ({len(text.splitlines())} lines, {len(docs)} documents)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
