@@ -19,11 +19,13 @@ existing manifests apply unchanged.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional
 
-GROUP = "schedule.k8s.everpeace.github.com"
+from .serialization import API_GROUP as GROUP
+from .serialization import API_VERSION
+
 VERSION = "v1alpha1"
-API_VERSION = f"{GROUP}/{VERSION}"
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +267,8 @@ def _validate(value: Any, schema: Dict[str, Any], path: str, errors: List[Schema
     if schema.get("x-kubernetes-int-or-string") or "anyOf" in schema:
         if not isinstance(value, (int, str)) or isinstance(value, bool):
             errors.append(SchemaError(path, f"expected integer or string, got {type(value).__name__}"))
+        elif isinstance(value, str) and "pattern" in schema and not re.fullmatch(schema["pattern"], value):
+            errors.append(SchemaError(path, f"{value!r} does not match pattern {schema['pattern']!r}"))
         return
     t = schema.get("type")
     if t == "object":

@@ -10,7 +10,7 @@ threshold``.
 
 from __future__ import annotations
 
-from datetime import datetime, timezone
+from datetime import date, datetime, timezone
 from typing import Any, Dict, List, Mapping, Optional
 
 from ..quantity import format_quantity, parse_quantity
@@ -74,13 +74,15 @@ def label_selector_from_dict(d: Optional[Mapping[str, Any]]) -> LabelSelector:
 
 
 def _boundary_str(v: Any) -> str:
-    # YAML auto-parses unquoted RFC3339 timestamps into datetime objects;
-    # str() would yield "2024-01-01 00:00:00+09:00" (space, not RFC3339),
-    # so format explicitly.
+    # YAML auto-parses unquoted RFC3339 timestamps into datetime objects
+    # (and date-only values into datetime.date); str() would yield
+    # "2024-01-01 00:00:00+09:00" (space, not RFC3339), so format explicitly.
     if isinstance(v, datetime):
         if v.tzinfo is None:
             v = v.replace(tzinfo=timezone.utc)
         return v.isoformat().replace("+00:00", "Z")
+    if isinstance(v, date):
+        return v.isoformat()
     return str(v or "")
 
 
@@ -237,10 +239,10 @@ def normalize_manifest(d: Any) -> Any:
     patch into a canonically-keyed document would otherwise leave BOTH keys,
     and the reader's precedence would pick the stale canonical one.
 
-    Also renders YAML's auto-parsed timestamps back to RFC3339 strings —
-    the wire format is JSON, where they are strings (kubectl does the same
-    YAML→JSON conversion before sending)."""
-    if isinstance(d, datetime):
+    Also renders YAML's auto-parsed timestamps (datetime and date-only)
+    back to RFC3339 strings — the wire format is JSON, where they are
+    strings (kubectl does the same YAML→JSON conversion before sending)."""
+    if isinstance(d, (datetime, date)):
         return _boundary_str(d)
     if isinstance(d, dict):
         out = {}

@@ -249,21 +249,13 @@ class Store:
     def update_throttle_spec(self, thr: Throttle) -> Throttle:
         """Replace the object but keep the STORED status (the apiserver
         ignores status changes on main-resource writes when the status
-        subresource is enabled — throttle_types.go:158 marker). Atomic: the
-        status merge happens under the store lock so a concurrent
-        ``update_throttle_status`` can never be reverted by a stale read."""
-        with self._lock:
-            current = self._objects["Throttle"].get(thr.key)
-            if current is None:
-                raise NotFoundError(f"Throttle {thr.key!r} not found")
-            return self._update("Throttle", thr.with_status(current.status))
+        subresource is enabled — throttle_types.go:158 marker). Atomic via
+        :meth:`mutate`, so a concurrent ``update_throttle_status`` can never
+        be reverted by a stale read."""
+        return self.mutate("Throttle", thr.key, lambda _cur: thr)
 
     def update_cluster_throttle_spec(self, thr: ClusterThrottle) -> ClusterThrottle:
-        with self._lock:
-            current = self._objects["ClusterThrottle"].get(thr.name)
-            if current is None:
-                raise NotFoundError(f"ClusterThrottle {thr.name!r} not found")
-            return self._update("ClusterThrottle", thr.with_status(current.status))
+        return self.mutate("ClusterThrottle", thr.name, lambda _cur: thr)
 
     # -- status subresource (optimistic concurrency) ----------------------
 

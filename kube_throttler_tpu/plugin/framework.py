@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 class StatusCode(Enum):
@@ -59,15 +59,30 @@ class EventRecorder:
 class RecordingEventRecorder(EventRecorder):
     """Stores emitted events (the integration tier asserts on them the way
     the reference asserts on FailedScheduling / ResourceRequestsExceeds…
-    events — util_pod_test.go:68-92)."""
+    events — util_pod_test.go:68-92).
 
-    def __init__(self) -> None:
+    Identical events aggregate into one entry with a count (like the real
+    kube event recorder's correlator) and distinct entries are capped at
+    ``max_events`` with oldest-first eviction — a daemon retrying one stuck
+    pod every flush interval must not grow memory without bound."""
+
+    def __init__(self, max_events: int = 10_000) -> None:
         self._lock = threading.Lock()
+        self._max_events = max_events
         self.events: List[PodEvent] = []
+        self.counts: Dict[PodEvent, int] = {}
 
     def eventf(self, pod_key: str, event_type: str, reason: str, action: str, note: str) -> None:
+        ev = PodEvent(pod_key, event_type, reason, action, note)
         with self._lock:
-            self.events.append(PodEvent(pod_key, event_type, reason, action, note))
+            if ev in self.counts:
+                self.counts[ev] += 1
+                return
+            self.counts[ev] = 1
+            self.events.append(ev)
+            if len(self.events) > self._max_events:
+                evicted = self.events.pop(0)
+                self.counts.pop(evicted, None)
 
     def events_for(self, pod_key: str) -> List[PodEvent]:
         with self._lock:

@@ -86,6 +86,10 @@ class Scheduler:
         self._active: List[_QueuedPod] = []
         self._unschedulable: Dict[str, _QueuedPod] = {}
         self._queued_keys: set = set()
+        # bumped on every requeue hint; a cycle that started before the bump
+        # re-queues to active instead of parking (closes the window where a
+        # wake lands while its pod is popped but not yet parked)
+        self._wake_gen = 0
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -129,6 +133,9 @@ class Scheduler:
                 self._queued_keys.discard(pod.key)
                 self._unschedulable.pop(pod.key, None)
                 self._active = [q for q in self._active if q.key != pod.key]
+            # a delete frees node capacity and throttle usage — it is a
+            # requeue hint like any other Pod event (EventsToRegister)
+            self._wake_unschedulable()
             return
         if event.type == EventType.ADDED:
             with self._cv:
@@ -157,6 +164,9 @@ class Scheduler:
 
     def _wake_unschedulable(self) -> None:
         with self._cv:
+            # bump even when nothing is parked: a mid-cycle pod checks this
+            # generation before parking itself
+            self._wake_gen += 1
             if not self._unschedulable:
                 return
             for q in self._unschedulable.values():
@@ -192,6 +202,7 @@ class Scheduler:
             if idx is None:
                 return None
             queued = self._active.pop(idx)
+            gen = self._wake_gen
         try:
             pod = self.store.get_pod(*queued.key.split("/", 1))
         except KeyError:
@@ -207,29 +218,35 @@ class Scheduler:
         status = self.plugin.pre_filter(pod)
         if not status.is_success():
             self._record_failed_scheduling(pod, status.message())
-            self._park(queued, now)
+            self._park(queued, now, gen)
             return None
 
         node = self._pick_node()
         if node is None:
             self._record_failed_scheduling(pod, "0/%d nodes are available" % len(self.nodes))
-            self._park(queued, now)
+            self._park(queued, now, gen)
             return None
 
         reserve_status = self.plugin.reserve(pod, node.name)
         if not reserve_status.is_success():
             self.plugin.unreserve(pod, node.name)
-            self._park(queued, now)
+            self._park(queued, now, gen)
             return None
 
         try:
-            bound = replace(pod, spec=replace(pod.spec, node_name=node.name))
-            # occupancy increments via this write's own MODIFIED event
-            self.store.update_pod(bound)
+            # atomic bind: set ONLY spec.nodeName on the store's current
+            # object (the bind-subresource analog) — a whole-object write of
+            # the pod read at cycle start would revert any patch that landed
+            # mid-cycle. Occupancy increments via the write's MODIFIED event.
+            self.store.mutate(
+                "Pod",
+                pod.key,
+                lambda cur: replace(cur, spec=replace(cur.spec, node_name=node.name)),
+            )
         except Exception:
             logger.exception("bind failed for %s", pod.key)
             self.plugin.unreserve(pod, node.name)
-            self._park(queued, now)
+            self._park(queued, now, gen)
             return None
 
         with self._cv:
@@ -237,13 +254,19 @@ class Scheduler:
         logger.debug("scheduled %s -> %s", pod.key, node.name)
         return pod.key
 
-    def _park(self, queued: _QueuedPod, now: float) -> None:
+    def _park(self, queued: _QueuedPod, now: float, gen: Optional[int] = None) -> None:
         # a sync drain passes now=inf to bypass backoff gates; anchor the
         # backoff to the real clock so the pod isn't gated forever once a
         # real-time loop takes over
         base = now if math.isfinite(now) else time.monotonic()
         queued.not_before = base + self._backoff_for(queued.attempts)
         with self._cv:
+            if gen is not None and gen != self._wake_gen:
+                # a requeue hint fired while this pod was mid-cycle; parking
+                # now would miss it — keep the pod active (backoff-gated)
+                self._active.append(queued)
+                self._cv.notify_all()
+                return
             self._unschedulable[queued.key] = queued
 
     def _record_failed_scheduling(self, pod: Pod, message: str) -> None:
@@ -281,17 +304,25 @@ class Scheduler:
         with self._cv:
             return len(self._active) + len(self._unschedulable)
 
-    def start(self, poll_interval: float = 0.01) -> None:
+    def start(self, poll_interval: float = 0.01, flush_interval: float = 5.0) -> None:
+        """``flush_interval``: unschedulable pods are periodically re-queued
+        even without a triggering event (kube-scheduler's
+        flushUnschedulablePodsLeftover analog) — the safety net under the
+        event-driven wakeups; backoff gates still apply after a flush."""
         if self._thread is not None:
             return
         self._stop_event.clear()
 
         def loop() -> None:
+            last_flush = time.monotonic()
             while not self._stop_event.is_set():
                 key = self.schedule_one()
                 if key is None:
                     with self._cv:
                         self._cv.wait(timeout=poll_interval)
+                if time.monotonic() - last_flush >= flush_interval:
+                    last_flush = time.monotonic()
+                    self._wake_unschedulable()
 
         self._thread = threading.Thread(target=loop, name="scheduler", daemon=True)
         self._thread.start()

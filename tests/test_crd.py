@@ -28,15 +28,19 @@ def test_generated_crd_file_in_sync():
     assert docs == [crd.cluster_throttle_crd(), crd.throttle_crd()]
 
 
-def test_gen_tool_runs():
+def test_gen_tool_runs(tmp_path):
+    # write to a temp path: regenerating deploy/crd.yaml in place would
+    # silently repair the drift test_generated_crd_file_in_sync exists to catch
+    dest = tmp_path / "crd.yaml"
     out = subprocess.run(
-        [sys.executable, str(REPO / "tools" / "gen_crd.py")],
+        [sys.executable, str(REPO / "tools" / "gen_crd.py"), "--out", str(dest)],
         capture_output=True,
         text=True,
         cwd=REPO,
     )
     assert out.returncode == 0, out.stderr
     assert "2 documents" in out.stdout
+    assert dest.read_text() == (REPO / "deploy" / "crd.yaml").read_text()
 
 
 def test_crd_names_and_scope():
@@ -138,3 +142,38 @@ def test_deploy_manifests_are_well_formed_yaml():
     decoded = decode_plugin_args(args)
     assert decoded.name == "kube-throttler"
     assert decoded.target_scheduler_name == "my-scheduler"
+
+
+def test_quantity_pattern_rejects_garbage_strings():
+    bad = {
+        "kind": "Throttle",
+        "spec": {"threshold": {"resourceRequests": {"cpu": "lots"}}},
+    }
+    errs = crd.validate(bad)
+    assert any("pattern" in str(e) for e in errs)
+    # suffixed forms still pass
+    ok = {
+        "kind": "Throttle",
+        "spec": {"threshold": {"resourceRequests": {"cpu": "1500m", "memory": "2Gi", "x": "1e3"}}},
+    }
+    assert crd.validate(ok) == []
+
+
+def test_date_only_override_boundary_normalizes():
+    import datetime as dt
+
+    raw = {
+        "kind": "Throttle",
+        "metadata": {"name": "d"},
+        "spec": {
+            "throttlerName": "t",
+            "temporaryThresholdOverrides": [
+                {"begin": dt.date(2024, 1, 1), "end": dt.date(2024, 1, 7), "threshold": {}}
+            ],
+        },
+    }
+    norm = serialization.normalize_manifest(raw)
+    assert norm["spec"]["temporaryThresholdOverrides"][0]["begin"] == "2024-01-01"
+    assert crd.validate(norm) == []
+    obj = serialization.object_from_dict(norm)
+    assert obj.spec.temporary_threshold_overrides[0].begin == "2024-01-01"

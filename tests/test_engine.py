@@ -338,3 +338,17 @@ class TestDeviceMirrorRegressions:
         # once the namespace exists, the match appears
         store.create_namespace(Namespace("ghost"))
         assert "/c1" in mgr.check_pod(pod, "clusterthrottle")
+
+
+def test_recording_event_recorder_aggregates_and_caps():
+    from kube_throttler_tpu.plugin.framework import RecordingEventRecorder
+
+    r = RecordingEventRecorder(max_events=3)
+    for _ in range(100):
+        r.eventf("ns/p", "Warning", "FailedScheduling", "Scheduling", "same msg")
+    assert len(r.events) == 1
+    assert r.counts[r.events[0]] == 100
+    for i in range(5):
+        r.eventf("ns/p", "Warning", "FailedScheduling", "Scheduling", f"msg-{i}")
+    assert len(r.events) == 3  # capped, oldest evicted
+    assert len(r.counts) == 3

@@ -259,3 +259,70 @@ class TestBackgroundLoop:
         finally:
             sched.stop()
             plugin.stop()
+
+
+class TestRequeueRaces:
+    """Regression tests for the three scheduler findings: delete-wake,
+    mid-cycle lost wakeup, and non-atomic bind."""
+
+    def test_pod_delete_frees_slot_and_wakes_parked_pods(self):
+        # Node(max_pods=1), NO throttles: p1 binds, p2 parks on "0/1 nodes
+        # available". Deleting p1 must requeue p2 without any throttle event.
+        store, plugin, sched, _ = _setup(nodes=[Node("n1", max_pods=1)])
+        store.create_pod(make_pod("p1", requests={"cpu": "1m"}))
+        store.create_pod(make_pod("p2", requests={"cpu": "1m"}))
+        assert sched.run_until_idle() == 1
+        assert len(sched._unschedulable) == 1
+        store.delete_pod("default", "p1")
+        # the DELETED handler freed the slot and moved p2 back to active
+        assert not sched._unschedulable
+        assert sched.run_until_idle() == 1
+        assert store.get_pod("default", "p2").is_scheduled()
+
+    def test_wake_during_cycle_keeps_pod_active(self):
+        # A requeue hint that fires while the pod is popped (pre-park) must
+        # not be lost: the pod re-enters _active instead of _unschedulable.
+        store, plugin, sched, _ = _setup()
+        store.create_throttle(_throttle("t1", cpu="100m"))
+        store.create_pod(make_pod("p1", labels={"throttle": "t1"}, requests={"cpu": "500m"}))
+        plugin.run_pending_once()
+
+        orig = plugin.pre_filter
+
+        def pre_filter_with_midcycle_event(pod):
+            status = orig(pod)
+            # a threshold edit lands while this cycle is in flight
+            thr = store.get_throttle("default", "t1")
+            store.update_throttle_spec(
+                replace(thr, spec=replace(thr.spec, threshold=ResourceAmount.of(requests={"cpu": "1"})))
+            )
+            return status
+
+        plugin.pre_filter = pre_filter_with_midcycle_event
+        assert sched.schedule_one(now=float("inf")) is None  # blocked by stale state
+        plugin.pre_filter = orig
+        # the mid-cycle wake kept p1 in the active queue
+        assert not sched._unschedulable and len(sched._active) == 1
+        assert sched.run_until_idle() == 1
+
+    def test_bind_preserves_concurrent_pod_patch(self):
+        # A label patch landing between the cycle's read and its bind write
+        # must survive the bind (bind sets only spec.nodeName).
+        store, plugin, sched, _ = _setup()
+        store.create_pod(make_pod("p1", requests={"cpu": "1m"}))
+
+        orig = plugin.pre_filter
+
+        def pre_filter_with_patch(pod):
+            status = orig(pod)
+            store.mutate(
+                "Pod", pod.key,
+                lambda cur: replace(cur, labels={**cur.labels, "patched": "yes"}),
+            )
+            return status
+
+        plugin.pre_filter = pre_filter_with_patch
+        assert sched.run_until_idle() == 1
+        final = store.get_pod("default", "p1")
+        assert final.is_scheduled()
+        assert final.labels.get("patched") == "yes"

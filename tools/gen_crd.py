@@ -15,8 +15,15 @@ import yaml
 from kube_throttler_tpu.api import crd
 
 
-def main() -> int:
-    out = Path(__file__).resolve().parent.parent / "deploy" / "crd.yaml"
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--out",
+        default=str(Path(__file__).resolve().parent.parent / "deploy" / "crd.yaml"),
+    )
+    out = Path(parser.parse_args(argv).out)
     docs = [crd.cluster_throttle_crd(), crd.throttle_crd()]
     text = "---\n" + "---\n".join(
         yaml.safe_dump(d, sort_keys=True, default_flow_style=False) for d in docs
