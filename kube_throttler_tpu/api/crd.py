@@ -23,9 +23,7 @@ import re
 from typing import Any, Dict, List, Optional
 
 from .serialization import API_GROUP as GROUP
-from .serialization import API_VERSION
-
-VERSION = "v1alpha1"
+from .serialization import API_VERSION, VERSION
 
 
 # ---------------------------------------------------------------------------

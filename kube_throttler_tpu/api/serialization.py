@@ -35,7 +35,8 @@ from .types import (
 )
 
 API_GROUP = "schedule.k8s.everpeace.github.com"
-API_VERSION = f"{API_GROUP}/v1alpha1"
+VERSION = "v1alpha1"
+API_VERSION = f"{API_GROUP}/{VERSION}"
 
 
 def resource_amount_from_dict(d: Optional[Mapping[str, Any]]) -> ResourceAmount:

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional
 from . import __version__
 from .api.pod import Namespace
 from .engine.store import Store
+from .utils import tracing
 from .plugin import KubeThrottler, decode_plugin_args
 from .plugin.framework import RecordingEventRecorder
 from .server import ThrottlerHTTPServer
@@ -68,6 +69,10 @@ def main(argv: Optional[list] = None) -> int:
         "daemon only, an external scheduler calls /v1/prefilter)",
     )
     serve.add_argument("--node-max-pods", type=int, default=300)
+    serve.add_argument(
+        "--v", type=int, default=0, dest="verbosity",
+        help="klog-style verbosity (0-5); change at runtime via PUT /debug/flags/v",
+    )
 
     sub.add_parser("version", help="print version")
 
@@ -76,6 +81,15 @@ def main(argv: Optional[list] = None) -> int:
     if args.command == "version":
         print(f"kube-throttler-tpu version {__version__}")
         return 0
+
+    # klog-equivalent logging: INFO to stderr, V-levels gate detail lines
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
+    )
+    tracing.set_verbosity(args.verbosity)
 
     config: Dict[str, Any] = {}
     if args.config:

@@ -13,6 +13,7 @@ from datetime import timedelta
 from typing import Callable, List, Optional
 
 from ..engine.workqueue import RateLimitingQueue, ShutDown
+from ..utils.tracing import NoopTracer, vlog
 from ..utils.clock import Clock, RealClock
 
 logger = logging.getLogger(__name__)
@@ -36,6 +37,9 @@ class ControllerBase:
         self.threadiness = threadiness
         self.workqueue = RateLimitingQueue(name, clock=self.clock)
         self.reconcile_func: Callable[[str], None] = lambda key: None
+        # phase tracer (utils.tracing.PhaseTracer); set by the plugin so
+        # reconcile latency lands in the same histogram family as the hot path
+        self.tracer = NoopTracer()
         self._threads: List[threading.Thread] = []
         self._started = False
 
@@ -71,7 +75,9 @@ class ControllerBase:
             except ShutDown:
                 return
             try:
-                self.reconcile_func(key)
+                vlog(4, "%s: reconciling %r", self.name, key)
+                with self.tracer.trace("reconcile"):
+                    self.reconcile_func(key)
             except Exception:
                 # error → rate-limited requeue (controller.go:106-108)
                 self.workqueue.add_rate_limited(key)
@@ -89,7 +95,8 @@ class ControllerBase:
         while len(self.workqueue) > 0 and n < max_items:
             key = self.workqueue.get(timeout=0.01)
             try:
-                self.reconcile_func(key)
+                with self.tracer.trace("reconcile"):
+                    self.reconcile_func(key)
             except Exception:
                 self.workqueue.add_rate_limited(key)
                 logger.exception("error reconciling %r, requeuing", key)

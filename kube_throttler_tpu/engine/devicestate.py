@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.tracing import NoopTracer
 from ..api.pod import Pod
 from ..api.types import ClusterThrottle, ResourceAmount, Throttle
 from ..quantity import to_milli
@@ -293,6 +294,7 @@ class DeviceStateManager:
         self.target_scheduler_name = target_scheduler_name
         self.dims = dims or DimRegistry()
         self._lock = threading.RLock()
+        self.tracer = NoopTracer()  # set by the plugin; times device checks
         self.throttle = _KindState("throttle", self.dims)
         self.clusterthrottle = _KindState("clusterthrottle", self.dims)
 
@@ -350,7 +352,7 @@ class DeviceStateManager:
     def check_pod(self, pod: Pod, kind: str, on_equal: bool = False) -> Dict[str, str]:
         """Single-pod check → {throttle_key: status_name} over affected
         throttles. The device kernel sees a 1-row pod batch + its mask row."""
-        with self._lock:
+        with self.tracer.trace("device_check"), self._lock:
             ks = self.throttle if kind == "throttle" else self.clusterthrottle
             ks.ensure_capacity()
             row_req = np.zeros((1, ks.R), dtype=np.int64)

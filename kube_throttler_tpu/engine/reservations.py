@@ -15,6 +15,8 @@ Unreserve) — the reserve-until-observed handshake (SURVEY §3.3).
 from __future__ import annotations
 
 import threading
+
+from ..utils.tracing import vlog
 from typing import Dict, Iterable, Optional, Set, Tuple
 
 from ..api.pod import Pod
@@ -36,6 +38,7 @@ class ReservedResourceAmounts:
             return self._cache.setdefault(throttle_key, {})
 
     def add_pod(self, throttle_key: str, pod: Pod) -> bool:
+        vlog(5, "reservation add: pod=%s throttle=%s", pod.key, throttle_key)
         """Overwrite-insert; True if the pod was newly reserved."""
         with self._key_lock(throttle_key):
             m = self._pod_map(throttle_key)
@@ -44,6 +47,7 @@ class ReservedResourceAmounts:
             return not existed
 
     def remove_pod(self, throttle_key: str, pod: Pod) -> bool:
+        vlog(5, "reservation remove: pod=%s throttle=%s", pod.key, throttle_key)
         return self.remove_pod_key(throttle_key, pod.key)
 
     def remove_pod_key(self, throttle_key: str, pod_key: str) -> bool:

@@ -53,10 +53,63 @@ class GaugeVec:
             return dict(self._values)
 
 
+class HistogramVec:
+    """Prometheus histogram family: cumulative buckets + _sum/_count per
+    label set. Backs the per-phase latency tracing (SURVEY §5's TPU-native
+    tracing equivalent — the reference has only klog levels)."""
+
+    # le boundaries tuned for scheduling-phase latencies: 10µs .. 10s
+    DEFAULT_BUCKETS = (
+        1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+        1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        label_names: Sequence[str],
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(buckets) if buckets is not None else self.DEFAULT_BUCKETS
+        self._lock = threading.Lock()
+        # key -> (bucket counts, sum, count)
+        self._series: Dict[Tuple[str, ...], list] = {}
+
+    def observe(self, labels: Dict[str, str], value: float) -> None:
+        key = tuple(labels[n] for n in self.label_names)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * len(self.buckets), 0.0, 0]
+                self._series[key] = s
+            counts, _, _ = s
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    counts[i] += 1
+            s[1] += value
+            s[2] += 1
+
+    def snapshot(self, labels: Dict[str, str]) -> Optional[Tuple[float, int]]:
+        """(sum, count) for one label set, or None."""
+        key = tuple(labels[n] for n in self.label_names)
+        with self._lock:
+            s = self._series.get(key)
+            return (s[1], s[2]) if s else None
+
+    def collect(self) -> Dict[Tuple[str, ...], tuple]:
+        with self._lock:
+            return {k: (list(s[0]), s[1], s[2]) for k, s in self._series.items()}
+
+
 class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._gauges: Dict[str, GaugeVec] = {}
+        self._histograms: Dict[str, HistogramVec] = {}
 
     def gauge_vec(self, name: str, help_text: str, label_names: Sequence[str]) -> GaugeVec:
         with self._lock:
@@ -66,6 +119,20 @@ class Registry:
             self._gauges[name] = g
             return g
 
+    def histogram_vec(
+        self,
+        name: str,
+        help_text: str,
+        label_names: Sequence[str],
+        buckets: Optional[Sequence[float]] = None,
+    ) -> HistogramVec:
+        with self._lock:
+            if name in self._histograms:
+                return self._histograms[name]
+            h = HistogramVec(name, help_text, label_names, buckets)
+            self._histograms[name] = h
+            return h
+
     def exposition(self) -> str:
         """Prometheus text format."""
 
@@ -73,9 +140,13 @@ class Registry:
             # label-value escaping per the exposition format: \ " and newline
             return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
+        def fmt(value: float) -> str:
+            return str(int(value)) if value == int(value) else str(value)
+
         lines = []
         with self._lock:
             gauges = list(self._gauges.values())
+            histograms = list(self._histograms.values())
         for g in gauges:
             lines.append(f"# HELP {g.name} {g.help}")
             lines.append(f"# TYPE {g.name} gauge")
@@ -83,10 +154,21 @@ class Registry:
                 labels = ",".join(
                     f'{n}="{esc(v)}"' for n, v in zip(g.label_names, key)
                 )
-                if value == int(value):
-                    lines.append(f"{g.name}{{{labels}}} {int(value)}")
-                else:
-                    lines.append(f"{g.name}{{{labels}}} {value}")
+                lines.append(f"{g.name}{{{labels}}} {fmt(value)}")
+        for h in histograms:
+            lines.append(f"# HELP {h.name} {h.help}")
+            lines.append(f"# TYPE {h.name} histogram")
+            for key, (counts, total, count) in sorted(h.collect().items()):
+                base = [f'{n}="{esc(v)}"' for n, v in zip(h.label_names, key)]
+                for le, c in zip(h.buckets, counts):
+                    labels = ",".join(base + [f'le="{le}"'])
+                    lines.append(f"{h.name}_bucket{{{labels}}} {c}")
+                labels = ",".join(base + ['le="+Inf"'])
+                lines.append(f"{h.name}_bucket{{{labels}}} {count}")
+                sep = ",".join(base)
+                brace = f"{{{sep}}}" if sep else ""
+                lines.append(f"{h.name}_sum{brace} {total}")
+                lines.append(f"{h.name}_count{brace} {count}")
         return "\n".join(lines) + "\n"
 
 

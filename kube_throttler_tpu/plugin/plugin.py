@@ -17,6 +17,7 @@ from ..controllers import ClusterThrottleController, ThrottleController
 from ..engine.devicestate import DeviceStateManager
 from ..engine.store import Store
 from ..metrics import ClusterThrottleMetricsRecorder, Registry, ThrottleMetricsRecorder
+from ..utils.tracing import PhaseTracer, vlog
 from ..utils.clock import Clock, RealClock
 from .args import KubeThrottlerPluginArgs
 from .framework import ClusterEvent, EventRecorder, Status, StatusCode
@@ -47,6 +48,7 @@ class KubeThrottler:
         self.store = store
         self.event_recorder = event_recorder
         self.metrics_registry = metrics_registry or Registry()
+        self.tracer = PhaseTracer(self.metrics_registry)
         self.device_manager = (
             DeviceStateManager(store, args.name, args.target_scheduler_name)
             if use_device
@@ -72,6 +74,10 @@ class KubeThrottler:
             device_manager=self.device_manager,
             metrics_recorder=ClusterThrottleMetricsRecorder(self.metrics_registry),
         )
+        if self.device_manager is not None:
+            self.device_manager.tracer = self.tracer
+        self.throttle_ctr.tracer = self.tracer
+        self.cluster_throttle_ctr.tracer = self.tracer
         if start_workers:
             self.throttle_ctr.start()
             self.cluster_throttle_ctr.start()
@@ -83,6 +89,10 @@ class KubeThrottler:
     # -------------------------------------------------------------- prefilter
 
     def pre_filter(self, pod: Pod) -> Status:
+        with self.tracer.trace("prefilter"):
+            return self._pre_filter(pod)
+
+    def _pre_filter(self, pod: Pod) -> Status:
         try:
             thr_active, thr_insufficient, thr_exceeds, thr_affected = (
                 self.throttle_ctr.check_throttled(pod, False)
@@ -102,6 +112,7 @@ class KubeThrottler:
             + len(clthr_active) + len(clthr_insufficient) + len(clthr_exceeds)
             == 0
         ):
+            vlog(5, "pod %s is not throttled by any throttle/clusterthrottle", pod.key)
             return Status(StatusCode.SUCCESS)
 
         # reason ordering mirrors plugin.go:182-214 exactly
@@ -135,11 +146,17 @@ class KubeThrottler:
             )
         if thr_insufficient:
             reasons.append(f"throttle[insufficient]={','.join(throttle_names(thr_insufficient))}")
+        # plugin.go:157-style V(2) visibility into every rejection
+        vlog(2, "pod %s is unschedulable: %s", pod.key, "; ".join(reasons))
         return Status(StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE, tuple(reasons))
 
     # ---------------------------------------------------------------- reserve
 
     def reserve(self, pod: Pod, node: str = "") -> Status:
+        with self.tracer.trace("reserve"):
+            return self._reserve(pod, node)
+
+    def _reserve(self, pod: Pod, node: str = "") -> Status:
         errs: List[str] = []
         try:
             self.throttle_ctr.reserve(pod)
@@ -154,6 +171,10 @@ class KubeThrottler:
         return Status(StatusCode.SUCCESS)
 
     def unreserve(self, pod: Pod, node: str = "") -> None:
+        with self.tracer.trace("unreserve"):
+            self._unreserve(pod, node)
+
+    def _unreserve(self, pod: Pod, node: str = "") -> None:
         try:
             self.throttle_ctr.unreserve(pod)
         except Exception:

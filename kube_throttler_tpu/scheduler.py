@@ -35,6 +35,7 @@ from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 from .api.pod import Pod
+from .utils.tracing import vlog
 from .engine.store import Event, EventType, Store
 from .plugin.plugin import KubeThrottler
 
@@ -251,7 +252,7 @@ class Scheduler:
 
         with self._cv:
             self._queued_keys.discard(queued.key)
-        logger.debug("scheduled %s -> %s", pod.key, node.name)
+        vlog(3, "scheduled %s -> %s", pod.key, node.name)
         return pod.key
 
     def _park(self, queued: _QueuedPod, now: float, gen: Optional[int] = None) -> None:

@@ -31,6 +31,7 @@ from typing import Optional, Tuple
 from .api.pod import Namespace
 from .api.serialization import object_from_dict
 from .api.types import ClusterThrottle, Throttle
+from .utils import tracing
 from .engine.store import NotFoundError, Store
 from .plugin import KubeThrottler
 
@@ -113,11 +114,30 @@ class ThrottlerHTTPServer:
                 except Exception as e:
                     self._send(400, {"error": str(e)})
 
+            def do_PUT(self):
+                try:
+                    outer._put(self)
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- handlers
+
+    def _put(self, h) -> None:
+        # dynamic verbosity — the scheduler's PUT /debug/flags/v analog
+        # (reference Makefile:94-95: log-level / log-level-debug targets)
+        if h.path == "/debug/flags/v":
+            length = int(h.headers.get("Content-Length", "0"))
+            raw = h.rfile.read(length).decode().strip() if length else ""
+            level = int(raw)
+            prev = tracing.set_verbosity(level)
+            h._send(200, f"successfully set klog.logging.verbosity to {level} (was {prev})",
+                    content_type="text/plain")
+        else:
+            h._send(404, {"error": f"unknown path {h.path}"})
 
     def _get(self, h) -> None:
         if h.path == "/healthz":
