@@ -1,7 +1,11 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress lint gen bench bench-quick walkthrough smoke serve clean
+.PHONY: test test-stress lint gen bench bench-quick walkthrough smoke serve clean native
+
+native:          ## build the C++ selector row-match engine (auto-built on import too)
+	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
+		sys.exit(0 if load() is not None else 1)"
 
 test:            ## unit + kernel + integration tiers (8-device virtual CPU mesh)
 	$(PY) -m pytest tests/ -q

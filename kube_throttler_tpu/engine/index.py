@@ -38,6 +38,7 @@ from ..api.types import (
     SelectorError,
     Throttle,
 )
+from ..native import NativeRowEngine
 
 AnyThrottle = Union[Throttle, ClusterThrottle]
 
@@ -75,13 +76,28 @@ def _simple_terms(thr: AnyThrottle) -> Optional[List[Tuple[Dict[str, str], Dict[
 class SelectorIndex:
     """One index instance per kind (mirroring the two controllers)."""
 
-    def __init__(self, kind: str, pod_capacity: int = 64, throttle_capacity: int = 16):
+    def __init__(
+        self,
+        kind: str,
+        pod_capacity: int = 64,
+        throttle_capacity: int = 16,
+        use_native: bool = True,
+    ):
         assert kind in ("throttle", "clusterthrottle")
         self.kind = kind
         self._lock = threading.RLock()
 
         self._values = _Interner()
         self._ns_ids = _Interner()
+        self._key_ids = _Interner()
+
+        # native C++ row-match tier (native/ktnative.cpp); None → pure Python
+        self._native: Optional[NativeRowEngine] = None
+        if use_native:
+            try:
+                self._native = NativeRowEngine(kind)
+            except RuntimeError:
+                pass
 
         # pods
         self._pod_rows: Dict[str, int] = {}
@@ -104,6 +120,8 @@ class SelectorIndex:
 
         # namespaces (labels, for clusterthrottle ns selectors)
         self._namespaces: Dict[str, Namespace] = {}
+        # interned {key_id: value_id} per namespace, for the native row path
+        self._ns_label_ids: Dict[str, Dict[int, int]] = {}
 
         self.mask = np.zeros((self._pcap, self._tcap), dtype=bool)
 
@@ -200,6 +218,8 @@ class SelectorIndex:
                 self._thr_cols[key] = col
             self._col_thrs[col] = thr
             self._thr_valid[col] = True
+            if self._native is not None:
+                self._native_sync_col(col, thr)
             self._recompute_col(col)
             return col
 
@@ -212,6 +232,8 @@ class SelectorIndex:
         grown_mask[:, : self._tcap] = self.mask
         self.mask = grown_mask
         self._tcap = new_cap
+        if self._native is not None:
+            self._native.reserve(new_cap)
 
     def remove_throttle(self, throttle_key: str) -> None:
         with self._lock:
@@ -222,6 +244,8 @@ class SelectorIndex:
             self._thr_valid[col] = False
             self.mask[:, col] = False
             self._free_cols.append(col)
+            if self._native is not None:
+                self._native.clear_col(col)
 
     # ------------------------------------------------------------ namespaces
 
@@ -230,6 +254,7 @@ class SelectorIndex:
         recompute their rows (cluster selectors may flip)."""
         with self._lock:
             self._namespaces[ns.name] = ns
+            self._ns_label_ids.pop(ns.name, None)
             if self.kind != "clusterthrottle":
                 return
             ns_id = self._ns_ids.id_of(ns.name)
@@ -279,8 +304,44 @@ class SelectorIndex:
             match &= self._pod_ns == self._ns_ids.id_of(thr.namespace)
         self.mask[:, col] = match
 
+    def _native_sync_col(self, col: int, thr: AnyThrottle) -> None:
+        """Compile a throttle's selector into the native engine's column."""
+        assert self._native is not None
+        thr_ns = self._ns_ids.id_of(thr.namespace) if isinstance(thr, Throttle) else -1
+        simple = _simple_terms(thr)
+        if simple is None:
+            self._native.set_col_general(col, thr_ns)
+            return
+        terms = []
+        for pod_pairs, ns_pairs in simple:
+            pr = [(self._key_ids.id_of(k), self._values.id_of(v)) for k, v in pod_pairs.items()]
+            nr = [(self._key_ids.id_of(k), self._values.id_of(v)) for k, v in ns_pairs.items()]
+            terms.append((pr, nr))
+        self._native.set_col(col, thr_ns, terms)
+
     def _recompute_row(self, row: int) -> None:
         pod = self._row_pods[row]
+        if self._native is not None:
+            ns = self._namespaces.get(pod.namespace)
+            pod_labels = {
+                self._key_ids.id_of(k): self._values.id_of(v) for k, v in pod.labels.items()
+            }
+            ns_labels = self._ns_label_ids.get(pod.namespace)
+            if ns_labels is None:
+                ns_labels = {
+                    self._key_ids.id_of(k): self._values.id_of(v)
+                    for k, v in (ns.labels if ns else {}).items()
+                }
+                self._ns_label_ids[pod.namespace] = ns_labels
+            match, general = self._native.match_row(
+                self._ns_ids.id_of(pod.namespace), ns is not None, pod_labels, ns_labels
+            )
+            out = np.zeros(self._tcap, dtype=bool)
+            out[: len(match)] = match.astype(bool)
+            for col in np.nonzero(general)[0]:
+                out[col] = self._eval_general(self._col_thrs[int(col)], pod)
+            self.mask[row, :] = out
+            return
         out = np.zeros(self._tcap, dtype=bool)
         for key, col in self._thr_cols.items():
             out[col] = self._match_one(self._col_thrs[col], pod)

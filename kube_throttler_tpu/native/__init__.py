@@ -1,0 +1,220 @@
+"""ctypes bindings for the native selector row-match engine.
+
+The shared library is built from ``native/ktnative.cpp`` (``make native``).
+If it is absent, the loader builds it on first import with ``g++`` — a
+single-file, sub-second compile — and falls back to pure Python when no
+toolchain is available, so the package never hard-depends on the binary.
+
+Set ``KT_TPU_NO_NATIVE=1`` to force the Python tier (used by parity tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_PKG_DIR = Path(__file__).resolve().parent
+_REPO_ROOT = _PKG_DIR.parent.parent
+_SRC = _REPO_ROOT / "native" / "ktnative.cpp"
+_SO = _PKG_DIR / "_ktnative.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_load_lock = threading.Lock()
+_load_attempted = False
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+logger = logging.getLogger(__name__)
+
+CXX_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
+
+
+def _build() -> bool:
+    """Compile to a temp file and atomically rename, so concurrent importers
+    never dlopen a partially written library."""
+    if not _SRC.exists():
+        return False
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_PKG_DIR))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", *CXX_FLAGS, str(_SRC), "-o", tmp],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.warning(
+            "native selector engine build failed (%s); falling back to the "
+            "pure-Python row-match tier",
+            exc,
+        )
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.ktn_create.argtypes = [ctypes.c_int32]
+    lib.ktn_create.restype = ctypes.c_void_p
+    lib.ktn_destroy.argtypes = [ctypes.c_void_p]
+    lib.ktn_destroy.restype = None
+    lib.ktn_reserve.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ktn_reserve.restype = None
+    lib.ktn_set_col.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
+    ]
+    lib.ktn_set_col.restype = None
+    lib.ktn_set_col_general.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    lib.ktn_set_col_general.restype = None
+    lib.ktn_clear_col.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ktn_clear_col.restype = None
+    lib.ktn_num_cols.argtypes = [ctypes.c_void_p]
+    lib.ktn_num_cols.restype = ctypes.c_int32
+    lib.ktn_match_row.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        _i32p, _i32p, ctypes.c_int32,
+        _i32p, _i32p, ctypes.c_int32,
+        _u8p, _u8p,
+    ]
+    lib.ktn_match_row.restype = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _load_attempted
+    if os.environ.get("KT_TPU_NO_NATIVE") == "1":
+        return None
+    with _load_lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        if not _SO.exists() or (
+            _SRC.exists() and _SO.stat().st_mtime < _SRC.stat().st_mtime
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+            _declare(lib)
+            _lib = lib
+        except OSError as exc:
+            logger.warning(
+                "native selector engine load failed (%s); falling back to the "
+                "pure-Python row-match tier",
+                exc,
+            )
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _as_i32(seq: Sequence[int]) -> np.ndarray:
+    return np.asarray(seq, dtype=np.int32)
+
+
+def _ptr(arr: np.ndarray) -> _i32p:
+    return arr.ctypes.data_as(_i32p)
+
+
+class NativeRowEngine:
+    """One engine per SelectorIndex — wraps the C row-match kernel.
+
+    All interning happens in the caller; this class only marshals int32
+    arrays across the ctypes boundary.  Thread safety is the caller's
+    (SelectorIndex holds its RLock around every call).
+    """
+
+    def __init__(self, kind: str):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.ktn_create(1 if kind == "clusterthrottle" else 0))
+
+    def __del__(self) -> None:  # pragma: no cover - interpreter teardown
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self._lib.ktn_destroy(h)
+            except Exception:
+                pass
+            self._h = None
+
+    def reserve(self, tcap: int) -> None:
+        self._lib.ktn_reserve(self._h, tcap)
+
+    def set_col(
+        self,
+        col: int,
+        thr_ns: int,
+        terms: Sequence[Tuple[Sequence[Tuple[int, int]], Sequence[Tuple[int, int]]]],
+    ) -> None:
+        """terms: [(pod_reqs, ns_reqs)] with reqs as (key_id, value_id)."""
+        pod_off = [0]
+        ns_off = [0]
+        pod_keys: List[int] = []
+        pod_vals: List[int] = []
+        ns_keys: List[int] = []
+        ns_vals: List[int] = []
+        for pod_reqs, ns_reqs in terms:
+            for k, v in pod_reqs:
+                pod_keys.append(k)
+                pod_vals.append(v)
+            for k, v in ns_reqs:
+                ns_keys.append(k)
+                ns_vals.append(v)
+            pod_off.append(len(pod_keys))
+            ns_off.append(len(ns_keys))
+        self._lib.ktn_set_col(
+            self._h, col, thr_ns, len(terms),
+            _ptr(_as_i32(pod_off)), _ptr(_as_i32(pod_keys)), _ptr(_as_i32(pod_vals)),
+            _ptr(_as_i32(ns_off)), _ptr(_as_i32(ns_keys)), _ptr(_as_i32(ns_vals)),
+        )
+
+    def set_col_general(self, col: int, thr_ns: int) -> None:
+        self._lib.ktn_set_col_general(self._h, col, thr_ns)
+
+    def clear_col(self, col: int) -> None:
+        self._lib.ktn_clear_col(self._h, col)
+
+    def match_row(
+        self,
+        pod_ns: int,
+        ns_exists: bool,
+        pod_labels: Dict[int, int],
+        ns_labels: Dict[int, int],
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (match, needs_general) as uint8 arrays of length num_cols."""
+        n_cols = self._lib.ktn_num_cols(self._h)
+        out = np.zeros(n_cols, dtype=np.uint8)
+        general = np.zeros(n_cols, dtype=np.uint8)
+        pk = _as_i32(list(pod_labels.keys()))
+        pv = _as_i32(list(pod_labels.values()))
+        nk = _as_i32(list(ns_labels.keys()))
+        nv = _as_i32(list(ns_labels.values()))
+        self._lib.ktn_match_row(
+            self._h, pod_ns, 1 if ns_exists else 0,
+            _ptr(pk), _ptr(pv), len(pk),
+            _ptr(nk), _ptr(nv), len(nk),
+            out.ctypes.data_as(_u8p), general.ctypes.data_as(_u8p),
+        )
+        return out, general
