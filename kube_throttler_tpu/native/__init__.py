@@ -44,15 +44,17 @@ def _build() -> bool:
     never dlopen a partially written library."""
     if not _SRC.exists():
         return False
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_PKG_DIR))
-    os.close(fd)
+    tmp = None
     try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_PKG_DIR))
+        os.close(fd)
         subprocess.run(
             ["g++", *CXX_FLAGS, str(_SRC), "-o", tmp],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.chmod(tmp, 0o644)  # mkstemp's 0600 would break shared installs
         os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError) as exc:
@@ -61,10 +63,11 @@ def _build() -> bool:
             "pure-Python row-match tier",
             exc,
         )
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 
