@@ -237,6 +237,96 @@ def bench_pallas_sweep(rng, P, T, R, label):
     return per_iter
 
 
+def bench_single_pod_indexed(rng, state, T, R, label, K=64):
+    """The real PreFilter hot path: gather the pod's K affected-throttle rows
+    (host index supplies them) and classify O(K*R) — T-independent."""
+    from kube_throttler_tpu.ops.fastcheck import (
+        fast_check_pod_packed,
+        pack_check_state,
+        precompute_check_state,
+    )
+
+    pre = pack_check_state(precompute_check_state(state))
+    jax.block_until_ready(pre.vals)
+
+    pod_req = np.zeros(R, dtype=np.int64)
+    pod_present = np.zeros(R, dtype=bool)
+    pod_req[0] = 300
+    pod_present[0] = True
+    idx = np.zeros(K, dtype=np.int32)
+    valid = np.zeros(K, dtype=bool)
+    idx[:3] = rng.integers(0, T, 3)
+    valid[:3] = True
+    device = jax.devices()[0]
+    pod_req, pod_present, idx, valid = (
+        jax.device_put(a, device) for a in (pod_req, pod_present, idx, valid)
+    )
+
+    def make(n):
+        @jax.jit
+        def run(pre, pod_req, pod_present, idx, valid):
+            def body(i, acc):
+                st = fast_check_pod_packed.__wrapped__(
+                    pre, pod_req + acc % 2 + i, pod_present, idx, valid, False, True
+                )
+                return acc + jnp.sum(st == 1, dtype=jnp.int64)
+
+            return lax.fori_loop(0, n, body, jnp.int64(0))
+
+        return lambda: run(pre, pod_req, pod_present, idx, valid)
+
+    per_check = device_time_per_iter(make, n1=10, n2=500)
+    log(
+        f"[{label}] indexed single-pod check (K={K} gathered of T={T}): "
+        f"{per_check*1e6:.2f}us device time per decision"
+    )
+    return per_check * 1e3
+
+
+def bench_streaming_batched(rng, T, R, label, n_events=1000):
+    """Event-burst ingest: all n_events in ONE scatter dispatch."""
+    from kube_throttler_tpu.ops.aggregate import apply_pod_deltas_batched
+
+    used_cnt = np.asarray(rng.integers(0, 50, T), dtype=np.int64)
+    used_req = np.asarray(rng.integers(0, 64, (T, R)), dtype=np.int64) * 1000
+    contrib = np.asarray(rng.integers(0, 10, (T, R)), dtype=np.int32)
+    K = 4
+    ids = rng.integers(0, T, (n_events, K)).astype(np.int32)
+    signs = rng.choice(np.array([-1, 1], dtype=np.int64), (n_events, K))
+    pod_req = np.zeros((n_events, R), dtype=np.int64)
+    pod_req[:, 0] = 100
+    pod_present = np.zeros((n_events, R), dtype=bool)
+    pod_present[:, 0] = True
+    device = jax.devices()[0]
+    args = [
+        jax.device_put(a, device)
+        for a in (used_cnt, used_req, contrib, ids, signs, pod_req, pod_present)
+    ]
+
+    def make(n):
+        @jax.jit
+        def run(used_cnt, used_req, contrib, ids, signs, pod_req, pod_present):
+            def body(i, carry):
+                uc, ur, co = carry
+                uc, ur, co = apply_pod_deltas_batched.__wrapped__(
+                    uc + i % 2, ur, co, ids, signs, pod_req, pod_present
+                )
+                return (uc, ur, co)
+
+            uc, ur, co = lax.fori_loop(0, n, body, (used_cnt, used_req, contrib))
+            return uc[0] + ur[0, 0] + co[0, 0]
+
+        return lambda: run(*args)
+
+    per_batch = device_time_per_iter(make, n1=2, n2=12)
+    eps = n_events / per_batch
+    log(
+        f"[{label}] batched streaming deltas T={T}: {eps:,.0f} events/sec "
+        f"device-side ({per_batch*1e3:.3f}ms per {n_events}-event batch)"
+    )
+    return eps
+
+
 def bench_overrides(rng, T, O, R, label):
     ov_valid = rng.random((T, O)) < 0.8
     ov_begin = np.full((T, O), NS_MIN, dtype=np.int64)
@@ -336,10 +426,12 @@ def main():
         bench_pallas_sweep(rng, P, T, R, "cfg4:100kx10k")
     except Exception as e:  # pallas needs the TPU mosaic path; CPU runs skip
         log(f"[cfg4:100kx10k] pallas sweep unavailable: {str(e)[:120]}")
-    single_ms = bench_single_pod(rng, state, T, R, "cfg4:100kx10k")
+    bench_single_pod(rng, state, T, R, "cfg4:100kx10k")
+    single_ms = bench_single_pod_indexed(rng, state, T, R, "cfg4:100kx10k")
 
     # config 5: streaming reconcile
     bench_streaming(rng, T, R, "cfg5:streaming")
+    bench_streaming_batched(rng, T, R, "cfg5:streaming")
 
     target_ms = 1.0  # BASELINE north star: <1ms p99 on one v5e-1
     single_ms = max(float(single_ms), 1e-4)  # slope noise floor

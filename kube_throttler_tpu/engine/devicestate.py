@@ -56,6 +56,7 @@ class _KindState:
         self.dirty_pods = True
         self.dirty_throttles = True
         self._device_state: Optional[ThrottleState] = None
+        self._device_packed = None  # CheckPrecompPacked cache for check_pod
         self._device_pods: Optional[PodBatch] = None
         self._device_mask = None
 
@@ -259,7 +260,18 @@ class _KindState:
                 st_req_flag_present=jnp.asarray(self.st_req_flag_present),
             )
             self.dirty_throttles = False
+            self._device_packed = None  # derived cache follows the state
         return self._device_state
+
+    def device_packed(self):
+        """Packed residual-form precomp for the indexed single-pod check,
+        rebuilt lazily on throttle-state change."""
+        from ..ops.fastcheck import pack_check_state, precompute_check_state
+
+        state = self.device_state()  # refreshes + clears dirty_throttles
+        if self._device_packed is None:
+            self._device_packed = pack_check_state(precompute_check_state(state))
+        return self._device_packed
 
     def device_pods(self) -> Tuple[PodBatch, jnp.ndarray]:
         self.ensure_capacity()
@@ -295,6 +307,9 @@ class DeviceStateManager:
         self.dims = dims or DimRegistry()
         self._lock = threading.RLock()
         self.tracer = NoopTracer()  # set by the plugin; times device checks
+        # check_pod uses the indexed hot path up to this many affected
+        # throttles, the dense [1,T] sweep beyond (tunable for tests)
+        self.indexed_check_max = 1024
         self.throttle = _KindState("throttle", self.dims)
         self.clusterthrottle = _KindState("clusterthrottle", self.dims)
 
@@ -369,11 +384,38 @@ class DeviceStateManager:
                     thr = ks.index._col_thrs[col]
                     mask_row[0, col] = ks.index._match_one(thr, pod)
 
+            step3 = True if kind == "throttle" else on_equal
+            cols = np.nonzero(mask_row[0])[0]
+            if cols.size <= self.indexed_check_max:
+                # hot path: classify only the K affected rows against the
+                # cached packed precomp, and extract results from those K
+                # slots alone — O(K·R) device AND host work, independent of
+                # tcap. K buckets (powers of two) bound recompilation.
+                from ..ops.fastcheck import fast_check_pod_packed
+
+                k = 8
+                while k < cols.size:
+                    k *= 2
+                idx = np.zeros(k, dtype=np.int32)
+                idx_valid = np.zeros(k, dtype=bool)
+                idx[: cols.size] = cols
+                idx_valid[: cols.size] = True
+                out_k = np.asarray(
+                    fast_check_pod_packed(
+                        ks.device_packed(), row_req[0], row_present[0],
+                        idx, idx_valid, on_equal, step3,
+                    )
+                )
+                result = {}
+                for slot, col in enumerate(cols):
+                    status = int(out_k[slot])
+                    if status != CHECK_NOT_AFFECTED:
+                        result[ks.index._col_thrs[int(col)].key] = STATUS_NAMES[status]
+                return result
             batch = PodBatch(
                 valid=np.ones(1, dtype=bool), req=row_req, req_present=row_present
             )
             state = ks.device_state()
-            step3 = True if kind == "throttle" else on_equal
             out = np.asarray(
                 check_pods(state, batch, mask_row, on_equal=on_equal, step3_on_equal=step3)
             )[0]

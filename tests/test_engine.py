@@ -312,6 +312,36 @@ class TestDeviceMirrorRegressions:
         store.update_throttle(replace(thr, spec=replace(thr.spec, throttler_name="someone-else")))
         assert mgr.check_pod(pod, "throttle") == {}
 
+    def test_indexed_and_dense_check_branches_agree(self):
+        """check_pod's indexed hot path vs its dense fallback over the SAME
+        state — forced by tuning indexed_check_max (review finding: the dense
+        branch was unreachable at the default 1024 threshold)."""
+        store, mgr = self._manager()
+        # several throttles at different saturation levels, all matching
+        for i, cpu in enumerate(["50m", "100m", "1", "10"]):
+            store.create_throttle(
+                Throttle(
+                    name=f"t{i}",
+                    spec=ThrottleSpec(
+                        throttler_name="kube-throttler",
+                        threshold=ResourceAmount.of(pod=2 if i % 2 else None, requests={"cpu": cpu}),
+                        selector=ThrottleSelector(
+                            selector_terms=(
+                                ThrottleSelectorTerm(LabelSelector(match_labels={"throttle": "x"})),
+                            )
+                        ),
+                    ),
+                )
+            )
+        pod = make_pod("p", labels={"throttle": "x"}, requests={"cpu": "200m"})
+        store.create_pod(pod)
+        for on_equal in (False, True):
+            mgr.indexed_check_max = 1024
+            hot = mgr.check_pod(pod, "throttle", on_equal=on_equal)
+            mgr.indexed_check_max = 0  # force the dense branch
+            dense = mgr.check_pod(pod, "throttle", on_equal=on_equal)
+            assert hot == dense and len(hot) == 4
+
     def test_missing_namespace_never_matches_clusterthrottle(self):
         from kube_throttler_tpu.engine.devicestate import DeviceStateManager
 
