@@ -150,6 +150,52 @@ class KubeThrottler:
         vlog(2, "pod %s is unschedulable: %s", pod.key, "; ".join(reasons))
         return Status(StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE, tuple(reasons))
 
+    def pre_filter_batch(self) -> dict:
+        """Bulk admission triage: ONE device pass classifies every stored pod
+        against both kinds' full throttle state (no per-pod loop — the
+        100k×10k check matrix the reference evaluates pod-by-pod in Go runs
+        as two batched kernels here). Without a device manager, falls back to
+        the per-pod host oracle.
+
+        Returns ``{"schedulable": {pod_key: bool}, "errors": [pod_key, ...]}``;
+        schedulable mirrors PreFilter's gate (no active/insufficient/exceeds
+        throttle of either kind, plugin.go:177-180). Pods whose Namespace
+        object is missing land in ``errors`` — the per-pod path returns an
+        ERROR status for them (clusterthrottle_controller.go:273-276), so the
+        batch must not report them schedulable. Per-pod reasons stay on
+        ``pre_filter``.
+        """
+        import numpy as np
+
+        with self.tracer.trace("prefilter_batch"):
+            known_ns = {ns.name for ns in self.store.list_namespaces()}
+            schedulable: dict = {}
+            errors: list = []
+            if self.device_manager is None:
+                # host oracle, side-effect-free (no Warning events — triage
+                # only, matching the device path)
+                for pod in self.store.list_pods():
+                    try:
+                        ta, ti, te, _ = self.throttle_ctr.check_throttled(pod, False)
+                        ca, ci, ce, _ = self.cluster_throttle_ctr.check_throttled(pod, False)
+                    except Exception:
+                        errors.append(pod.key)
+                        continue
+                    schedulable[pod.key] = not (ta or ti or te or ca or ci or ce)
+                return {"schedulable": schedulable, "errors": errors}
+
+            for kind in ("throttle", "clusterthrottle"):
+                _, ok, rows = self.device_manager.check_batch(kind, False)
+                ok = np.asarray(ok)
+                for key, row in rows.items():
+                    schedulable[key] = schedulable.get(key, True) and bool(ok[row])
+            for key in list(schedulable):
+                ns, _, _ = key.partition("/")
+                if ns not in known_ns:
+                    del schedulable[key]
+                    errors.append(key)
+            return {"schedulable": schedulable, "errors": errors}
+
     # ---------------------------------------------------------------- reserve
 
     def reserve(self, pod: Pod, node: str = "") -> Status:

@@ -224,6 +224,8 @@ class ThrottlerHTTPServer:
                 200,
                 {"code": status.code.value, "reasons": list(status.reasons)},
             )
+        elif h.path == "/v1/prefilter-batch":
+            h._send(200, self.plugin.pre_filter_batch())
         elif h.path == "/v1/reserve":
             pod = self._resolve_pod(body)
             status = self.plugin.reserve(pod)

@@ -179,6 +179,107 @@ class TestHTTPServer:
         code, _ = _req(server, "DELETE", "/v1/objects/pods/default/pod1")
         assert code == 200
 
+    def test_prefilter_batch_agrees_with_per_pod(self, server):
+        """/v1/prefilter-batch (one device pass over every stored pod) must
+        agree with per-pod /v1/prefilter for each pod's schedulability."""
+        import time
+
+        _req(
+            server,
+            "POST",
+            "/v1/objects",
+            {
+                "kind": "Throttle",
+                "metadata": {"name": "tb", "namespace": "default"},
+                "spec": {
+                    "throttlerName": "kube-throttler",
+                    "threshold": {"resourceRequests": {"cpu": "300m"}},
+                    "selector": {"selectorTerms": [{"podSelector": {"matchLabels": {"grp": "b"}}}]},
+                },
+            },
+        )
+        for name, cpu, labeled in [
+            ("bp1", "200m", True),   # fits
+            ("bp2", "400m", True),   # alone exceeds threshold
+            ("bp3", "200m", False),  # unmatched — always schedulable
+        ]:
+            _req(
+                server,
+                "POST",
+                "/v1/objects",
+                {
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": name,
+                        "namespace": "default",
+                        "labels": {"grp": "b"} if labeled else {},
+                    },
+                    "spec": {
+                        "schedulerName": "my-scheduler",
+                        "containers": [{"name": "c", "resources": {"requests": {"cpu": cpu}}}],
+                    },
+                },
+            )
+        # wait until the async reconcile has observed the objects
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            code, batch = _req(server, "POST", "/v1/prefilter-batch", {})
+            if len(batch["schedulable"]) >= 3:
+                break
+            time.sleep(0.05)
+        assert code == 200
+        for key in ("default/bp1", "default/bp2", "default/bp3"):
+            code, single = _req(server, "POST", "/v1/prefilter", {"podKey": key})
+            assert batch["schedulable"][key] == (single["code"] == "Success"), key
+
+    @pytest.mark.parametrize("use_device", [True, False])
+    def test_prefilter_batch_modes_and_missing_namespace(self, use_device):
+        """Device and host-oracle batch paths agree, and a pod whose
+        Namespace object is missing lands in errors (the per-pod path
+        returns ERROR for it — review finding)."""
+        from kube_throttler_tpu.api import (
+            LabelSelector,
+            ResourceAmount,
+            Throttle,
+            ThrottleSelector,
+            ThrottleSelectorTerm,
+            ThrottleSpec,
+        )
+        from kube_throttler_tpu.api.pod import make_pod
+
+        store = Store()
+        store.create_namespace(Namespace("default"))
+        plugin = KubeThrottler(
+            decode_plugin_args({"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}),
+            store,
+            use_device=use_device,
+        )
+        store.create_throttle(
+            Throttle(
+                name="t",
+                spec=ThrottleSpec(
+                    throttler_name="kube-throttler",
+                    threshold=ResourceAmount.of(requests={"cpu": "300m"}),
+                    selector=ThrottleSelector(
+                        selector_terms=(
+                            ThrottleSelectorTerm(LabelSelector(match_labels={"g": "x"})),
+                        )
+                    ),
+                ),
+            )
+        )
+        store.create_pod(make_pod("ok", labels={"g": "x"}, requests={"cpu": "100m"}))
+        store.create_pod(make_pod("big", labels={"g": "x"}, requests={"cpu": "400m"}))
+        # namespace object "ghost" is never created
+        store.create_pod(make_pod("orphan", namespace="ghost", requests={"cpu": "100m"}))
+        plugin.run_pending_once()
+
+        out = plugin.pre_filter_batch()
+        assert out["schedulable"]["default/ok"] is True
+        assert out["schedulable"]["default/big"] is False
+        assert "ghost/orphan" in out["errors"]
+        assert "ghost/orphan" not in out["schedulable"]
+
     def test_pod_reapply_preserves_bound_state(self, server):
         """Re-applying a pod manifest must not clobber nodeName/phase."""
         import time
