@@ -1,0 +1,176 @@
+"""Hypothesis property tests: oracle algebraic laws and oracle ↔ kernel
+equivalence over generated states.
+
+SURVEY §4's template calls for "hypothesis/property tests for
+Add/IsThrottled" — these cover: arbitrary quantities through the exact
+decimal parser, ResourceAmount algebra (the reference's clamp/negative
+quirks preserved — resource_amount.go:83-125), IsThrottled dimension
+scoping (resource_amount.go:147-155), and randomized single-cell agreement
+between ``_check_throttled_for`` and the batched kernel for all onEqual
+variants.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from kube_throttler_tpu import quantity as qt
+from kube_throttler_tpu.api.pod import make_pod
+from kube_throttler_tpu.api.types import (
+    ResourceAmount,
+    Throttle,
+    ThrottleSpec,
+    ThrottleStatus,
+    _check_throttled_for,
+)
+from kube_throttler_tpu.ops.check import STATUS_NAMES, check_pods
+from kube_throttler_tpu.ops.schema import DimRegistry, encode_pods, encode_throttle_state
+
+# ---------------------------------------------------------------- strategies
+
+SUFFIXES = ["", "m", "k", "M", "G", "Ki", "Mi", "Gi"]
+
+
+@st.composite
+def quantities(draw):
+    n = draw(st.integers(min_value=0, max_value=10**12))
+    return f"{n}{draw(st.sampled_from(SUFFIXES))}"
+
+
+RESOURCES = ["cpu", "memory", "nvidia.com/gpu", "storage"]
+
+
+@st.composite
+def amounts(draw):
+    cnt = draw(st.one_of(st.none(), st.integers(min_value=0, max_value=50)))
+    reqs = draw(
+        st.one_of(
+            st.none(),
+            st.dictionaries(st.sampled_from(RESOURCES), quantities(), max_size=3),
+        )
+    )
+    return ResourceAmount.of(pod=cnt, requests=reqs)
+
+
+# ----------------------------------------------------------------- quantity
+
+
+@given(quantities())
+@settings(max_examples=200, deadline=None)
+def test_quantity_milli_roundtrip_exact(s):
+    """to_milli is exact: re-parsing the milli value yields an equal
+    quantity (never silently rounded)."""
+    q = qt.parse_quantity(s)
+    try:
+        m = qt.to_milli(q)
+    except qt.SubMilliPrecisionError:
+        return  # sub-milli precision is rejected loudly
+    assert qt.parse_quantity(f"{m}m") == q
+
+
+@given(quantities(), quantities())
+@settings(max_examples=100, deadline=None)
+def test_quantity_ordering_matches_milli(a, b):
+    qa, qb = qt.parse_quantity(a), qt.parse_quantity(b)
+    try:
+        ma, mb = qt.to_milli(qa), qt.to_milli(qb)
+    except qt.SubMilliPrecisionError:
+        return
+    assert (qa < qb) == (ma < mb) and (qa == qb) == (ma == mb)
+
+
+# ---------------------------------------------------------- amount algebra
+
+
+@given(amounts(), amounts())
+@settings(max_examples=150, deadline=None)
+def test_add_sub_round_trip_quirks(a, b):
+    """a.add(b).sub(b) restores ``a``'s dims EXCEPT the reference's
+    deliberate asymmetries: pod count clamps at 0 on sub while request
+    quantities may go negative; keys only in ``b`` remain at 0."""
+    back = a.add(b).sub(b)
+    if a.resource_counts is None and b.resource_counts is None:
+        assert back.resource_counts is None
+    else:
+        assert back.resource_counts == max(a.resource_counts or 0, 0)
+    for k, v in (a.resource_requests or {}).items():
+        assert back.resource_requests[k] == v
+    for k in (b.resource_requests or {}):
+        if k not in (a.resource_requests or {}):
+            assert back.resource_requests[k] == 0
+
+
+@given(amounts(), amounts(), st.booleans())
+@settings(max_examples=150, deadline=None)
+def test_is_throttled_dimension_scoping(threshold, used, on_equal):
+    """Only dims present in the threshold are evaluated; threshold dims
+    absent from used evaluate to not-throttled; empty-but-present threshold
+    request map yields a nil flag map (Go allocation quirk, preserved)."""
+    flags = threshold.is_throttled(used, on_equal)
+    treqs = threshold.resource_requests
+    if treqs is None or not treqs:
+        assert flags.resource_requests is None
+    else:
+        assert set(flags.resource_requests.keys()) == set(treqs.keys())
+        for k in treqs:
+            if k not in (used.resource_requests or {}):
+                assert flags.resource_requests[k] is False
+    if threshold.resource_counts is None or used.resource_counts is None:
+        assert flags.resource_counts_pod is False
+
+
+# ----------------------------------------------------- oracle ↔ kernel e2e
+
+
+@st.composite
+def pod_requests(draw):
+    return draw(st.dictionaries(st.sampled_from(RESOURCES), quantities(), max_size=3))
+
+
+@given(amounts(), amounts(), amounts(), pod_requests(), st.booleans(), st.booleans())
+@settings(max_examples=200, deadline=None)
+def test_kernel_matches_oracle_single_cell(
+    threshold, used, reserved, pod_reqs, on_equal, step3_on_equal
+):
+    """One (pod, throttle) cell through the batched kernel equals the
+    ordered 4-state oracle for arbitrary generated amounts and both
+    onEqual flags (covering the Throttle/ClusterThrottle asymmetry)."""
+    # drop sub-milli-unrepresentable quantities up front (the encoder
+    # rejects them loudly; the oracle works in exact Fractions)
+    for amt in (threshold, used, reserved):
+        for v in (amt.resource_requests or {}).values():
+            try:
+                qt.to_milli(v)
+            except qt.SubMilliPrecisionError:
+                return
+    for v in pod_reqs.values():
+        try:
+            qt.to_milli(qt.parse_quantity(v))
+        except qt.SubMilliPrecisionError:
+            return
+
+    pod = make_pod("p", requests=pod_reqs)
+    status = ThrottleStatus(used=used, throttled=threshold.is_throttled(used, True))
+    thr = Throttle(
+        name="t",
+        spec=ThrottleSpec(throttler_name="x", threshold=threshold),
+        status=status,
+    )
+
+    oracle = _check_throttled_for(
+        threshold, status, pod, reserved, on_equal, step3_on_equal
+    )
+
+    dims = DimRegistry()
+    for name in pod_reqs:
+        dims.index_of(name)
+    state = encode_throttle_state([thr], dims, reserved=[reserved])
+    batch = encode_pods([pod], dims)
+    assert batch.req.shape[1] == state.thr_req.shape[1]  # ≤4 names, cap 8
+    mask = np.ones((1, 1), dtype=bool)
+    got = int(np.asarray(check_pods(state, batch, mask, on_equal, step3_on_equal))[0, 0])
+    assert STATUS_NAMES[got] == oracle, (
+        f"kernel={STATUS_NAMES[got]} oracle={oracle} thr={threshold} used={used} "
+        f"res={reserved} pod={pod_reqs} onEqual={on_equal} step3={step3_on_equal}"
+    )
