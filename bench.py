@@ -401,6 +401,47 @@ def bench_streaming(rng, T, R, label, n_events=1000):
     return eps
 
 
+def bench_example_scenario(label):
+    """BASELINE config 1: the example/throttle.yaml t1 + walkthrough pods
+    through the FULL plugin stack on the host-oracle path (the 'CPU
+    PreFilter reference scenario' — what the reference's Go hot path does
+    per attempt, here per-decision host latency)."""
+    import yaml
+
+    from kube_throttler_tpu.api.pod import Namespace
+    from kube_throttler_tpu.api.serialization import object_from_dict
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    plugin = KubeThrottler(
+        decode_plugin_args({"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}),
+        store,
+        use_device=False,
+    )
+    with open("example/throttle.yaml") as f:
+        store.create_throttle(object_from_dict(yaml.safe_load(f)))
+    pods = []
+    with open("example/pods.yaml") as f:
+        for doc in yaml.safe_load_all(f):
+            pod = object_from_dict(doc)
+            store.create_pod(pod)
+            pods.append(pod)
+    plugin.run_pending_once()
+
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        plugin.pre_filter(pods[i % len(pods)])
+    dt = time.perf_counter() - t0
+    log(
+        f"[{label}] example t1 + pods1-3, host-oracle PreFilter: "
+        f"{dt/n*1e6:.1f}us/decision ({n/dt:,.0f} decisions/sec)"
+    )
+    plugin.stop()
+
+
 def main():
     quick = "--quick" in sys.argv
     scale = 10 if quick else 1
@@ -411,6 +452,9 @@ def main():
     log(f"dispatch round-trip (environment tunnel overhead): {rtt*1e3:.1f}ms")
 
     R = 8
+
+    # config 1: the reference example scenario end-to-end (host path)
+    bench_example_scenario("cfg1:example")
 
     # config 2: 1k pods x 100 throttles, 4 active dims
     bench_batched(rng, 1000 // scale, 100, R, "cfg2:1kx100")
