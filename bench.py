@@ -442,6 +442,60 @@ def bench_example_scenario(label):
     plugin.stop()
 
 
+def bench_selector_index(label, T=10_000, n_pods=200):
+    """Host-side selector-mask maintenance (SURVEY hard part 3): per-pod-event
+    row recompute against T compiled selector columns, native C++ vs Python."""
+    import random
+
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ResourceAmount,
+        Throttle,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+        ThrottleSpec,
+    )
+    from kube_throttler_tpu.engine.index import SelectorIndex
+    from kube_throttler_tpu.native import available
+
+    rng = random.Random(0)
+    throttles = [
+        Throttle(
+            name=f"t{i}",
+            spec=ThrottleSpec(
+                throttler_name="x",
+                threshold=ResourceAmount.of(pod=1),
+                selector=ThrottleSelector(
+                    selector_terms=(
+                        ThrottleSelectorTerm(
+                            LabelSelector(match_labels={"grp": f"g{i % 500}"})
+                        ),
+                    )
+                ),
+            ),
+        )
+        for i in range(T)
+    ]
+    pods = [
+        make_pod(f"p{i}", labels={"grp": f"g{rng.randrange(500)}"}) for i in range(n_pods)
+    ]
+
+    for use_native, name in ((True, "native C++"), (False, "python")):
+        if use_native and not available():
+            log(f"[{label}] native tier unavailable (no toolchain or KT_TPU_NO_NATIVE=1); python tier only")
+            continue
+        idx = SelectorIndex("throttle", pod_capacity=n_pods, throttle_capacity=T, use_native=use_native)
+        idx.upsert_namespace(Namespace("default"))
+        for thr in throttles:
+            idx.upsert_throttle(thr)
+        t0 = time.perf_counter()
+        for pod in pods:
+            idx.upsert_pod(pod)  # one mask-row recompute per pod event
+        dt = (time.perf_counter() - t0) / n_pods
+        log(f"[{label}] pod-event row recompute vs T={T} ({name}): {dt*1e6:.1f}us/event")
+
+
 def main():
     quick = "--quick" in sys.argv
     scale = 10 if quick else 1
@@ -455,6 +509,7 @@ def main():
 
     # config 1: the reference example scenario end-to-end (host path)
     bench_example_scenario("cfg1:example")
+    bench_selector_index("host:index", T=10_000 // scale)
 
     # config 2: 1k pods x 100 throttles, 4 active dims
     bench_batched(rng, 1000 // scale, 100, R, "cfg2:1kx100")
