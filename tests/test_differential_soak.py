@@ -1,0 +1,304 @@
+"""Randomized differential soak: two full stacks — device-kernel-served and
+host-oracle — consume an identical random event stream; every pod's
+PreFilter verdict and every throttle's reconciled status must agree at
+every checkpoint.
+
+This is the strongest end-to-end equivalence artifact: it exercises the
+whole pipeline (store → watch events → selector index (native C++ row tier
+on one side) → device mirror → packed indexed check kernel) against the
+pure-Python reference semantics, over object shapes unit tests don't
+enumerate (matchExpressions columns, label moves, unknown namespaces,
+overrides straddling the fake clock, reservations, deletes).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from kube_throttler_tpu.api.pod import Namespace, make_pod
+from kube_throttler_tpu.api.types import (
+    ClusterThrottle,
+    ClusterThrottleSelector,
+    ClusterThrottleSelectorTerm,
+    ClusterThrottleSpec,
+    LabelSelector,
+    LabelSelectorRequirement,
+    ResourceAmount,
+    TemporaryThresholdOverride,
+    Throttle,
+    ThrottleSelector,
+    ThrottleSelectorTerm,
+    ThrottleSpec,
+)
+from kube_throttler_tpu.engine.store import NotFoundError, Store
+from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+from kube_throttler_tpu.utils.clock import FakeClock
+
+NOW = datetime(2024, 3, 1, 12, 0, 0, tzinfo=timezone.utc)
+
+
+def _rfc(dt):
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _stack(use_device: bool):
+    store = Store()
+    clock = FakeClock(NOW)
+    plugin = KubeThrottler(
+        decode_plugin_args({"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}),
+        store,
+        clock=clock,
+        use_device=use_device,
+    )
+    return store, plugin, clock
+
+
+def _rand_expression(rng):
+    op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])
+    return LabelSelectorRequirement(
+        key=rng.choice("abc"),
+        operator=op,
+        values=(rng.choice("xyz"),) if op in ("In", "NotIn") else (),
+    )
+
+
+def _rand_selector(rng, cluster: bool):
+    terms = []
+    for _ in range(rng.randint(0, 2)):
+        pod_sel = LabelSelector(
+            match_labels={rng.choice("abc"): rng.choice("xyz") for _ in range(rng.randint(0, 2))},
+            match_expressions=(
+                (_rand_expression(rng),) if rng.random() < 0.3 else ()
+            ),
+        )
+        if cluster:
+            terms.append(
+                ClusterThrottleSelectorTerm(
+                    pod_selector=pod_sel,
+                    namespace_selector=LabelSelector(
+                        match_labels={"env": rng.choice("pq")} if rng.random() < 0.4 else {}
+                    ),
+                )
+            )
+        else:
+            terms.append(ThrottleSelectorTerm(pod_selector=pod_sel))
+    if cluster:
+        return ClusterThrottleSelector(selector_terms=tuple(terms))
+    return ThrottleSelector(selector_terms=tuple(terms))
+
+
+def _rand_threshold(rng):
+    reqs = {}
+    if rng.random() < 0.8:
+        reqs["cpu"] = f"{rng.randint(1, 9)}00m"
+    if rng.random() < 0.5:
+        reqs["memory"] = f"{rng.randint(1, 8)}Gi"
+    return ResourceAmount.of(
+        pod=rng.randint(1, 4) if rng.random() < 0.6 else None, requests=reqs or None
+    )
+
+
+def _rand_overrides(rng):
+    out = []
+    for _ in range(rng.randint(0, 2)):
+        active = rng.random() < 0.5
+        begin = NOW - timedelta(hours=1) if active else NOW + timedelta(hours=1)
+        out.append(
+            TemporaryThresholdOverride(
+                begin=_rfc(begin),
+                end=_rfc(begin + timedelta(hours=2)),
+                threshold=_rand_threshold(rng),
+            )
+        )
+    return tuple(out)
+
+
+def _normalize_reasons(reasons):
+    out = []
+    for r in reasons:
+        head, _, names = r.partition("=")
+        out.append(f"{head}={','.join(sorted(names.split(',')))}")
+    return sorted(out)
+
+
+def _status_dict(thr):
+    return {
+        "used": thr.status.used.to_dict(),
+        "throttled": thr.status.throttled.to_dict(),
+        "threshold": thr.status.calculated_threshold.threshold.to_dict(),
+    }
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_device_and_host_stacks_agree_under_random_churn(seed):
+    rng = random.Random(seed)
+    (store_d, plug_d, clock_d), (store_h, plug_h, clock_h) = _stack(True), _stack(False)
+
+    namespaces = ["default", "ns1", "ns2"]
+    pods: list = []
+
+    def both(fn):
+        fn(store_d)
+        fn(store_h)
+
+    # two namespaces known from the start; ns2 arrives (or not) mid-stream
+    for ns in namespaces[:2]:
+        labels = {"env": rng.choice("pq")}
+        both(lambda s, ns=ns, labels=labels: s.create_namespace(Namespace(ns, labels=dict(labels))))
+
+    def checkpoint():
+        plug_d.run_pending_once()
+        plug_h.run_pending_once()
+        # every pod's PreFilter verdict agrees
+        for pod in pods:
+            sd = plug_d.pre_filter(pod)
+            sh = plug_h.pre_filter(pod)
+            assert sd.code == sh.code, (pod.key, sd.reasons, sh.reasons)
+            assert _normalize_reasons(sd.reasons) == _normalize_reasons(sh.reasons), pod.key
+        # every throttle's reconciled status agrees
+        for thr_d in store_d.list_throttles():
+            thr_h = store_h.get_throttle(thr_d.namespace, thr_d.name)
+            assert _status_dict(thr_d) == _status_dict(thr_h), thr_d.key
+        for ct_d in store_d.list_cluster_throttles():
+            ct_h = store_h.get_cluster_throttle(ct_d.name)
+            assert _status_dict(ct_d) == _status_dict(ct_h), ct_d.key
+
+    for step in range(120):
+        op = rng.random()
+        if op < 0.25:  # (re)apply a Throttle
+            name, ns = f"t{rng.randint(0, 6)}", rng.choice(namespaces)
+            thr = Throttle(
+                name=name,
+                namespace=ns,
+                spec=ThrottleSpec(
+                    throttler_name="kube-throttler",
+                    threshold=_rand_threshold(rng),
+                    temporary_threshold_overrides=_rand_overrides(rng),
+                    selector=_rand_selector(rng, cluster=False),
+                ),
+            )
+
+            def apply_thr(s, thr=thr):
+                try:
+                    s.create_throttle(thr)
+                except ValueError:
+                    cur = s.get_throttle(thr.namespace, thr.name)
+                    s.update_throttle(replace(thr, status=cur.status))
+
+            both(apply_thr)
+        elif op < 0.4:  # (re)apply a ClusterThrottle
+            ct = ClusterThrottle(
+                name=f"ct{rng.randint(0, 4)}",
+                spec=ClusterThrottleSpec(
+                    throttler_name="kube-throttler",
+                    threshold=_rand_threshold(rng),
+                    temporary_threshold_overrides=_rand_overrides(rng),
+                    selector=_rand_selector(rng, cluster=True),
+                ),
+            )
+
+            def apply_ct(s, ct=ct):
+                try:
+                    s.create_cluster_throttle(ct)
+                except ValueError:
+                    cur = s.get_cluster_throttle(ct.name)
+                    s.update_cluster_throttle(replace(ct, status=cur.status))
+
+            both(apply_ct)
+        elif op < 0.65 or not pods:  # create a pod (sometimes already bound)
+            name, ns = f"p{step}", rng.choice(namespaces)
+            pod = make_pod(
+                name,
+                namespace=ns,
+                labels={rng.choice("abc"): rng.choice("xyz") for _ in range(rng.randint(0, 2))},
+                requests={"cpu": f"{rng.randint(1, 6)}00m"},
+                scheduler_name="my-scheduler" if rng.random() < 0.9 else "other",
+                node_name="n1" if rng.random() < 0.5 else "",
+            )
+            pods.append(pod)
+            both(lambda s, pod=pod: s.create_pod(pod))
+        elif op < 0.75:  # label move on a random pod
+            old = rng.choice(pods)
+            moved = replace(
+                old, labels={rng.choice("abc"): rng.choice("xyz")}
+            )
+            pods[pods.index(old)] = moved
+
+            def upd(s, moved=moved):
+                try:
+                    s.update_pod(moved)
+                except NotFoundError:
+                    pass
+
+            both(upd)
+        elif op < 0.82:  # reserve / unreserve a pod (scheduler cycle)
+            pod = rng.choice(pods)
+            if rng.random() < 0.6:
+                sd, sh = plug_d.reserve(pod), plug_h.reserve(pod)
+                assert sd.code == sh.code, (pod.key, sd.reasons, sh.reasons)
+            else:
+                plug_d.unreserve(pod)
+                plug_h.unreserve(pod)
+        elif op < 0.87:  # delete a throttle — exercises column free/reuse
+            if rng.random() < 0.5:
+                name, ns = f"t{rng.randint(0, 6)}", rng.choice(namespaces)
+
+                def rm_thr(s, name=name, ns=ns):
+                    try:
+                        s.delete_throttle(ns, name)
+                    except NotFoundError:
+                        pass
+
+                both(rm_thr)
+            else:
+                name = f"ct{rng.randint(0, 4)}"
+
+                def rm_ct(s, name=name):
+                    try:
+                        s.delete_cluster_throttle(name)
+                    except NotFoundError:
+                        pass
+
+                both(rm_ct)
+        elif op < 0.93:  # delete a pod
+            pod = pods.pop(rng.randrange(len(pods)))
+
+            def rm(s, pod=pod):
+                try:
+                    s.delete_pod(pod.namespace, pod.name)
+                except NotFoundError:
+                    pass
+
+            both(rm)
+        else:  # late namespace arrival / label change
+            ns = rng.choice(namespaces)
+            labels = {"env": rng.choice("pq")}
+
+            def upsert_ns(s, ns=ns, labels=labels):
+                try:
+                    s.create_namespace(Namespace(ns, labels=dict(labels)))
+                except ValueError:
+                    s.update_namespace(Namespace(ns, labels=dict(labels)))
+
+            both(upsert_ns)
+
+        if step == 60:
+            # advance both clocks past every override window boundary so the
+            # next reconciles flip active → expired (and future → active)
+            clock_d.advance(timedelta(hours=1, minutes=30))
+            clock_h.advance(timedelta(hours=1, minutes=30))
+            # re-reconcile every override-bearing throttle at the new time
+            for s, p in ((store_d, plug_d), (store_h, plug_h)):
+                for thr in s.list_throttles():
+                    s.update_throttle(thr)
+                for ct in s.list_cluster_throttles():
+                    s.update_cluster_throttle(ct)
+
+        if step % 12 == 11:
+            checkpoint()
+
+    checkpoint()
