@@ -33,18 +33,24 @@ from .plugin.framework import RecordingEventRecorder
 from .server import ThrottlerHTTPServer
 
 
-def _args_from_config_file(path: str) -> Dict[str, Any]:
+def _load_config_file(path: str) -> Dict[str, Any]:
     import yaml
 
     with open(path) as f:
-        cfg = yaml.safe_load(f) or {}
+        return yaml.safe_load(f) or {}
+
+
+def _args_from_config(cfg: Dict[str, Any], path: str) -> Dict[str, Any]:
     for profile in cfg.get("profiles", []) or []:
         for pc in profile.get("pluginConfig", []) or []:
             if pc.get("name") == "kube-throttler":
                 return dict(pc.get("args") or {})
     if "name" in cfg:
         return cfg
-    raise SystemExit(f"no kube-throttler pluginConfig found in {path}")
+    # a config carrying only scheduler-level blocks (e.g. leaderElection) is
+    # fine — plugin args may come from CLI flags; decode_plugin_args
+    # validates the merged result
+    return {}
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -60,6 +66,17 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--port", type=int, default=10259)
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
+    serve.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="block until the leadership lease is acquired before serving "
+        "(also honours leaderElection.leaderElect in --config)",
+    )
+    serve.add_argument(
+        "--lock-file",
+        default="",
+        help="leadership lease path (default /tmp/kube-throttler-tpu-<name>.lock)",
+    )
     serve.add_argument(
         "--nodes",
         type=int,
@@ -92,8 +109,14 @@ def main(argv: Optional[list] = None) -> int:
     tracing.set_verbosity(args.verbosity)
 
     config: Dict[str, Any] = {}
+    leader_elect = args.leader_elect
     if args.config:
-        config = _args_from_config_file(args.config)
+        raw_cfg = _load_config_file(args.config)
+        config = _args_from_config(raw_cfg, args.config)
+        # KubeSchedulerConfiguration leaderElection parity (the reference
+        # inherits this from the embedded kube-scheduler)
+        if (raw_cfg.get("leaderElection") or {}).get("leaderElect"):
+            leader_elect = True
     if args.name:
         config["name"] = args.name
     if args.target_scheduler_name:
@@ -107,6 +130,25 @@ def main(argv: Optional[list] = None) -> int:
         plugin_args = decode_plugin_args(config)
     except ValueError as e:
         parser.error(str(e))  # clean usage error, not a traceback
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+
+    elector = None
+    if leader_elect:
+        from .utils.leaderelect import FileLeaseElector
+
+        lock_path = args.lock_file or f"/tmp/kube-throttler-tpu-{plugin_args.name}.lock"
+        elector = FileLeaseElector(lock_path)
+        print(f"leader election on {lock_path}: waiting for lease...", flush=True)
+        try:
+            if not elector.acquire(stop):
+                return 0  # interrupted while standing by
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr, flush=True)
+            return 1
+
     store = Store()
     store.create_namespace(Namespace("default"))
     plugin = KubeThrottler(
@@ -137,14 +179,13 @@ def main(argv: Optional[list] = None) -> int:
         flush=True,
     )
 
-    stop = threading.Event()
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
     stop.wait()
     server.stop()
     if scheduler is not None:
         scheduler.stop()
     plugin.stop()
+    if elector is not None:
+        elector.release()
     return 0
 
 

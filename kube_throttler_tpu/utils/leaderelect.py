@@ -1,0 +1,91 @@
+"""Leader election for the standalone daemon.
+
+The reference inherits leader election from the embedded kube-scheduler
+(the ``leaderElection`` block of KubeSchedulerConfiguration —
+deploy/config.yaml in both repos); a standby replica blocks until the
+lease is free. This module provides the standalone analog: an exclusive
+``flock`` lease on a file, acquired with the same block-until-leader
+behavior. Single-host/shared-filesystem scope — for multi-host HA the
+daemon would sit behind a real Lease object on the control-plane store,
+which the in-memory apiserver doesn't persist by design (crash-only,
+SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class FileLeaseElector:
+    """Blocking file-lock lease: ``acquire`` polls flock(LOCK_EX|LOCK_NB)
+    until it wins or ``stop`` is set; the OS releases the lease on process
+    death, so a crashed leader frees its standby automatically."""
+
+    def __init__(self, lock_path: str, retry_period: float = 2.0):
+        self.lock_path = lock_path
+        self.retry_period = retry_period
+        self._fd: Optional[int] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._fd is not None
+
+    def try_acquire(self) -> bool:
+        if self._fd is not None:
+            return True
+        try:
+            fd = os.open(self.lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError as e:
+            # unusable path (missing dir, permission-denied) is a config
+            # error, not a held lease — fail loudly instead of retrying
+            raise RuntimeError(
+                f"cannot open leadership lease {self.lock_path}: {e}"
+            ) from e
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        self._fd = fd  # leadership is held from here even if the pid write fails
+        try:
+            os.ftruncate(fd, 0)
+            os.write(fd, str(os.getpid()).encode())
+        except OSError:
+            pass  # the pid note is advisory only
+        return True
+
+    def acquire(self, stop: Optional[threading.Event] = None) -> bool:
+        """Block until leadership is acquired (True) or ``stop`` fires
+        (False)."""
+        waiting_logged = False
+        while True:
+            if self.try_acquire():
+                logger.info("acquired leadership lease %s", self.lock_path)
+                return True
+            if not waiting_logged:
+                logger.info(
+                    "lease %s held by another replica; standing by", self.lock_path
+                )
+                waiting_logged = True
+            if stop is not None:
+                if stop.wait(self.retry_period):
+                    return False
+            else:
+                time.sleep(self.retry_period)
+
+    def release(self) -> None:
+        if self._fd is None:
+            return
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        finally:
+            os.close(self._fd)
+            self._fd = None
+        logger.info("released leadership lease %s", self.lock_path)
