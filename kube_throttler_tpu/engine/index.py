@@ -91,7 +91,7 @@ class SelectorIndex:
         self._ns_ids = _Interner()
         self._key_ids = _Interner()
 
-        # native C++ row-match tier (native/ktnative.cpp); None → pure Python
+        # native C++ row-match tier (kube_throttler_tpu/native/ktnative.cpp); None → pure Python
         self._native: Optional[NativeRowEngine] = None
         if use_native:
             try:

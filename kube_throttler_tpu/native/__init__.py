@@ -1,6 +1,8 @@
 """ctypes bindings for the native selector row-match engine.
 
-The shared library is built from ``native/ktnative.cpp`` (``make native``).
+The shared library is built from ``ktnative.cpp`` in this package
+(``make native``), which ships with the wheel so installed copies
+auto-build too.
 If it is absent, the loader builds it on first import with ``g++`` — a
 single-file, sub-second compile — and falls back to pure Python when no
 toolchain is available, so the package never hard-depends on the binary.
@@ -22,8 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 _PKG_DIR = Path(__file__).resolve().parent
-_REPO_ROOT = _PKG_DIR.parent.parent
-_SRC = _REPO_ROOT / "native" / "ktnative.cpp"
+_SRC = _PKG_DIR / "ktnative.cpp"
 _SO = _PKG_DIR / "_ktnative.so"
 
 _lib: Optional[ctypes.CDLL] = None

@@ -1,6 +1,6 @@
 """Parity tests: native C++ row-match tier vs the pure-Python SelectorIndex.
 
-The native engine (native/ktnative.cpp) must reproduce the Python tier's
+The native engine (kube_throttler_tpu/native/ktnative.cpp) must reproduce the Python tier's
 mask bit-for-bit over every selector shape the reference supports:
 matchLabels-only terms (throttle_selector.go:30-54), ClusterThrottle
 namespace selectors (clusterthrottle_selector.go:112-141), matchExpressions
