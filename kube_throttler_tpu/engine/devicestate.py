@@ -59,6 +59,11 @@ class _KindState:
         self._device_packed = None  # CheckPrecompPacked cache for check_pod
         self._device_pods: Optional[PodBatch] = None
         self._device_mask = None
+        # rows touched by single-pod events since the last device sync —
+        # applied as device-side scatters instead of a full [P,*] re-upload
+        self._dirty_pod_rows: set = set()
+        # beyond this many pending rows a full upload is cheaper
+        self.row_scatter_max = 256
 
     def _alloc_pods(self, pcap: int) -> None:
         self.pod_req = np.zeros((pcap, self.R), dtype=np.int64)
@@ -222,19 +227,24 @@ class _KindState:
 
     def set_pod_row(self, pod: Pod) -> None:
         row = self.index.upsert_pod(pod)
+        before = (self.pcap, self.R)
         self.ensure_capacity()
         self.pod_req, self.pod_present = self.encode_pod_requests_into(
             self.pod_req, self.pod_present, row, pod
         )
         self.pod_valid[row] = True
-        self.dirty_pods = True
+        if (self.pcap, self.R) == before and not self.dirty_pods:
+            self._dirty_pod_rows.add(row)  # incremental row scatter suffices
+        else:
+            self.dirty_pods = True
 
     def remove_pod_row(self, key: str) -> None:
         row = self.index.pod_row(key)
         self.index.remove_pod(key)
         if row is not None:
             self.pod_valid[row] = False
-            self.dirty_pods = True
+            if not self.dirty_pods:
+                self._dirty_pod_rows.add(row)
 
     # -- device sync ------------------------------------------------------
 
@@ -275,7 +285,11 @@ class _KindState:
 
     def device_pods(self) -> Tuple[PodBatch, jnp.ndarray]:
         self.ensure_capacity()
-        if self.dirty_pods or self._device_pods is None:
+        if (
+            self.dirty_pods
+            or self._device_pods is None
+            or len(self._dirty_pod_rows) > self.row_scatter_max
+        ):
             self._device_pods = PodBatch(
                 valid=jnp.asarray(self.pod_valid),
                 req=jnp.asarray(self.pod_req),
@@ -283,8 +297,32 @@ class _KindState:
             )
             self._device_mask = jnp.asarray(self.index.mask)
             self.dirty_pods = False
-        elif self._device_mask is None or self._device_mask.shape != self.index.mask.shape:
+            self._dirty_pod_rows.clear()
+            return self._device_pods, self._device_mask
+
+        mask_rebuilt = False
+        if self._device_mask is None or self._device_mask.shape != self.index.mask.shape:
+            # throttle/namespace event invalidated the whole mask; the live
+            # numpy mask already includes any pending row changes
             self._device_mask = jnp.asarray(self.index.mask)
+            mask_rebuilt = True
+
+        if self._dirty_pod_rows:
+            # single-pod events: ship only the touched rows (device-side
+            # scatter instead of a full [P,R]/[P,T] host→device transfer)
+            rows = np.fromiter(self._dirty_pod_rows, dtype=np.int64)
+            self._device_pods = PodBatch(
+                valid=self._device_pods.valid.at[rows].set(self.pod_valid[rows]),
+                req=self._device_pods.req.at[rows].set(self.pod_req[rows]),
+                req_present=self._device_pods.req_present.at[rows].set(
+                    self.pod_present[rows]
+                ),
+            )
+            if not mask_rebuilt:
+                self._device_mask = self._device_mask.at[rows].set(
+                    np.asarray(self.index.mask[rows, :])
+                )
+            self._dirty_pod_rows.clear()
         return self._device_pods, self._device_mask
 
     def refresh_mask(self) -> None:
@@ -333,7 +371,8 @@ class DeviceStateManager:
                     ks.remove_pod_row(event.obj.key)
                 else:
                     ks.set_pod_row(event.obj)
-                ks.refresh_mask()
+                # no refresh_mask: a pod event only changes its own mask row,
+                # which the incremental row scatter ships
 
     def _on_any_throttle(self, ks: _KindState, event: Event) -> None:
         thr = event.obj

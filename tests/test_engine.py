@@ -342,6 +342,79 @@ class TestDeviceMirrorRegressions:
             dense = mgr.check_pod(pod, "throttle", on_equal=on_equal)
             assert hot == dense and len(hot) == 4
 
+    def test_incremental_device_sync_matches_full_upload(self):
+        """device_pods' row-scatter path (single-pod events) must produce
+        the same check_batch results as a freshly-built manager that
+        full-uploads, across interleaved pod churn, label moves, deletes,
+        and a throttle edit (which still forces a full mask rebuild)."""
+        import random
+        from dataclasses import replace as dc_replace
+
+        import numpy as np
+
+        rng = random.Random(5)
+        store, mgr = self._manager()
+        store.create_throttle(self._throttle("t1", label="x"))
+        store.create_throttle(self._throttle("t2", label="y"))
+
+        live = {}
+        for step in range(40):
+            op = rng.random()
+            if op < 0.5 or not live:
+                name = f"p{step}"
+                pod = make_pod(
+                    name,
+                    labels={"throttle": rng.choice("xy")},
+                    requests={"cpu": f"{rng.randint(1, 4)}00m"},
+                    node_name="n1" if rng.random() < 0.5 else "",
+                )
+                live[name] = pod
+                try:
+                    store.create_pod(pod)
+                except ValueError:
+                    store.update_pod(pod)
+            elif op < 0.7:
+                name = rng.choice(list(live))
+                moved = dc_replace(live[name], labels={"throttle": rng.choice("xy")})
+                live[name] = moved
+                store.update_pod(moved)
+            elif op < 0.85:
+                name = rng.choice(list(live))
+                del live[name]
+                store.delete_pod("default", name)
+            else:  # throttle edit → full mask invalidation interleaved
+                thr = store.get_throttle("default", "t1")
+                store.update_throttle(
+                    dc_replace(
+                        thr,
+                        spec=dc_replace(
+                            thr.spec,
+                            threshold=ResourceAmount.of(requests={"cpu": f"{rng.randint(1, 9)}00m"}),
+                        ),
+                    )
+                )
+
+            counts_inc, sched_inc, rows_inc = mgr.check_batch("throttle")
+            # fresh manager rebuilds everything from the same store state;
+            # unsubscribe it afterwards or stale managers pile up handlers
+            from kube_throttler_tpu.engine.devicestate import DeviceStateManager
+
+            fresh = DeviceStateManager(store, "kube-throttler", "my-scheduler")
+            counts_full, sched_full, rows_full = fresh.check_batch("throttle")
+            for kind_name, handler in (
+                ("Namespace", fresh._on_namespace),
+                ("Pod", fresh._on_pod),
+                ("Throttle", fresh._on_throttle),
+                ("ClusterThrottle", fresh._on_cluster_throttle),
+            ):
+                store.remove_event_handler(kind_name, handler)
+            for key, row in rows_inc.items():
+                frow = rows_full[key]
+                np.testing.assert_array_equal(
+                    np.asarray(counts_inc)[row], np.asarray(counts_full)[frow], err_msg=f"{step}:{key}"
+                )
+                assert bool(np.asarray(sched_inc)[row]) == bool(np.asarray(sched_full)[frow])
+
     def test_missing_namespace_never_matches_clusterthrottle(self):
         from kube_throttler_tpu.engine.devicestate import DeviceStateManager
 
