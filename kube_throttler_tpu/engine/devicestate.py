@@ -59,9 +59,10 @@ class _KindState:
         self._device_packed = None  # CheckPrecompPacked cache for check_pod
         self._device_pods: Optional[PodBatch] = None
         self._device_mask = None
-        # rows touched by single-pod events since the last device sync —
-        # applied as device-side scatters instead of a full [P,*] re-upload
+        # rows/cols touched by single-object events since the last device
+        # sync — applied as device-side scatters instead of a full re-upload
         self._dirty_pod_rows: set = set()
+        self._dirty_thr_cols: set = set()
         # beyond this many pending rows a full upload is cheaper
         self.row_scatter_max = 256
 
@@ -144,14 +145,27 @@ class _KindState:
     def _amount_into_row(
         self,
         amount: Optional[ResourceAmount],
-        cnt: np.ndarray,
-        cnt_present: np.ndarray,
-        req: np.ndarray,
-        req_present: np.ndarray,
+        cnt_name: str,
+        cnt_present_name: str,
+        req_name: str,
+        req_present_name: str,
         i: int,
     ) -> None:
         if amount is None:
             amount = ResourceAmount()
+        # resolve every dim index FIRST and grow once: ensure_capacity()
+        # REPLACES the staging arrays, so references must only be taken
+        # after any growth has happened
+        entries = [
+            (self.dims.index_of(name), to_milli(q))
+            for name, q in (amount.resource_requests or {}).items()
+        ]
+        if any(j >= self.R for j, _ in entries):
+            self.ensure_capacity()
+        cnt = getattr(self, cnt_name)
+        cnt_present = getattr(self, cnt_present_name)
+        req = getattr(self, req_name)
+        req_present = getattr(self, req_present_name)
         if amount.resource_counts is not None:
             cnt[i] = amount.resource_counts
             cnt_present[i] = True
@@ -160,22 +174,34 @@ class _KindState:
             cnt_present[i] = False
         req[i, :] = 0
         req_present[i, :] = False
-        for name, q in (amount.resource_requests or {}).items():
-            j = self.dims.index_of(name)
-            if j >= self.R:
-                self.ensure_capacity()
-            req[i, j] = to_milli(q)
+        for j, milli in entries:
+            req[i, j] = milli
             req_present[i, j] = True
+
+    def _note_thr_col(self, col: int, before: Tuple[int, int]) -> None:
+        """Record a single-throttle change for the scatter path, or escalate
+        to a full re-upload if capacity moved under us."""
+        if (self.tcap, self.R) == before and not self.dirty_throttles:
+            self._dirty_thr_cols.add(col)
+        else:
+            self.dirty_throttles = True
+
+    def _note_pod_row(self, row: int, before: Tuple[int, int]) -> None:
+        if (self.pcap, self.R) == before and not self.dirty_pods:
+            self._dirty_pod_rows.add(row)
+        else:
+            self.dirty_pods = True
 
     def set_throttle_row(self, thr: AnyThrottle) -> None:
         from ..api.types import effective_threshold
 
         col = self.index.upsert_throttle(thr)
+        before = (self.tcap, self.R)
         self.ensure_capacity()
         eff = effective_threshold(thr.spec.threshold, thr.status)
-        self._amount_into_row(eff, self.thr_cnt, self.thr_cnt_present, self.thr_req, self.thr_req_present, col)
+        self._amount_into_row(eff, "thr_cnt", "thr_cnt_present", "thr_req", "thr_req_present", col)
         self._amount_into_row(
-            thr.status.used, self.used_cnt, self.used_cnt_present, self.used_req, self.used_req_present, col
+            thr.status.used, "used_cnt", "used_cnt_present", "used_req", "used_req_present", col
         )
         st = thr.status.throttled
         self.st_cnt_throttled[col] = st.resource_counts_pod
@@ -188,7 +214,7 @@ class _KindState:
             self.st_req_flag_present[col, j] = True
             self.st_req_throttled[col, j] = flag
         self.thr_valid[col] = True
-        self.dirty_throttles = True
+        self._note_thr_col(col, before)
 
     def remove_throttle_row(self, key: str) -> None:
         col = self.index.throttle_col(key)
@@ -199,14 +225,15 @@ class _KindState:
             self.res_cnt_present[col] = False
             self.res_req[col, :] = 0
             self.res_req_present[col, :] = False
-            self.dirty_throttles = True
+            self._note_thr_col(col, (self.tcap, self.R))
 
     def set_reserved_row(self, key: str, amount: ResourceAmount) -> None:
         col = self.index.throttle_col(key)
         if col is None:
             return
-        self._amount_into_row(amount, self.res_cnt, self.res_cnt_present, self.res_req, self.res_req_present, col)
-        self.dirty_throttles = True
+        before = (self.tcap, self.R)
+        self._amount_into_row(amount, "res_cnt", "res_cnt_present", "res_req", "res_req_present", col)
+        self._note_thr_col(col, before)
 
     def encode_pod_requests_into(
         self, req: np.ndarray, present: np.ndarray, i: int, pod: Pod
@@ -233,24 +260,53 @@ class _KindState:
             self.pod_req, self.pod_present, row, pod
         )
         self.pod_valid[row] = True
-        if (self.pcap, self.R) == before and not self.dirty_pods:
-            self._dirty_pod_rows.add(row)  # incremental row scatter suffices
-        else:
-            self.dirty_pods = True
+        self._note_pod_row(row, before)
 
     def remove_pod_row(self, key: str) -> None:
         row = self.index.pod_row(key)
         self.index.remove_pod(key)
         if row is not None:
             self.pod_valid[row] = False
-            if not self.dirty_pods:
-                self._dirty_pod_rows.add(row)
+            self._note_pod_row(row, (self.pcap, self.R))
 
     # -- device sync ------------------------------------------------------
 
+    # (ThrottleState field, staging attribute) in constructor order
+    _THR_FIELDS = (
+        ("valid", "thr_valid"),
+        ("thr_cnt", "thr_cnt"), ("thr_cnt_present", "thr_cnt_present"),
+        ("thr_req", "thr_req"), ("thr_req_present", "thr_req_present"),
+        ("used_cnt", "used_cnt"), ("used_cnt_present", "used_cnt_present"),
+        ("used_req", "used_req"), ("used_req_present", "used_req_present"),
+        ("res_cnt", "res_cnt"), ("res_cnt_present", "res_cnt_present"),
+        ("res_req", "res_req"), ("res_req_present", "res_req_present"),
+        ("st_cnt_throttled", "st_cnt_throttled"),
+        ("st_req_throttled", "st_req_throttled"),
+        ("st_req_flag_present", "st_req_flag_present"),
+    )
+
     def device_state(self) -> ThrottleState:
         self.ensure_capacity()
-        if self.dirty_throttles or self._device_state is None:
+        if (
+            not self.dirty_throttles
+            and self._device_state is not None
+            and self._dirty_thr_cols
+            and len(self._dirty_thr_cols) <= self.row_scatter_max
+        ):
+            # single-throttle events: scatter only the touched rows of the
+            # 16 [T]/[T,R] tensors instead of re-uploading them all
+            cols = np.fromiter(self._dirty_thr_cols, dtype=np.int64)
+            s = self._device_state
+            self._device_state = ThrottleState(
+                **{
+                    field: getattr(s, field).at[cols].set(getattr(self, attr)[cols])
+                    for field, attr in self._THR_FIELDS
+                }
+            )
+            self._dirty_thr_cols.clear()
+            self._device_packed = None  # derived cache follows the state
+            return self._device_state
+        if self.dirty_throttles or self._device_state is None or self._dirty_thr_cols:
             self._device_state = ThrottleState(
                 valid=jnp.asarray(self.thr_valid),
                 thr_cnt=jnp.asarray(self.thr_cnt),
@@ -270,6 +326,7 @@ class _KindState:
                 st_req_flag_present=jnp.asarray(self.st_req_flag_present),
             )
             self.dirty_throttles = False
+            self._dirty_thr_cols.clear()
             self._device_packed = None  # derived cache follows the state
         return self._device_state
 
