@@ -308,22 +308,10 @@ class _KindState:
             return self._device_state
         if self.dirty_throttles or self._device_state is None or self._dirty_thr_cols:
             self._device_state = ThrottleState(
-                valid=jnp.asarray(self.thr_valid),
-                thr_cnt=jnp.asarray(self.thr_cnt),
-                thr_cnt_present=jnp.asarray(self.thr_cnt_present),
-                thr_req=jnp.asarray(self.thr_req),
-                thr_req_present=jnp.asarray(self.thr_req_present),
-                used_cnt=jnp.asarray(self.used_cnt),
-                used_cnt_present=jnp.asarray(self.used_cnt_present),
-                used_req=jnp.asarray(self.used_req),
-                used_req_present=jnp.asarray(self.used_req_present),
-                res_cnt=jnp.asarray(self.res_cnt),
-                res_cnt_present=jnp.asarray(self.res_cnt_present),
-                res_req=jnp.asarray(self.res_req),
-                res_req_present=jnp.asarray(self.res_req_present),
-                st_cnt_throttled=jnp.asarray(self.st_cnt_throttled),
-                st_req_throttled=jnp.asarray(self.st_req_throttled),
-                st_req_flag_present=jnp.asarray(self.st_req_flag_present),
+                **{
+                    field: jnp.asarray(getattr(self, attr))
+                    for field, attr in self._THR_FIELDS
+                }
             )
             self.dirty_throttles = False
             self._dirty_thr_cols.clear()
@@ -376,9 +364,7 @@ class _KindState:
                 ),
             )
             if not mask_rebuilt:
-                self._device_mask = self._device_mask.at[rows].set(
-                    np.asarray(self.index.mask[rows, :])
-                )
+                self._device_mask = self._device_mask.at[rows].set(self.index.mask[rows, :])
             self._dirty_pod_rows.clear()
         return self._device_pods, self._device_mask
 

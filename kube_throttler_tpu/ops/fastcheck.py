@@ -240,10 +240,11 @@ def fast_check_pod_packed(
 
     # one int64 compare plane: pod vs [thr_req, resid']. ``>=`` for step 4
     # under onEqual folds into ``>`` against resid-1 (exact in int64: resid
-    # is thr-(used+res), admission-scale magnitudes).
+    # is thr-(used+res), admission-scale magnitudes); the adjustment is an
+    # elementwise subtract, not a scatter.
     targets = g_vals
     if on_equal:
-        targets = targets.at[:, 1, :].add(-1)
+        targets = targets - jnp.array([0, 1], dtype=targets.dtype)[None, :, None]
     cmp = pod_req[None, None, :] > targets  # [K,2,R]
 
     sat_plane = g_planes[:, 2] if step3_on_equal else g_planes[:, 3]
