@@ -11,18 +11,32 @@ by slope: run N data-dependent chained iterations inside ONE dispatch
 (lax.fori_loop), materialize to host, and take (t(N2)-t(N1))/(N2-N1). The
 tunnel RTT is reported separately so co-located numbers can be projected.
 
+Resilience: the tunnel backend can be transiently unavailable. Before any
+in-process backend touch, a subprocess probe retries ``jax.devices()`` with
+bounded exponential backoff; if the platform never comes up the bench
+re-execs itself on CPU (degraded, flagged in the JSON). Every config is
+individually fenced so a single failure cannot cost the run its output:
+the final JSON line is ALWAYS printed.
+
 Run: python bench.py            (ambient platform — TPU in CI)
      python bench.py --quick    (scaled-down shapes for smoke runs)
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
+import traceback
 from datetime import datetime, timezone
 
 sys.path.insert(0, ".")
 
 import numpy as np
+
+from kube_throttler_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()  # must run before the first backend init
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +55,62 @@ GiB_m = 1024**3 * 1000  # 1Gi in milli-units
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------------- backend init
+
+
+def ensure_backend(max_wait: float = 300.0) -> bool:
+    """Probe backend availability in a SUBPROCESS with bounded retry/backoff.
+
+    The in-process backend cache is poisoned permanently by one failed init,
+    so never touch ``jax.devices()`` here until a throwaway process has
+    proven the platform is up. Returns True when the probe succeeds; False
+    when the deadline expires (caller degrades to CPU).
+    """
+    deadline = time.monotonic() + max_wait
+    delay, attempt = 2.0, 0
+    while True:
+        attempt += 1
+        try:
+            probe = (
+                f"import sys; sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+                "from kube_throttler_tpu.utils.platform import honor_jax_platforms_env\n"
+                "honor_jax_platforms_env()\n"
+                "import jax; jax.devices()"
+            )
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                timeout=max(1.0, min(300.0, deadline - time.monotonic())),
+            )
+            if r.returncode == 0:
+                if attempt > 1:
+                    log(f"backend probe succeeded on attempt {attempt}")
+                return True
+            err = r.stderr.decode(errors="replace").strip().splitlines()
+            log(f"backend probe attempt {attempt} failed: {err[-1] if err else 'rc!=0'}")
+        except subprocess.TimeoutExpired:
+            log(f"backend probe attempt {attempt} timed out")
+        if time.monotonic() + delay > deadline:
+            return False
+        log(f"retrying backend probe in {delay:.0f}s")
+        time.sleep(delay)
+        delay = min(delay * 2, 60.0)
+
+
+def init_devices_or_reexec():
+    """First in-process backend touch, fenced. If it still fails after the
+    probe said OK (tunnel dropped between probe and init), re-exec once on
+    CPU so the run produces a (degraded) result instead of a stack trace."""
+    try:
+        return jax.devices()
+    except Exception as e:  # backend cache is now poisoned; re-exec is the only recovery
+        if os.environ.get("KT_BENCH_CPU_FALLBACK") == "1":
+            raise
+        log(f"in-process backend init failed ({str(e)[:200]}); re-exec on CPU")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "KT_BENCH_CPU_FALLBACK": "1"}
+        os.execvpe(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
 
 
 # --------------------------------------------------------------- synthesis
@@ -117,12 +187,68 @@ def _host_time(fn, repeats=3):
 
 def device_time_per_iter(make_chained, n1=2, n2=12):
     """Slope timing: chained(n) runs n data-dependent iterations in one
-    dispatch; per-iteration device time = (t(n2)-t(n1))/(n2-n1)."""
+    dispatch; per-iteration device time = (t(n2)-t(n1))/(n2-n1). The
+    single-number (median) view of device_time_stats."""
+    return device_time_stats(make_chained, n1=n1, n2=n2, samples=3)["p50"]
+
+
+def device_time_stats(make_chained, n1=2, n2=12, samples=8):
+    """Repeated paired-slope estimates → distribution of per-iteration device
+    time. Each sample is an independent (t(n1), t(n2)) pair, so tunnel-RTT
+    jitter common to both dispatches cancels in the difference.
+
+    NOTE on what the percentiles mean: each slope sample averages (n2-n1)
+    chained device iterations, so this is the distribution of the slope
+    ESTIMATOR, not of individual decision latencies — per-decision device
+    tail cannot be observed through a ~66 ms tunnel RTT. True per-call tail
+    latency is measured separately on the host paths (host_percentiles).
+
+    Returns {mean, p50, p99, cv, samples}; cv = std/mean of the slope
+    samples — a noisy measurement (cv>0.5) is retried once with double the
+    samples and the top/bottom outliers dropped, and cv is recomputed."""
     f1, f2 = make_chained(n1), make_chained(n2)
     _host_time(f1, repeats=1)  # compile
     _host_time(f2, repeats=1)
-    t1, t2 = _host_time(f1), _host_time(f2)
-    return max((t2 - t1) / (n2 - n1), 1e-9)
+
+    def collect(k):
+        est = []
+        for _ in range(k):
+            # min-of-3 per endpoint: a single ms-scale tunnel-RTT spike on one
+            # dispatch would otherwise swing (or negate) a µs-scale slope
+            t1 = _host_time(f1, repeats=3)
+            t2 = _host_time(f2, repeats=3)
+            est.append(max((t2 - t1) / (n2 - n1), 1e-9))
+        return np.array(est)
+
+    est = collect(samples)
+    cv = float(est.std() / est.mean()) if est.mean() > 0 else 0.0
+    if cv > 0.5:  # noisy measurement: double the sample count, trim outliers
+        est = np.sort(np.concatenate([est, collect(samples)]))[1:-1]
+        cv = float(est.std() / est.mean()) if est.mean() > 0 else 0.0
+    return {
+        "mean": float(est.mean()),
+        "p50": float(np.percentile(est, 50)),
+        "p99": float(np.percentile(est, 99)),
+        "cv": cv,
+        "samples": int(len(est)),
+    }
+
+
+def host_percentiles(fn, n, warmup=50):
+    """True per-call latency distribution of a host-side function."""
+    for _ in range(min(warmup, n)):
+        fn()
+    times = np.empty(n)
+    for i in range(n):
+        t0 = time.perf_counter()
+        fn()
+        times[i] = time.perf_counter() - t0
+    return {
+        "mean": float(times.mean()),
+        "p50": float(np.percentile(times, 50)),
+        "p99": float(np.percentile(times, 99)),
+        "samples": n,
+    }
 
 
 def measure_dispatch_rtt():
@@ -275,12 +401,13 @@ def bench_single_pod_indexed(rng, state, T, R, label, K=64):
 
         return lambda: run(pre, pod_req, pod_present, idx, valid)
 
-    per_check = device_time_per_iter(make, n1=10, n2=500)
+    stats = device_time_stats(make, n1=10, n2=500, samples=12)
     log(
         f"[{label}] indexed single-pod check (K={K} gathered of T={T}): "
-        f"{per_check*1e6:.2f}us device time per decision"
+        f"{stats['mean']*1e6:.2f}us mean / {stats['p99']*1e6:.2f}us p99-of-slope device time "
+        f"per decision (cv={stats['cv']:.3f}, {stats['samples']} slope samples)"
     )
-    return per_check * 1e3
+    return stats
 
 
 def bench_streaming_batched(rng, T, R, label, n_events=1000):
@@ -430,16 +557,20 @@ def bench_example_scenario(label):
             pods.append(pod)
     plugin.run_pending_once()
 
-    n = 2000
-    t0 = time.perf_counter()
-    for i in range(n):
-        plugin.pre_filter(pods[i % len(pods)])
-    dt = time.perf_counter() - t0
+    i = [0]
+
+    def one():
+        plugin.pre_filter(pods[i[0] % len(pods)])
+        i[0] += 1
+
+    stats = host_percentiles(one, 2000)
     log(
         f"[{label}] example t1 + pods1-3, host-oracle PreFilter: "
-        f"{dt/n*1e6:.1f}us/decision ({n/dt:,.0f} decisions/sec)"
+        f"{stats['mean']*1e6:.1f}us mean / {stats['p99']*1e6:.1f}us p99 per decision "
+        f"({1/stats['mean']:,.0f} decisions/sec)"
     )
     plugin.stop()
+    return stats
 
 
 def bench_selector_index(label, T=10_000, n_pods=200):
@@ -500,51 +631,133 @@ def main():
     quick = "--quick" in sys.argv
     scale = 10 if quick else 1
     rng = np.random.default_rng(0)
-    log(f"devices: {jax.devices()}")
 
-    rtt = measure_dispatch_rtt()
-    log(f"dispatch round-trip (environment tunnel overhead): {rtt*1e3:.1f}ms")
+    detail: dict = {}
+    errors: dict = {}
+
+    def safe(name, fn, *a, **k):
+        """Fence one config: a failure records an error but never kills the run."""
+        try:
+            return fn(*a, **k)
+        except Exception as e:
+            log(f"[{name}] FAILED: {e.__class__.__name__}: {str(e)[:300]}")
+            log(traceback.format_exc(limit=4))
+            errors[name] = f"{e.__class__.__name__}: {str(e)[:200]}"
+            return None
+
+    if os.environ.get("KT_BENCH_CPU_FALLBACK") == "1":
+        # Already re-exec'd onto CPU after an in-process init failure; probing
+        # the down tunnel again would just burn the whole backoff budget.
+        degraded = True
+    else:
+        degraded = not ensure_backend(max_wait=120.0 if quick else 600.0)
+        if degraded:
+            log("backend never came up; degrading to CPU for this run")
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+    devices = safe("init", init_devices_or_reexec)
+    log(f"devices: {devices}")
+    platform = devices[0].platform if devices else "none"
+
+    rtt = safe("rtt", measure_dispatch_rtt) if devices else None
+    if rtt is not None:
+        log(f"dispatch round-trip (environment tunnel overhead): {rtt*1e3:.1f}ms")
+        detail["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
 
     R = 8
 
-    # config 1: the reference example scenario end-to-end (host path)
-    bench_example_scenario("cfg1:example")
-    bench_selector_index("host:index", T=10_000 // scale)
+    # config 1: the reference example scenario end-to-end (host path; device-free)
+    cfg1 = safe("cfg1", bench_example_scenario, "cfg1:example")
+    if cfg1:
+        detail["cfg1_host_prefilter_p99_us"] = round(cfg1["p99"] * 1e6, 1)
+    safe("host:index", bench_selector_index, "host:index", T=10_000 // scale)
 
-    # config 2: 1k pods x 100 throttles, 4 active dims
-    bench_batched(rng, 1000 // scale, 100, R, "cfg2:1kx100")
+    single_stats = None
+    if devices:
+        # config 2: 1k pods x 100 throttles, 4 active dims
+        safe("cfg2", bench_batched, rng, 1000 // scale, 100, R, "cfg2:1kx100")
 
-    # config 3: 10k x 1k
-    bench_batched(rng, 10_000 // scale, 1000 // scale, R, "cfg3:10kx1k")
+        # config 3: 10k x 1k
+        safe("cfg3", bench_batched, rng, 10_000 // scale, 1000 // scale, R, "cfg3:10kx1k")
 
-    # config 4: 100k x 10k with overrides (the headline)
-    P, T = 100_000 // scale, 10_000 // scale
-    bench_overrides(rng, T, 4, R, "cfg4:overrides")
-    state, batch, mask, dps, sweep_s = bench_batched(rng, P, T, R, "cfg4:100kx10k")
-    try:
-        bench_pallas_sweep(rng, P, T, R, "cfg4:100kx10k")
-    except Exception as e:  # pallas needs the TPU mosaic path; CPU runs skip
-        log(f"[cfg4:100kx10k] pallas sweep unavailable: {str(e)[:120]}")
-    bench_single_pod(rng, state, T, R, "cfg4:100kx10k")
-    single_ms = bench_single_pod_indexed(rng, state, T, R, "cfg4:100kx10k")
+        # config 4: 100k x 10k with overrides (the headline)
+        P, T = 100_000 // scale, 10_000 // scale
+        safe("cfg4:overrides", bench_overrides, rng, T, 4, R, "cfg4:overrides")
+        big = safe("cfg4:batched", bench_batched, rng, P, T, R, "cfg4:100kx10k")
+        if platform in ("tpu", "axon"):  # the tunnel backend names itself either way
+            safe("cfg4:pallas", bench_pallas_sweep, rng, P, T, R, "cfg4:100kx10k")
+        else:
+            log("[cfg4:pallas] skipped: pallas mosaic kernel needs the TPU backend")
+        if big is not None:
+            state = big[0]
+            safe("cfg4:single", bench_single_pod, rng, state, T, R, "cfg4:100kx10k")
+            single_stats = safe(
+                "cfg4:indexed", bench_single_pod_indexed, rng, state, T, R, "cfg4:100kx10k"
+            )
 
-    # config 5: streaming reconcile
-    bench_streaming(rng, T, R, "cfg5:streaming")
-    bench_streaming_batched(rng, T, R, "cfg5:streaming")
+        # config 5: streaming reconcile
+        eps_scan = safe("cfg5:scan", bench_streaming, rng, T, R, "cfg5:streaming")
+        eps_batch = safe("cfg5:batched", bench_streaming_batched, rng, T, R, "cfg5:streaming")
+        if eps_batch:
+            detail["cfg5_events_per_sec"] = round(eps_batch)
+        elif eps_scan:
+            detail["cfg5_events_per_sec"] = round(eps_scan)
 
     target_ms = 1.0  # BASELINE north star: <1ms p99 on one v5e-1
-    single_ms = max(float(single_ms), 1e-4)  # slope noise floor
-    print(
-        json.dumps(
-            {
-                "metric": "PreFilter decision latency, single pod vs 100k-pod/10k-throttle state (device time, 1 chip)",
-                "value": round(single_ms, 4),
-                "unit": "ms",
-                "vs_baseline": round(target_ms / single_ms, 3),
-            }
+    if single_stats is not None:
+        value_ms = max(float(single_stats["p99"]) * 1e3, 1e-4)  # slope noise floor
+        detail["single_mean_ms"] = round(max(single_stats["mean"] * 1e3, 1e-4), 4)
+        detail["single_cv"] = round(single_stats["cv"], 4)
+        metric = (
+            "PreFilter decision latency, single pod vs 100k-pod/10k-throttle state "
+            f"(p99 over slope estimates, device time, 1 {platform} chip)"
         )
-    )
+    elif cfg1 is not None:
+        # device headline config unavailable (backend down, or cfg4 itself
+        # failed — see `errors`): fall back to the honest host-path p99 so the
+        # round still records a real measurement rather than nothing.
+        value_ms = cfg1["p99"] * 1e3
+        metric = "PreFilter decision p99 latency, host-oracle path (device headline config unavailable)"
+    else:
+        value_ms, metric = -1.0, "bench failed; see errors"
+
+    # vs_baseline compares against the DEVICE-path north star; a host-only
+    # fallback number is not comparable and must not record a fake win.
+    comparable = single_stats is not None and value_ms > 0
+    out = {
+        "metric": metric,
+        "value": round(value_ms, 4),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / value_ms, 3) if comparable else 0.0,
+        "p99_ms": round(value_ms, 4),
+        "platform": platform,
+        "degraded": degraded,
+        **detail,
+    }
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # absolute last resort: never exit without the JSON line
+        if isinstance(e, SystemExit) and not e.code:
+            raise
+        log(traceback.format_exc())
+        print(
+            json.dumps(
+                {
+                    "metric": "bench crashed",
+                    "value": -1.0,
+                    "unit": "ms",
+                    "vs_baseline": 0.0,
+                    "error": f"{e.__class__.__name__}: {str(e)[:300]}",
+                }
+            )
+        )
+        sys.exit(130 if isinstance(e, KeyboardInterrupt) else 1)
