@@ -37,6 +37,12 @@ class ControllerBase:
         self.threadiness = threadiness
         self.workqueue = RateLimitingQueue(name, clock=self.clock)
         self.reconcile_func: Callable[[str], None] = lambda key: None
+        # optional batched reconcile: a worker drains up to batch_max ready
+        # keys and hands them over in one call, so a shared step (the device
+        # used-aggregate flush+gather) is paid once per drain, not per key.
+        # Returns {key: exception} for the keys to requeue.
+        self.reconcile_batch_func: Optional[Callable[[List[str]], dict]] = None
+        self.batch_max = 256
         # phase tracer (utils.tracing.PhaseTracer); set by the plugin so
         # reconcile latency lands in the same histogram family as the hot path
         self.tracer = NoopTracer()
@@ -68,24 +74,50 @@ class ControllerBase:
     def enqueue_after(self, key: str, duration: timedelta) -> None:
         self.workqueue.add_after(key, duration)
 
+    def _process_batch(self, keys: List[str]) -> None:
+        """Run the (batched) reconcile for drained keys; requeue failures
+        rate-limited (controller.go:106-108), forget successes."""
+        failures: dict = {}
+        try:
+            vlog(4, "%s: reconciling batch %r", self.name, keys)
+            with self.tracer.trace("reconcile"):
+                if self.reconcile_batch_func is not None:
+                    failures = self.reconcile_batch_func(keys) or {}
+                else:
+                    for key in keys:
+                        try:
+                            self.reconcile_func(key)
+                        except Exception as e:
+                            failures[key] = e
+        except Exception as e:  # batch-level crash fails every key
+            failures = {key: e for key in keys}
+        for key in keys:
+            if key in failures:
+                self.workqueue.add_rate_limited(key)
+                logger.error(
+                    "error reconciling %r, requeuing", key, exc_info=failures[key]
+                )
+            else:
+                self.workqueue.forget(key)
+            self.workqueue.done(key)
+
+    def _drain_more(self, first: str) -> List[str]:
+        keys = [first]
+        if self.reconcile_batch_func is not None:
+            while len(keys) < self.batch_max:
+                nxt = self.workqueue.try_get()
+                if nxt is None:
+                    break
+                keys.append(nxt)
+        return keys
+
     def _run_worker(self) -> None:
         while True:
             try:
                 key = self.workqueue.get()
             except ShutDown:
                 return
-            try:
-                vlog(4, "%s: reconciling %r", self.name, key)
-                with self.tracer.trace("reconcile"):
-                    self.reconcile_func(key)
-            except Exception:
-                # error → rate-limited requeue (controller.go:106-108)
-                self.workqueue.add_rate_limited(key)
-                logger.exception("error reconciling %r, requeuing", key)
-            else:
-                self.workqueue.forget(key)
-            finally:
-                self.workqueue.done(key)
+            self._process_batch(self._drain_more(key))
 
     def run_pending_once(self, max_items: int = 10000) -> int:
         """Synchronously drain currently-ready queue items on the calling
@@ -94,15 +126,7 @@ class ControllerBase:
         n = 0
         while len(self.workqueue) > 0 and n < max_items:
             key = self.workqueue.get(timeout=0.01)
-            try:
-                with self.tracer.trace("reconcile"):
-                    self.reconcile_func(key)
-            except Exception:
-                self.workqueue.add_rate_limited(key)
-                logger.exception("error reconciling %r, requeuing", key)
-            else:
-                self.workqueue.forget(key)
-            finally:
-                self.workqueue.done(key)
-            n += 1
+            keys = self._drain_more(key)
+            self._process_batch(keys)
+            n += len(keys)
         return n

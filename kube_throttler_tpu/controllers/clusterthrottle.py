@@ -62,6 +62,7 @@ class ClusterThrottleController(ControllerBase):
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
+        self.reconcile_batch_func = self.reconcile_batch
         self._setup_event_handlers()
 
     def is_responsible_for(self, thr: ClusterThrottle) -> bool:
@@ -75,18 +76,61 @@ class ClusterThrottleController(ControllerBase):
     # ------------------------------------------------------------- reconcile
 
     def reconcile(self, key: str) -> None:
+        errors = self.reconcile_batch([key])
+        if errors:
+            raise errors[key]
+
+    def reconcile_batch(self, keys: List[str]) -> Dict[str, Exception]:
+        """Batched twin of ThrottleController.reconcile_batch: one device
+        flush+gather of the used-aggregates serves the whole drained batch."""
         now = self.clock.now()
-        try:
-            thr = self.store.get_cluster_throttle(key.lstrip("/"))
-        except NotFoundError:
-            return
+        thrs: Dict[str, ClusterThrottle] = {}
+        for key in dict.fromkeys(keys):
+            try:
+                thrs[key] = self.store.get_cluster_throttle(key.lstrip("/"))
+            except NotFoundError:
+                pass
+        if not thrs:
+            return {}
+        errors: Dict[str, Exception] = {}
+        used_map = None
+        if self.device_manager is not None:
+            try:
+                reserved = {
+                    t.key: self.cache.reserved_pod_keys(t.key) for t in thrs.values()
+                }
+                used_map = self.device_manager.aggregate_used_for(
+                    self.KIND, [t.key for t in thrs.values()], reserved
+                )
+            except Exception as e:
+                return {key: e for key in keys}
+        for key, thr in thrs.items():
+            try:
+                if used_map is not None:
+                    used, unreserve_pods = used_map[thr.key]
+                    self._finish_reconcile(key, thr, used, now, None, None, unreserve_pods)
+                else:
+                    non_terminated, terminated = self.affected_pods(thr)
+                    used = ResourceAmount()
+                    for p in non_terminated:
+                        used = used.add(resource_amount_of_pod(p))
+                    self._finish_reconcile(
+                        key, thr, used, now, non_terminated, terminated, None
+                    )
+            except Exception as e:
+                errors[key] = e
+        return errors
 
-        non_terminated, terminated = self.affected_pods(thr)
-
-        used = ResourceAmount()
-        for p in non_terminated:
-            used = used.add(resource_amount_of_pod(p))
-
+    def _finish_reconcile(
+        self,
+        key: str,
+        thr: ClusterThrottle,
+        used: ResourceAmount,
+        now,
+        non_terminated: Optional[List[Pod]],
+        terminated: Optional[List[Pod]],
+        unreserve_pods: Optional[List[Pod]] = None,
+    ) -> None:
         calculated = thr.spec.calculate_threshold(now)
         new_calculated = thr.status.calculated_threshold
         if (
@@ -101,8 +145,14 @@ class ClusterThrottleController(ControllerBase):
         )
 
         def unreserve_affected() -> None:
-            for p in non_terminated + terminated:
-                self.unreserve_on_throttle(p, thr)
+            # see ThrottleController._finish_reconcile: the device-path set
+            # is snapshot-coherent with the aggregate
+            if non_terminated is not None:
+                for p in non_terminated + terminated:
+                    self.unreserve_on_throttle(p, thr)
+            else:
+                for p in unreserve_pods:
+                    self.unreserve_on_throttle(p, thr)
 
         if new_status != thr.status:
             self.store.update_cluster_throttle_status(thr.with_status(new_status))
@@ -121,20 +171,27 @@ class ClusterThrottleController(ControllerBase):
     # ----------------------------------------------------------- collections
 
     def affected_pods(self, thr: ClusterThrottle) -> Tuple[List[Pod], List[Pod]]:
-        ns_map = {}
-        pods: List[Pod] = []
-        for ns in self.store.list_namespaces():
-            if not thr.spec.selector.matches_to_namespace(ns):
-                continue
-            ns_map[ns.name] = ns
-            pods.extend(self.store.list_pods(ns.name))
-
         non_terminated: List[Pod] = []
         terminated: List[Pod] = []
+        if self.device_manager is not None:
+            # the mask column already ANDs podSelector ∧ namespaceSelector ∧
+            # namespace-existence (clusterthrottle_selector.go:112-141)
+            pods = self.device_manager.matched_pods(self.KIND, thr.key)
+        else:
+            ns_map = {}
+            pods = []
+            for ns in self.store.list_namespaces():
+                if not thr.spec.selector.matches_to_namespace(ns):
+                    continue
+                ns_map[ns.name] = ns
+                pods.extend(self.store.list_pods(ns.name))
+            pods = [
+                p
+                for p in pods
+                if thr.spec.selector.matches_to_pod(p, ns_map[p.namespace])
+            ]
         for pod in pods:
             if not self.should_count_in(pod):
-                continue
-            if not thr.spec.selector.matches_to_pod(pod, ns_map[pod.namespace]):
                 continue
             if pod.is_not_finished():
                 non_terminated.append(pod)
@@ -142,11 +199,33 @@ class ClusterThrottleController(ControllerBase):
                 terminated.append(pod)
         return non_terminated, terminated
 
+    def affected_cluster_throttle_keys(self, pod: Pod) -> List[str]:
+        ns = self.store.get_namespace(pod.namespace)
+        if ns is None:
+            # Go: lister Get error propagates (clusterthrottle_controller.go:273-276)
+            raise NotFoundError(f"namespace {pod.namespace!r} not found")
+        if self.device_manager is not None:
+            return self.device_manager.affected_throttle_keys(self.KIND, pod)
+        return [t.key for t in self._scan_cluster_throttles(pod, ns)]
+
     def affected_cluster_throttles(self, pod: Pod) -> List[ClusterThrottle]:
         ns = self.store.get_namespace(pod.namespace)
         if ns is None:
             # Go: lister Get error propagates (clusterthrottle_controller.go:273-276)
             raise NotFoundError(f"namespace {pod.namespace!r} not found")
+        if self.device_manager is not None:
+            affected = []
+            for key in self.device_manager.affected_throttle_keys(self.KIND, pod):
+                try:
+                    thr = self.store.get_cluster_throttle(key.lstrip("/"))
+                except NotFoundError:
+                    continue
+                if self.is_responsible_for(thr):
+                    affected.append(thr)
+            return affected
+        return self._scan_cluster_throttles(pod, ns)
+
+    def _scan_cluster_throttles(self, pod: Pod, ns) -> List[ClusterThrottle]:
         affected = []
         for thr in self.store.list_cluster_throttles():
             if not self.is_responsible_for(thr):
@@ -235,15 +314,15 @@ class ClusterThrottleController(ControllerBase):
             pod = event.obj
             if not self.should_count_in(pod):
                 return
-            for thr in self._affected_or_log(pod):
-                self.enqueue(thr.key)
+            for key in self._affected_keys_or_log(pod):
+                self.enqueue(key)
         elif event.type == EventType.MODIFIED:
             old_pod, new_pod = event.old_obj, event.obj
             if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
                 return
             try:
-                old_keys = {t.key for t in self.affected_cluster_throttles(old_pod)}
-                new_keys = {t.key for t in self.affected_cluster_throttles(new_pod)}
+                old_keys = set(self.affected_cluster_throttle_keys(old_pod))
+                new_keys = set(self.affected_cluster_throttle_keys(new_pod))
             except NotFoundError:
                 logger.exception("failed to get affected clusterthrottles for %s", new_pod.key)
                 return
@@ -265,12 +344,12 @@ class ClusterThrottleController(ControllerBase):
                     self.unreserve(pod)
                 except Exception:
                     logger.exception("failed to unreserve deleted pod %s", pod.key)
-            for thr in self._affected_or_log(pod):
-                self.enqueue(thr.key)
+            for key in self._affected_keys_or_log(pod):
+                self.enqueue(key)
 
-    def _affected_or_log(self, pod: Pod) -> List[ClusterThrottle]:
+    def _affected_keys_or_log(self, pod: Pod) -> List[str]:
         try:
-            return self.affected_cluster_throttles(pod)
+            return self.affected_cluster_throttle_keys(pod)
         except NotFoundError:
             logger.exception("failed to get affected clusterthrottles for %s", pod.key)
             return []

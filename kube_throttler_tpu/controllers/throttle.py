@@ -62,6 +62,7 @@ class ThrottleController(ControllerBase):
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
+        self.reconcile_batch_func = self.reconcile_batch
         self._setup_event_handlers()
 
     # ------------------------------------------------------------ predicates
@@ -77,19 +78,62 @@ class ThrottleController(ControllerBase):
     # ------------------------------------------------------------- reconcile
 
     def reconcile(self, key: str) -> None:
+        errors = self.reconcile_batch([key])
+        if errors:
+            raise errors[key]
+
+    def reconcile_batch(self, keys: List[str]) -> Dict[str, Exception]:
+        """Reconcile a drained batch of keys: with a device manager, ONE
+        flush+gather of the device used-aggregates serves every key (the
+        streaming data plane — no per-throttle pod scan); per-key status
+        writes are individually fenced. Returns failures for requeue."""
         now = self.clock.now()
-        namespace, _, name = key.partition("/")
-        try:
-            thr = self.store.get_throttle(namespace, name)
-        except NotFoundError:
-            return  # deleted — nothing to do (throttle_controller.go:96-99)
+        thrs: Dict[str, Throttle] = {}
+        for key in dict.fromkeys(keys):
+            namespace, _, name = key.partition("/")
+            try:
+                thrs[key] = self.store.get_throttle(namespace, name)
+            except NotFoundError:
+                pass  # deleted — nothing to do (throttle_controller.go:96-99)
+        if not thrs:
+            return {}
+        errors: Dict[str, Exception] = {}
+        used_map = None
+        if self.device_manager is not None:
+            try:
+                reserved = {key: self.cache.reserved_pod_keys(key) for key in thrs}
+                used_map = self.device_manager.aggregate_used_for(
+                    self.KIND, list(thrs), reserved
+                )
+            except Exception as e:  # device failure fails the whole batch
+                return {key: e for key in keys}
+        for key, thr in thrs.items():
+            try:
+                if used_map is not None:
+                    used, unreserve_pods = used_map[key]
+                    self._finish_reconcile(key, thr, used, now, None, None, unreserve_pods)
+                else:
+                    non_terminated, terminated = self.affected_pods(thr)
+                    used = ResourceAmount()
+                    for p in non_terminated:
+                        used = used.add(resource_amount_of_pod(p))
+                    self._finish_reconcile(
+                        key, thr, used, now, non_terminated, terminated, None
+                    )
+            except Exception as e:
+                errors[key] = e
+        return errors
 
-        non_terminated, terminated = self.affected_pods(thr)
-
-        used = ResourceAmount()
-        for p in non_terminated:
-            used = used.add(resource_amount_of_pod(p))
-
+    def _finish_reconcile(
+        self,
+        key: str,
+        thr: Throttle,
+        used: ResourceAmount,
+        now,
+        non_terminated: Optional[List[Pod]],
+        terminated: Optional[List[Pod]],
+        unreserve_pods: Optional[List[Pod]] = None,
+    ) -> None:
         calculated = thr.spec.calculate_threshold(now)
         new_calculated = thr.status.calculated_threshold
         if (
@@ -108,9 +152,16 @@ class ThrottleController(ControllerBase):
 
         def unreserve_affected() -> None:
             # after the status write, observed pods are safe to un-reserve;
-            # terminated pods too (throttle_controller.go:135-155)
-            for p in non_terminated + terminated:
-                self.unreserve_on_throttle(p, thr)
+            # terminated pods too (throttle_controller.go:135-155). The
+            # device path's set (reserved ∩ shouldCountIn ∩ matched) was
+            # computed under the SAME snapshot as the aggregate — unreserve
+            # is a no-op for non-reserved pods, so the sets are equivalent.
+            if non_terminated is not None:
+                for p in non_terminated + terminated:
+                    self.unreserve_on_throttle(p, thr)
+            else:
+                for p in unreserve_pods:
+                    self.unreserve_on_throttle(p, thr)
 
         if new_status != thr.status:
             self.store.update_throttle_status(thr.with_status(new_status))
@@ -131,10 +182,19 @@ class ThrottleController(ControllerBase):
     def affected_pods(self, thr: Throttle) -> Tuple[List[Pod], List[Pod]]:
         non_terminated: List[Pod] = []
         terminated: List[Pod] = []
-        for pod in self.store.list_pods(thr.namespace):
+        if self.device_manager is not None:
+            # selector part answered by the incremental mask column — only
+            # matched pods are touched, never the whole namespace
+            pods = self.device_manager.matched_pods(self.KIND, thr.key)
+            pods = [p for p in pods if p.namespace == thr.namespace]
+        else:
+            pods = [
+                p
+                for p in self.store.list_pods(thr.namespace)
+                if thr.spec.selector.matches_to_pod(p)
+            ]
+        for pod in pods:
             if not self.should_count_in(pod):
-                continue
-            if not thr.spec.selector.matches_to_pod(pod):
                 continue
             if pod.is_not_finished():
                 non_terminated.append(pod)
@@ -142,7 +202,23 @@ class ThrottleController(ControllerBase):
                 terminated.append(pod)
         return non_terminated, terminated
 
+    def affected_throttle_keys(self, pod: Pod) -> List[str]:
+        if self.device_manager is not None:
+            return self.device_manager.affected_throttle_keys(self.KIND, pod)
+        return [t.key for t in self.affected_throttles(pod)]
+
     def affected_throttles(self, pod: Pod) -> List[Throttle]:
+        if self.device_manager is not None:
+            affected = []
+            for key in self.device_manager.affected_throttle_keys(self.KIND, pod):
+                namespace, _, name = key.partition("/")
+                try:
+                    thr = self.store.get_throttle(namespace, name)
+                except NotFoundError:
+                    continue
+                if self.is_responsible_for(thr):
+                    affected.append(thr)
+            return affected
         affected = []
         for thr in self.store.list_throttles(pod.namespace):
             if not self.is_responsible_for(thr):
@@ -229,14 +305,14 @@ class ThrottleController(ControllerBase):
             pod = event.obj
             if not self.should_count_in(pod):
                 return
-            for thr in self.affected_throttles(pod):
-                self.enqueue(thr.key)
+            for key in self.affected_throttle_keys(pod):
+                self.enqueue(key)
         elif event.type == EventType.MODIFIED:
             old_pod, new_pod = event.old_obj, event.obj
             if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
                 return
-            old_keys = {t.key for t in self.affected_throttles(old_pod)}
-            new_keys = {t.key for t in self.affected_throttles(new_pod)}
+            old_keys = set(self.affected_throttle_keys(old_pod))
+            new_keys = set(self.affected_throttle_keys(new_pod))
             moved_from = old_keys - new_keys
             moved_to = new_keys - old_keys
             if moved_from or moved_to:
@@ -259,5 +335,5 @@ class ThrottleController(ControllerBase):
                     self.unreserve(pod)
                 except Exception:
                     logger.exception("failed to unreserve deleted pod %s", pod.key)
-            for thr in self.affected_throttles(pod):
-                self.enqueue(thr.key)
+            for key in self.affected_throttle_keys(pod):
+                self.enqueue(key)

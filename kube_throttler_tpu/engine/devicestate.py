@@ -66,10 +66,35 @@ class _KindState:
         # beyond this many pending rows a full upload is cheaper
         self.row_scatter_max = 256
 
+        # --- live used-aggregation state (reconcile data plane) ----------
+        # Device-resident running aggregates of status.used per throttle
+        # column, fed by pod-event deltas (apply_pod_deltas_batched) with
+        # per-column rebases on selector/threshold edits and a full
+        # aggregate_used rebase on namespace/capacity changes. Replaces the
+        # reference's per-reconcile O(P_ns) pod scan
+        # (throttle_controller.go:103-119).
+        self.agg_cnt = None  # int64[T] on device
+        self.agg_req = None  # int64[T,R] on device
+        self.agg_contrib = None  # int32[T,R] on device
+        self._agg_full_rebase = True
+        self._agg_rebase_cols: set = set()
+        # pending (cols int32[k], sign ±1, req int64[R'], present bool[R'])
+        self._agg_pending: list = []
+        self._agg_pending_max = 8192
+        self._delta_old = None  # snapshot between capture begin/end
+        self._counted_device = None
+        self._counted_dirty = True
+
     def _alloc_pods(self, pcap: int) -> None:
         self.pod_req = np.zeros((pcap, self.R), dtype=np.int64)
         self.pod_present = np.zeros((pcap, self.R), dtype=bool)
         self.pod_valid = np.zeros(pcap, dtype=bool)
+        # shouldCountIn ∧ is_not_finished per row — membership of status.used
+        self.counted = np.zeros(pcap, dtype=bool)
+        # shouldCountIn alone (phase-independent) — membership of the
+        # reconcile unreserve walk, which includes terminated pods
+        # (throttle_controller.go:135-155)
+        self.count_in = np.zeros(pcap, dtype=bool)
         self.pcap = pcap
 
     def _alloc_throttles(self, tcap: int) -> None:
@@ -114,11 +139,14 @@ class _KindState:
                 grown = np.zeros((pcap,) + arr.shape[1:], dtype=arr.dtype)
                 grown[: arr.shape[0]] = arr
                 setattr(self, name, grown)
-            grown_valid = np.zeros(pcap, dtype=bool)
-            grown_valid[: self.pod_valid.shape[0]] = self.pod_valid
-            self.pod_valid = grown_valid
+            for name in ("pod_valid", "counted", "count_in"):
+                arr = getattr(self, name)
+                grown = np.zeros(pcap, dtype=bool)
+                grown[: arr.shape[0]] = arr
+                setattr(self, name, grown)
             self.pcap = pcap
             self.dirty_pods = True
+            self._counted_dirty = True
         if tcap != self.tcap:
             old = self.tcap
             for name in (
@@ -192,7 +220,7 @@ class _KindState:
         else:
             self.dirty_pods = True
 
-    def set_throttle_row(self, thr: AnyThrottle) -> None:
+    def set_throttle_row(self, thr: AnyThrottle) -> int:
         from ..api.types import effective_threshold
 
         col = self.index.upsert_throttle(thr)
@@ -215,8 +243,9 @@ class _KindState:
             self.st_req_throttled[col, j] = flag
         self.thr_valid[col] = True
         self._note_thr_col(col, before)
+        return col
 
-    def remove_throttle_row(self, key: str) -> None:
+    def remove_throttle_row(self, key: str) -> Optional[int]:
         col = self.index.throttle_col(key)
         self.index.remove_throttle(key)
         if col is not None:
@@ -226,6 +255,7 @@ class _KindState:
             self.res_req[col, :] = 0
             self.res_req_present[col, :] = False
             self._note_thr_col(col, (self.tcap, self.R))
+        return col
 
     def set_reserved_row(self, key: str, amount: ResourceAmount) -> None:
         col = self.index.throttle_col(key)
@@ -252,7 +282,7 @@ class _KindState:
             present[i, j] = True
         return req, present
 
-    def set_pod_row(self, pod: Pod) -> None:
+    def set_pod_row(self, pod: Pod, counted: bool = False, count_in: bool = False) -> None:
         row = self.index.upsert_pod(pod)
         before = (self.pcap, self.R)
         self.ensure_capacity()
@@ -260,6 +290,10 @@ class _KindState:
             self.pod_req, self.pod_present, row, pod
         )
         self.pod_valid[row] = True
+        self.count_in[row] = count_in
+        if self.counted[row] != counted:
+            self.counted[row] = counted
+            self._counted_dirty = True
         self._note_pod_row(row, before)
 
     def remove_pod_row(self, key: str) -> None:
@@ -267,6 +301,10 @@ class _KindState:
         self.index.remove_pod(key)
         if row is not None:
             self.pod_valid[row] = False
+            self.count_in[row] = False
+            if self.counted[row]:
+                self.counted[row] = False
+                self._counted_dirty = True
             self._note_pod_row(row, (self.pcap, self.R))
 
     # -- device sync ------------------------------------------------------
@@ -371,6 +409,130 @@ class _KindState:
     def refresh_mask(self) -> None:
         self._device_mask = None
 
+    # -- live used-aggregation (the reconcile data plane) ------------------
+
+    def _pod_contribution(self, pod_key: str):
+        """Snapshot of a pod's current contribution to the aggregates:
+        (cols, req copy, present copy), or None if it contributes nothing."""
+        row = self.index.pod_row(pod_key)
+        if row is None or not self.pod_valid[row] or not self.counted[row]:
+            return None
+        cols = np.nonzero(self.index.mask[row, :])[0].astype(np.int32)
+        if cols.size == 0:
+            return None
+        return (cols, self.pod_req[row].copy(), self.pod_present[row].copy())
+
+    def capture_pod_delta_begin(self, pod_key: str) -> None:
+        self._delta_old = self._pod_contribution(pod_key)
+
+    def capture_pod_delta_end(self, pod_key: str) -> None:
+        old, self._delta_old = self._delta_old, None
+        new = self._pod_contribution(pod_key)
+        if old is not None and new is not None:
+            if (
+                np.array_equal(old[0], new[0])
+                and np.array_equal(old[1], new[1])
+                and np.array_equal(old[2], new[2])
+            ):
+                return  # no contribution change (e.g. status-only update)
+        if old is None and new is None:
+            return
+        if old is not None:
+            self._agg_pending.append((old[0], -1, old[1], old[2]))
+        if new is not None:
+            self._agg_pending.append((new[0], +1, new[1], new[2]))
+        if len(self._agg_pending) > self._agg_pending_max:
+            # a burst this large is cheaper as one full masked reduction
+            self._agg_full_rebase = True
+            self._agg_pending.clear()
+
+    def mark_col_rebase(self, col: Optional[int]) -> None:
+        """A throttle add/update/delete changed column membership — its
+        incremental aggregate is invalid; recompute it at next flush."""
+        if col is not None:
+            self._agg_rebase_cols.add(int(col))
+
+    def mark_full_rebase(self) -> None:
+        self._agg_full_rebase = True
+        self._agg_pending.clear()
+        self._agg_rebase_cols.clear()
+
+    @staticmethod
+    def _bucket(n: int, lo: int = 8) -> int:
+        k = lo
+        while k < n:
+            k *= 2
+        return k
+
+    def _device_counted(self):
+        if (
+            self._counted_device is None
+            or self._counted_dirty
+            or self._counted_device.shape != (self.pcap,)
+        ):
+            self._counted_device = jnp.asarray(self.counted & self.pod_valid)
+            self._counted_dirty = False
+        return self._counted_device
+
+    def flush_agg(self) -> None:
+        """Land all pending aggregate maintenance on device: col rebases and
+        the pod-delta burst each cost ONE dispatch (apply_pod_deltas_batched /
+        rebase_cols); a full rebase is one masked aggregate_used reduction."""
+        from ..ops.aggregate import aggregate_used, apply_pod_deltas_batched, rebase_cols
+
+        self.ensure_capacity()
+        pods, mask = self.device_pods()
+        counted = self._device_counted()
+        shapes_ok = (
+            self.agg_cnt is not None
+            and self.agg_cnt.shape == (self.tcap,)
+            and self.agg_req.shape == (self.tcap, self.R)
+        )
+        if self._agg_full_rebase or not shapes_ok:
+            self.agg_cnt, self.agg_req, self.agg_contrib = aggregate_used(
+                pods, mask, counted
+            )
+            self._agg_full_rebase = False
+            self._agg_pending.clear()
+            self._agg_rebase_cols.clear()
+            return
+        if self._agg_rebase_cols:
+            # deltas targeting a rebased column are subsumed by the rebase
+            # (it reads current state) — drop them or they double-count
+            rb = self._agg_rebase_cols
+            kept = []
+            for cols, sign, req, present in self._agg_pending:
+                cols_kept = cols[~np.isin(cols, list(rb))]
+                if cols_kept.size:
+                    kept.append((cols_kept, sign, req, present))
+            self._agg_pending = kept
+            arr = np.fromiter(rb, dtype=np.int32, count=len(rb))
+            k = self._bucket(arr.size)
+            cols_pad = np.full(k, self.tcap, dtype=np.int32)
+            cols_pad[: arr.size] = arr
+            self.agg_cnt, self.agg_req, self.agg_contrib = rebase_cols(
+                self.agg_cnt, self.agg_req, self.agg_contrib,
+                pods, mask, counted, cols_pad,
+            )
+            self._agg_rebase_cols.clear()
+        if self._agg_pending:
+            n = len(self._agg_pending)
+            kmax = self._bucket(max(c.size for c, _, _, _ in self._agg_pending), lo=4)
+            nb = self._bucket(n)
+            ids = np.full((nb, kmax), self.tcap, dtype=np.int32)
+            signs = np.zeros((nb, kmax), dtype=np.int64)
+            reqs = np.zeros((nb, self.R), dtype=np.int64)
+            presents = np.zeros((nb, self.R), dtype=bool)
+            for i, (cols, sign, req, present) in enumerate(self._agg_pending):
+                ids[i, : cols.size] = cols
+                signs[i, : cols.size] = sign
+                reqs[i, : req.shape[0]] = req  # pad if R grew since capture
+                presents[i, : present.shape[0]] = present
+            self.agg_cnt, self.agg_req, self.agg_contrib = apply_pod_deltas_batched(
+                self.agg_cnt, self.agg_req, self.agg_contrib, ids, signs, reqs, presents
+            )
+            self._agg_pending.clear()
+
 
 class DeviceStateManager:
     """Wires both kinds' staging to a Store and serves batched checks."""
@@ -406,14 +568,24 @@ class DeviceStateManager:
             for ks in (self.throttle, self.clusterthrottle):
                 ks.index.upsert_namespace(event.obj)
                 ks.refresh_mask()
+            # namespace (re)definition can flip many clusterthrottle mask
+            # rows at once — the incremental aggregate cannot follow that
+            self.clusterthrottle.mark_full_rebase()
 
     def _on_pod(self, event: Event) -> None:
+        pod = event.obj
+        count_in = (
+            pod.spec.scheduler_name == self.target_scheduler_name and pod.is_scheduled()
+        )
+        counted = count_in and pod.is_not_finished()
         with self._lock:
             for ks in (self.throttle, self.clusterthrottle):
+                ks.capture_pod_delta_begin(pod.key)
                 if event.type == EventType.DELETED:
-                    ks.remove_pod_row(event.obj.key)
+                    ks.remove_pod_row(pod.key)
                 else:
-                    ks.set_pod_row(event.obj)
+                    ks.set_pod_row(pod, counted=counted, count_in=count_in)
+                ks.capture_pod_delta_end(pod.key)
                 # no refresh_mask: a pod event only changes its own mask row,
                 # which the incremental row scatter ships
 
@@ -425,9 +597,10 @@ class DeviceStateManager:
                 # also handles a throttlerName edit AWAY from this throttler:
                 # the mirrored row must disappear, or it would keep blocking
                 # pods this throttler no longer governs
-                ks.remove_throttle_row(thr.key)
+                col = ks.remove_throttle_row(thr.key)
             else:
-                ks.set_throttle_row(thr)
+                col = ks.set_throttle_row(thr)
+            ks.mark_col_rebase(col)
             ks.refresh_mask()
 
     def _on_throttle(self, event: Event) -> None:
@@ -443,6 +616,104 @@ class DeviceStateManager:
         with self._lock:
             ks = self.throttle if kind == "throttle" else self.clusterthrottle
             ks.set_reserved_row(throttle_key, amount)
+
+    def _kind(self, kind: str) -> _KindState:
+        return self.throttle if kind == "throttle" else self.clusterthrottle
+
+    # -- index-backed collection queries (replace the O(T)/O(P) store scans
+    # of throttle_controller.go:221-269) ----------------------------------
+
+    def affected_throttle_keys(self, kind: str, pod: Pod) -> List[str]:
+        """affectedThrottles via the incremental mask: O(K) when the queried
+        object is the indexed one, a fresh compiled-row evaluation otherwise
+        (old side of a MODIFIED event, or a pod not yet stored)."""
+        with self._lock:
+            return self._kind(kind).index.affected_throttle_keys_for(pod)
+
+    def matched_pods(self, kind: str, throttle_key: str) -> List[Pod]:
+        """affectedPods' selector part via the mask column (latest objects)."""
+        with self._lock:
+            return self._kind(kind).index.matched_pods(throttle_key)
+
+    def indexed_pod(self, kind: str, pod_key: str) -> Optional[Pod]:
+        with self._lock:
+            return self._kind(kind).index.indexed_pod(pod_key)
+
+    # -- used aggregation (replaces reconcile's per-throttle pod-sum loop,
+    # throttle_controller.go:103-119) -------------------------------------
+
+    def aggregate_used_for(
+        self,
+        kind: str,
+        keys: Sequence[str],
+        reserved: Optional[Dict[str, set]] = None,
+    ) -> Dict[str, Tuple[ResourceAmount, List[Pod]]]:
+        """status.used for the given throttles from the device aggregates,
+        plus — per throttle — the reserved pods eligible for the reconcile
+        unreserve walk (shouldCountIn ∧ selector-match, including terminated
+        pods; throttle_controller.go:135-155).
+
+        One flush (at most three scatter/reduce dispatches for any event
+        burst) plus one gather serves the whole batch — this is the
+        streaming-reconcile data plane: cost is O(events) not
+        O(throttles × pods).
+
+        The unreserve set MUST come from the same snapshot as the aggregate
+        (hence one call, one lock hold): deriving it later would unreserve a
+        pod that got counted AFTER the flush, whose contribution is not in
+        the status about to be written — reopening the double-count window
+        the reserve-until-observed handshake exists to close."""
+        import jax
+
+        from ..quantity import from_milli
+
+        reserved = reserved or {}
+        with self._lock:
+            ks = self._kind(kind)
+            ks.flush_agg()
+            out: Dict[str, Tuple[ResourceAmount, List[Pod]]] = {}
+            cols: List[int] = []
+            valid_keys: List[str] = []
+            for key in keys:
+                unres: List[Pod] = []
+                col = ks.index.throttle_col(key)
+                if col is not None:
+                    for pod_key in reserved.get(key, ()):
+                        row = ks.index.pod_row(pod_key)
+                        if row is None:
+                            continue
+                        if ks.count_in[row] and ks.index.mask[row, col]:
+                            pod = ks.index.indexed_pod(pod_key)
+                            if pod is not None:
+                                unres.append(pod)
+                if col is None:
+                    # zero counted pods: both fields stay nil (the Go
+                    # accumulator never materializes on an empty sum)
+                    out[key] = (ResourceAmount(), unres)
+                else:
+                    out[key] = (ResourceAmount(), unres)  # used filled below
+                    cols.append(col)
+                    valid_keys.append(key)
+            if not cols:
+                return out
+            idx = jnp.asarray(np.asarray(cols, dtype=np.int32))
+            cnt, req, ctb = jax.device_get(
+                (ks.agg_cnt[idx], ks.agg_req[idx], ks.agg_contrib[idx])
+            )
+            names = self.dims.names
+            for i, key in enumerate(valid_keys):
+                if cnt[i] <= 0:
+                    continue  # stays the nil ResourceAmount
+                requests = {
+                    names[j]: from_milli(int(req[i, j]))
+                    for j in range(min(len(names), req.shape[1]))
+                    if ctb[i, j] > 0
+                }
+                out[key] = (
+                    ResourceAmount(resource_counts=int(cnt[i]), resource_requests=requests),
+                    out[key][1],
+                )
+            return out
 
     # -- queries ----------------------------------------------------------
 

@@ -319,8 +319,9 @@ class SelectorIndex:
             terms.append((pr, nr))
         self._native.set_col(col, thr_ns, terms)
 
-    def _recompute_row(self, row: int) -> None:
-        pod = self._row_pods[row]
+    def _match_row_arbitrary(self, pod: Pod) -> np.ndarray:
+        """Evaluate a pod (not necessarily stored) against every compiled
+        column → bool[tcap]. Native C++ tier when available."""
         if self._native is not None:
             ns = self._namespaces.get(pod.namespace)
             pod_labels = {
@@ -340,12 +341,14 @@ class SelectorIndex:
             out[: len(match)] = match.astype(bool)
             for col in np.nonzero(general)[0]:
                 out[col] = self._eval_general(self._col_thrs[int(col)], pod)
-            self.mask[row, :] = out
-            return
+            return out
         out = np.zeros(self._tcap, dtype=bool)
         for key, col in self._thr_cols.items():
             out[col] = self._match_one(self._col_thrs[col], pod)
-        self.mask[row, :] = out
+        return out
+
+    def _recompute_row(self, row: int) -> None:
+        self.mask[row, :] = self._match_row_arbitrary(self._row_pods[row])
 
     def _match_one(self, thr: AnyThrottle, pod: Pod) -> bool:
         """Single-pair oracle used by row recompute AND external callers
@@ -402,6 +405,22 @@ class SelectorIndex:
             col_to_key = {col: key for key, col in self._thr_cols.items()}
             return [col_to_key[c] for c in cols if c in col_to_key]
 
+    def affected_throttle_keys_for(self, pod: Pod) -> List[str]:
+        """affectedThrottles for an ARBITRARY pod object.
+
+        When the queried object is exactly the indexed one, this is an O(K)
+        mask-row read. Otherwise (a pod version the index has moved past —
+        e.g. the old side of a MODIFIED event — or a pod not yet stored) the
+        row is evaluated fresh against every compiled column, without
+        mutating the index."""
+        with self._lock:
+            row = self._pod_rows.get(pod.key)
+            if row is not None and self._row_pods.get(row) is pod:
+                cols = np.nonzero(self.mask[row, : self._tcap])[0]
+            else:
+                cols = np.nonzero(self._match_row_arbitrary(pod) & self._thr_valid)[0]
+            return [self._col_thrs[int(c)].key for c in cols if int(c) in self._col_thrs]
+
     def matched_pod_keys(self, throttle_key: str) -> List[str]:
         """Pod keys matching a throttle (affectedPods' selector part)."""
         with self._lock:
@@ -411,6 +430,29 @@ class SelectorIndex:
             rows = np.nonzero(self.mask[: self._pcap, col])[0]
             row_to_key = {row: key for key, row in self._pod_rows.items()}
             return [row_to_key[r] for r in rows if r in row_to_key]
+
+    def matched_pods(self, throttle_key: str) -> List[Pod]:
+        """The indexed Pod objects matching a throttle (latest store state)."""
+        with self._lock:
+            col = self._thr_cols.get(throttle_key)
+            if col is None:
+                return []
+            rows = np.nonzero(self.mask[: self._pcap, col])[0]
+            return [self._row_pods[int(r)] for r in rows if int(r) in self._row_pods]
+
+    def indexed_pod(self, pod_key: str) -> Optional[Pod]:
+        with self._lock:
+            row = self._pod_rows.get(pod_key)
+            return self._row_pods.get(row) if row is not None else None
+
+    def mask_cell(self, pod_key: str, throttle_key: str) -> bool:
+        """Does the indexed pod currently match the throttle?"""
+        with self._lock:
+            row = self._pod_rows.get(pod_key)
+            col = self._thr_cols.get(throttle_key)
+            if row is None or col is None:
+                return False
+            return bool(self.mask[row, col])
 
     def pod_row(self, pod_key: str) -> Optional[int]:
         with self._lock:

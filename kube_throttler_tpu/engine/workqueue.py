@@ -78,6 +78,16 @@ class RateLimitingQueue:
             self._dirty.discard(item)
             return item
 
+    def try_get(self) -> Optional[str]:
+        """Non-blocking get: an immediately-ready item or None (batch drain)."""
+        with self._cond:
+            if not self._queue:
+                return None
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
     def done(self, item: str) -> None:
         with self._cond:
             self._processing.discard(item)

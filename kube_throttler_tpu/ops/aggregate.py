@@ -107,6 +107,34 @@ def apply_pod_deltas_batched(
 
 
 @jax.jit
+def rebase_cols(
+    agg_cnt: jnp.ndarray,  # int64[T]
+    agg_req: jnp.ndarray,  # int64[T,R]
+    contrib: jnp.ndarray,  # int32[T,R]
+    pods: PodBatch,
+    mask: jnp.ndarray,  # bool[P,T]
+    counted: jnp.ndarray,  # bool[P]
+    cols: jnp.ndarray,  # int32[K] — columns to recompute (pad with T → dropped)
+):
+    """Recompute the used-aggregates of K specific throttle columns from
+    scratch (selector/threshold edits invalidate a column's incremental
+    aggregate — the membership set changed, so deltas no longer apply).
+
+    One masked [P,K] reduction + scatter, entirely on device; K is bucketed
+    by the caller so recompilation is bounded."""
+    m = mask[:, cols] & (counted & pods.valid)[:, None]  # bool[P,K]
+    cnt = jnp.sum(m, axis=0, dtype=jnp.int64)
+    mb = m[:, :, None]
+    req = jnp.sum(jnp.where(mb, pods.req[:, None, :], 0), axis=0)
+    ctb = jnp.sum((mb & pods.req_present[:, None, :]).astype(jnp.int32), axis=0)
+    return (
+        agg_cnt.at[cols].set(cnt, mode="drop"),
+        agg_req.at[cols].set(req, mode="drop"),
+        contrib.at[cols].set(ctb, mode="drop"),
+    )
+
+
+@jax.jit
 def throttled_flags(
     thr_cnt: jnp.ndarray,
     thr_cnt_present: jnp.ndarray,
