@@ -3,6 +3,13 @@
 N worker threads drain a rate-limiting workqueue; reconcile errors re-queue
 with exponential backoff (controller.go:106-108); success forgets the key.
 ``enqueue_after`` drives override-boundary self-wakeups.
+
+A periodic **resync** (``resync_interval`` + ``list_keys_func``) re-enqueues
+every live key on a fixed cadence — the eventual-consistency backstop the
+reference gets from its 5-minute informer resync (plugin.go:77,86): any
+status left stale by a missed/unwirable event converges within one interval.
+It rides the same delayed-queue machinery as ``enqueue_after`` via a
+reserved sentinel key, so FakeClock tests drive it deterministically.
 """
 
 from __future__ import annotations
@@ -18,6 +25,11 @@ from ..utils.clock import Clock, RealClock
 
 logger = logging.getLogger(__name__)
 
+# Reserved workqueue key that triggers a full re-enqueue of live keys.
+# "\x00" cannot appear in a Kubernetes object name, so it can never collide
+# with a real reconcile key.
+RESYNC_KEY = "\x00resync"
+
 
 class ControllerBase:
     def __init__(
@@ -28,6 +40,7 @@ class ControllerBase:
         target_scheduler_name: str,
         clock: Optional[Clock] = None,
         threadiness: int = 1,
+        resync_interval: Optional[timedelta] = None,
     ):
         self.name = name
         self.target_kind = target_kind
@@ -46,8 +59,14 @@ class ControllerBase:
         # phase tracer (utils.tracing.PhaseTracer); set by the plugin so
         # reconcile latency lands in the same histogram family as the hot path
         self.tracer = NoopTracer()
+        # periodic resync: every resync_interval, every key returned by
+        # list_keys_func is re-enqueued (dedup'd by the workqueue)
+        self.resync_interval = resync_interval
+        self.list_keys_func: Optional[Callable[[], List[str]]] = None
         self._threads: List[threading.Thread] = []
         self._started = False
+        if self.resync_interval is not None:
+            self.workqueue.add_after(RESYNC_KEY, self.resync_interval)
 
     def start(self) -> None:
         if self._started:
@@ -74,9 +93,31 @@ class ControllerBase:
     def enqueue_after(self, key: str, duration: timedelta) -> None:
         self.workqueue.add_after(key, duration)
 
+    def _resync(self) -> None:
+        """Re-enqueue every live key, then re-arm the next tick. Errors in
+        ``list_keys_func`` skip one tick but never kill the cadence."""
+        try:
+            if self.list_keys_func is not None:
+                keys = self.list_keys_func()
+                vlog(4, "%s: periodic resync, re-enqueuing %d keys", self.name, len(keys))
+                for key in keys:
+                    self.workqueue.add(key)
+        except Exception:
+            logger.exception("%s: resync key listing failed", self.name)
+        finally:
+            self.workqueue.forget(RESYNC_KEY)
+            self.workqueue.done(RESYNC_KEY)
+            if self.resync_interval is not None:
+                self.workqueue.add_after(RESYNC_KEY, self.resync_interval)
+
     def _process_batch(self, keys: List[str]) -> None:
         """Run the (batched) reconcile for drained keys; requeue failures
         rate-limited (controller.go:106-108), forget successes."""
+        if RESYNC_KEY in keys:
+            keys = [k for k in keys if k != RESYNC_KEY]
+            self._resync()
+            if not keys:
+                return
         failures: dict = {}
         try:
             vlog(4, "%s: reconciling batch %r", self.name, keys)

@@ -10,14 +10,20 @@ Differences from ThrottleController, all mirrored from the reference:
 - ``check_throttled`` passes the caller's onEqual through to step 3 of the
   4-state check (via ClusterThrottle.check_throttled_for —
   clusterthrottle_types.go:45);
-- the namespace informer is watched with NO handlers (429) — namespace
-  label changes do not trigger reconciles.
+- the reference watches the namespace informer with NO handlers
+  (clusterthrottle_controller.go:429) and relies on the 5-minute informer
+  resync (plugin.go:77) to eventually repair statuses after a namespace
+  relabel. This build diverges DELIBERATELY: ``_on_namespace_event``
+  enqueues every responsible ClusterThrottle whose namespaceSelector match
+  flipped, so ``status.used`` converges immediately instead of within 5
+  minutes; the periodic resync (ControllerBase.resync_interval) remains the
+  backstop.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..api.pod import Pod
 from ..api.types import (
@@ -48,6 +54,7 @@ class ClusterThrottleController(ControllerBase):
         num_key_mutex: int = 128,
         device_manager: Optional[DeviceStateManager] = None,
         metrics_recorder=None,
+        resync_interval=None,
     ):
         super().__init__(
             name="ClusterThrottleController",
@@ -56,6 +63,7 @@ class ClusterThrottleController(ControllerBase):
             target_scheduler_name=target_scheduler_name,
             clock=clock,
             threadiness=threadiness,
+            resync_interval=resync_interval,
         )
         self.store = store
         self.cache = ReservedResourceAmounts(num_key_mutex)
@@ -63,7 +71,15 @@ class ClusterThrottleController(ControllerBase):
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
         self.reconcile_batch_func = self.reconcile_batch
+        self.list_keys_func = self._list_responsible_keys
         self._setup_event_handlers()
+
+    def _list_responsible_keys(self) -> List[str]:
+        return [
+            t.key
+            for t in self.store.list_cluster_throttles()
+            if self.is_responsible_for(t)
+        ]
 
     def is_responsible_for(self, thr: ClusterThrottle) -> bool:
         return self.throttler_name == thr.spec.throttler_name
@@ -300,8 +316,44 @@ class ClusterThrottleController(ControllerBase):
     def _setup_event_handlers(self) -> None:
         self.store.add_event_handler("ClusterThrottle", self._on_throttle_event)
         self.store.add_event_handler("Pod", self._on_pod_event)
-        # namespace informer: watched but NO handlers — mirror of
-        # clusterthrottle_controller.go:429
+        # The reference watches namespaces with NO handlers
+        # (clusterthrottle_controller.go:429) and leans on the 5-min informer
+        # resync; here a namespace event whose selector match flips enqueues
+        # the affected clusterthrottles directly (no replay: preexisting
+        # namespaces carry no pending status change).
+        self.store.add_event_handler(
+            "Namespace", self._on_namespace_event, replay=False
+        )
+
+    def _on_namespace_event(self, event: Event) -> None:
+        """Enqueue responsible clusterthrottles whose namespaceSelector match
+        for this namespace changed. A relabel that un-matches a selector
+        flips many device-mask rows at once (devicestate._on_namespace); this
+        is the enqueue that makes the flipped aggregate land in status —
+        without it, ``status.used`` stays wrong until a pod event or resync.
+
+        A namespace label change affects all pods of the namespace uniformly
+        (the term is namespaceSelector ∧ podSelector,
+        clusterthrottle_selector.go:112-141), so only a flip of the
+        namespace-side match can change any pod's membership; equal
+        old/new match means no status can have changed and no enqueue is
+        needed.
+        """
+        old_ns = event.old_obj if event.type == EventType.MODIFIED else (
+            event.obj if event.type == EventType.DELETED else None
+        )
+        new_ns = event.obj if event.type != EventType.DELETED else None
+        for thr in self.store.list_cluster_throttles():
+            if not self.is_responsible_for(thr):
+                continue
+            old_match = old_ns is not None and thr.spec.selector.matches_to_namespace(
+                old_ns
+            )
+            new_match = new_ns is not None and thr.spec.selector.matches_to_namespace(
+                new_ns
+            )
+            if old_match != new_match:
+                self.enqueue(thr.key)
 
     def _on_throttle_event(self, event: Event) -> None:
         thr = event.obj

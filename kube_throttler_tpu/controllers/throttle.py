@@ -48,6 +48,7 @@ class ThrottleController(ControllerBase):
         num_key_mutex: int = 128,
         device_manager: Optional[DeviceStateManager] = None,
         metrics_recorder=None,
+        resync_interval=None,
     ):
         super().__init__(
             name="ThrottleController",
@@ -56,6 +57,7 @@ class ThrottleController(ControllerBase):
             target_scheduler_name=target_scheduler_name,
             clock=clock,
             threadiness=threadiness,
+            resync_interval=resync_interval,
         )
         self.store = store
         self.cache = ReservedResourceAmounts(num_key_mutex)
@@ -63,7 +65,13 @@ class ThrottleController(ControllerBase):
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
         self.reconcile_batch_func = self.reconcile_batch
+        self.list_keys_func = self._list_responsible_keys
         self._setup_event_handlers()
+
+    def _list_responsible_keys(self) -> List[str]:
+        return [
+            t.key for t in self.store.list_throttles() if self.is_responsible_for(t)
+        ]
 
     # ------------------------------------------------------------ predicates
 

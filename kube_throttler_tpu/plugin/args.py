@@ -3,10 +3,14 @@
 Same field names (including the ``kubeconfig`` JSON key whose Go field is the
 ``KubeConifg`` typo — SURVEY §2.3 quirk 5), same defaults and validation:
 ``name`` and ``targetSchedulerName`` required; interval defaults to 15s;
-threadiness defaults to CPU count; ``reconcileTemporaryThresholdInterval``
-is decoded-but-unused in the reference (override wakeups are event-driven);
-it is kept for config compatibility and honored as an optional periodic
-resync here.
+threadiness defaults to CPU count.
+
+``reconcileTemporaryThresholdInterval`` is decoded-but-unused in the
+reference (plugin_args.go:53-55 → plugin.go:93,104 → dropped; override
+wakeups are event-driven via NextOverrideHappensIn). Here it IS honored: the
+plugin passes it to both controllers as ``resync_interval``, the periodic
+enqueue-all backstop (controllers/base.py ``_resync``) that replaces the
+reference's 5-minute informer resync.
 """
 
 from __future__ import annotations

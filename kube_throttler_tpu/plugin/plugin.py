@@ -63,6 +63,7 @@ class KubeThrottler:
             num_key_mutex=args.num_key_mutex,
             device_manager=self.device_manager,
             metrics_recorder=ThrottleMetricsRecorder(self.metrics_registry),
+            resync_interval=args.reconcile_temporary_threshold_interval,
         )
         self.cluster_throttle_ctr = ClusterThrottleController(
             throttler_name=args.name,
@@ -73,6 +74,7 @@ class KubeThrottler:
             num_key_mutex=args.num_key_mutex,
             device_manager=self.device_manager,
             metrics_recorder=ClusterThrottleMetricsRecorder(self.metrics_registry),
+            resync_interval=args.reconcile_temporary_threshold_interval,
         )
         if self.device_manager is not None:
             self.device_manager.tracer = self.tracer

@@ -72,6 +72,25 @@ def _throttle(name, labels, **threshold):
     )
 
 
+def _ct_team_x(name):
+    """ClusterThrottle selecting pods {grp: a} in namespaces {team: x}."""
+    return ClusterThrottle(
+        name=name,
+        spec=ClusterThrottleSpec(
+            throttler_name="kube-throttler",
+            threshold=ResourceAmount.of(pod=10),
+            selector=ClusterThrottleSelector(
+                selector_terms=(
+                    ClusterThrottleSelectorTerm(
+                        pod_selector=LabelSelector(match_labels={"grp": "a"}),
+                        namespace_selector=LabelSelector(match_labels={"team": "x"}),
+                    ),
+                )
+            ),
+        ),
+    )
+
+
 def _bound(pod):
     bound = replace(pod, spec=replace(pod.spec, node_name="node-1"))
     bound.status.phase = "Running"
@@ -155,25 +174,7 @@ class TestDeltaThenRebase:
 class TestFullRebasePaths:
     def test_namespace_definition_triggers_clusterthrottle_rebase(self):
         store, plugin, _ = _stack()
-        store.create_cluster_throttle(
-            ClusterThrottle(
-                name="ct1",
-                spec=ClusterThrottleSpec(
-                    throttler_name="kube-throttler",
-                    threshold=ResourceAmount.of(pod=10),
-                    selector=ClusterThrottleSelector(
-                        selector_terms=(
-                            ClusterThrottleSelectorTerm(
-                                pod_selector=LabelSelector(match_labels={"grp": "a"}),
-                                namespace_selector=LabelSelector(
-                                    match_labels={"team": "x"}
-                                ),
-                            ),
-                        )
-                    ),
-                ),
-            )
-        )
+        store.create_cluster_throttle(_ct_team_x("ct1"))
         store.create_namespace(Namespace("team-ns", labels={"team": "x"}))
         pod = _bound(
             make_pod("p1", namespace="team-ns", labels={"grp": "a"}, requests={"cpu": "1"})
@@ -188,6 +189,76 @@ class TestFullRebasePaths:
         store.update_namespace(Namespace("team-ns", labels={"team": "y"}))
         store.update_pod(replace(pod))  # poke a reconcile
         _assert_status_matches_oracle(store, plugin)
+
+    def test_namespace_relabel_converges_without_pod_poke(self):
+        """The namespace event alone must enqueue the affected
+        clusterthrottle (controllers/clusterthrottle._on_namespace_event) —
+        no pod activity required for status.used to converge."""
+        store, plugin, _ = _stack()
+        store.create_cluster_throttle(_ct_team_x("ct1"))
+        store.create_namespace(Namespace("team-ns", labels={"team": "x"}))
+        store.create_pod(
+            _bound(
+                make_pod(
+                    "p1", namespace="team-ns", labels={"grp": "a"}, requests={"cpu": "1"}
+                )
+            )
+        )
+        _assert_status_matches_oracle(store, plugin)
+        assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
+
+        store.update_namespace(Namespace("team-ns", labels={"team": "y"}))
+        _assert_status_matches_oracle(store, plugin)
+        assert store.get_cluster_throttle("ct1").status.used == ResourceAmount()
+
+        # and back: the namespace re-matching must also converge unpoked
+        store.update_namespace(Namespace("team-ns", labels={"team": "x"}))
+        _assert_status_matches_oracle(store, plugin)
+        assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
+
+    def test_resync_backstop_converges_after_missed_event(self):
+        """reconcileTemporaryThresholdInterval as the eventual-consistency
+        backstop (the analog of the reference's 5-min informer resync,
+        plugin.go:77): with the namespace handler detached to simulate a
+        missed watch event, the status is stale until the FakeClock crosses
+        the resync interval — then it converges with NO pod poke."""
+        import time
+
+        store, plugin, clock = _stack()
+        ctr = plugin.cluster_throttle_ctr
+        store.create_cluster_throttle(_ct_team_x("ct1"))
+        store.create_namespace(Namespace("team-ns", labels={"team": "x"}))
+        store.create_pod(
+            _bound(
+                make_pod(
+                    "p1", namespace="team-ns", labels={"grp": "a"}, requests={"cpu": "1"}
+                )
+            )
+        )
+        _assert_status_matches_oracle(store, plugin)
+        assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
+
+        store.remove_event_handler("Namespace", ctr._on_namespace_event)
+        store.update_namespace(Namespace("team-ns", labels={"team": "y"}))
+        plugin.run_pending_once()
+        # event missed → stale (exactly the round-2 bug, now confined to a
+        # simulated watch-stream failure)
+        assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
+
+        # default interval is 15s; cross it and wait for the delayed-queue
+        # waker to promote the resync sentinel (polls the clock at ~2ms)
+        clock.advance(decode_plugin_args(
+            {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+        ).reconcile_temporary_threshold_interval + __import__("datetime").timedelta(seconds=1))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            plugin.run_pending_once()
+            if store.get_cluster_throttle("ct1").status.used == ResourceAmount():
+                break
+            time.sleep(0.01)
+        assert store.get_cluster_throttle("ct1").status.used == ResourceAmount()
+        for thr in store.list_cluster_throttles():
+            assert thr.status.used == _oracle_used(store, thr)
 
     def test_delta_burst_overflow_forces_full_rebase(self):
         store, plugin, _ = _stack()
