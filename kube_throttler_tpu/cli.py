@@ -61,6 +61,13 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument("--config", help="KubeSchedulerConfiguration-style YAML")
     serve.add_argument("--name", help="throttler name (spec.throttlerName to own)")
     serve.add_argument("--target-scheduler-name", help="schedulerName of governed pods")
+    serve.add_argument(
+        "--kubeconfig",
+        help="connect to a real apiserver: list+watch reflectors keep the "
+        "local cache synced and status writes go to the status subresource "
+        "(plugin.go:71-130); without it the daemon runs its own in-memory "
+        "apiserver fed via the HTTP surface",
+    )
     serve.add_argument("--controller-threadiness", type=int, default=0)
     serve.add_argument("--num-key-mutex", type=int, default=0)
     serve.add_argument("--host", default="127.0.0.1")
@@ -121,6 +128,8 @@ def main(argv: Optional[list] = None) -> int:
         config["name"] = args.name
     if args.target_scheduler_name:
         config["targetSchedulerName"] = args.target_scheduler_name
+    if args.kubeconfig:
+        config["kubeconfig"] = args.kubeconfig
     if args.controller_threadiness:
         config["controllerThrediness"] = args.controller_threadiness
     if args.num_key_mutex:
@@ -130,6 +139,15 @@ def main(argv: Optional[list] = None) -> int:
         plugin_args = decode_plugin_args(config)
     except ValueError as e:
         parser.error(str(e))  # clean usage error, not a traceback
+
+    if plugin_args.kubeconfig and args.nodes > 0:
+        # the embedded scheduler binds pods in the LOCAL store; in remote
+        # mode the reflectors own those objects and would revert every bind
+        parser.error(
+            "--nodes (embedded scheduler) cannot be combined with "
+            "--kubeconfig: bind decisions must go to the real apiserver — "
+            "run an external scheduler against /v1/prefilter instead"
+        )
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
@@ -150,13 +168,26 @@ def main(argv: Optional[list] = None) -> int:
             return 1
 
     store = Store()
-    store.create_namespace(Namespace("default"))
+    session = None
+    if plugin_args.kubeconfig:
+        from .client.transport import RemoteSession
+
+        session = RemoteSession.from_kubeconfig(plugin_args.kubeconfig, store)
+        print(
+            f"syncing from apiserver {session.config.server} "
+            f"(kubeconfig={plugin_args.kubeconfig})...",
+            flush=True,
+        )
+        session.start()  # blocks until every reflector listed once
+    else:
+        store.create_namespace(Namespace("default"))
     plugin = KubeThrottler(
         plugin_args,
         store,
         event_recorder=RecordingEventRecorder(),
         use_device=not args.no_device,
         start_workers=True,
+        status_writer=session.status_writer if session is not None else None,
     )
     scheduler = None
     if args.nodes > 0:
@@ -169,7 +200,9 @@ def main(argv: Optional[list] = None) -> int:
         )
         scheduler.start()
 
-    server = ThrottlerHTTPServer(plugin, host=args.host, port=args.port)
+    server = ThrottlerHTTPServer(
+        plugin, host=args.host, port=args.port, remote=session is not None
+    )
     server.start()
     print(
         f"kube-throttler-tpu serving on {args.host}:{server.port} "
@@ -183,6 +216,8 @@ def main(argv: Optional[list] = None) -> int:
     server.stop()
     if scheduler is not None:
         scheduler.stop()
+    if session is not None:
+        session.stop()
     plugin.stop()
     if elector is not None:
         elector.release()

@@ -142,6 +142,13 @@ class SharedIndexInformer:
                 for obj in self.indexer.list():
                     handler(Event(EventType.ADDED, self.kind, obj))
 
+    def remove_event_handler(self, handler: Handler) -> None:
+        with self._lock:
+            try:
+                self._handlers.remove(handler)
+            except ValueError:
+                pass
+
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
@@ -173,6 +180,31 @@ class SharedIndexInformer:
 
     def detach(self) -> None:
         self._store.remove_event_handler(self.kind, self._on_store_event)
+
+
+class InformerBundle:
+    """Routes each kind to the factory that owns it — the reference keeps
+    throttle kinds in the schedule factory and Pods/Namespaces in a second
+    core factory built specifically for its namespace indexer
+    (plugin.go:76-88). Controllers subscribe through this facade."""
+
+    def __init__(
+        self, schedule_factory: "SharedInformerFactory", core_factory: "SharedInformerFactory"
+    ) -> None:
+        self.schedule_factory = schedule_factory
+        self.core_factory = core_factory
+
+    def throttles(self) -> "SharedIndexInformer":
+        return self.schedule_factory.throttles()
+
+    def cluster_throttles(self) -> "SharedIndexInformer":
+        return self.schedule_factory.cluster_throttles()
+
+    def pods(self) -> "SharedIndexInformer":
+        return self.core_factory.pods()
+
+    def namespaces(self) -> "SharedIndexInformer":
+        return self.core_factory.namespaces()
 
 
 class SharedInformerFactory:

@@ -105,3 +105,36 @@ class NamespaceLister:
         if obj is None:
             raise KeyError(f"namespace {name} not found")
         return obj
+
+
+class Listers:
+    """The bundle the plugin hands its controllers: every read the hot/async
+    paths do goes through these indexer-backed listers (the reference reads
+    through exactly this layer — plugin.go:76-88 wires listers from the two
+    informer factories into the controllers)."""
+
+    def __init__(
+        self,
+        throttles: ThrottleLister,
+        cluster_throttles: ClusterThrottleLister,
+        pods: PodLister,
+        namespaces: NamespaceLister,
+    ) -> None:
+        self.throttles = throttles
+        self.cluster_throttles = cluster_throttles
+        self.pods = pods
+        self.namespaces = namespaces
+
+    @classmethod
+    def from_factories(cls, schedule_factory, core_factory) -> "Listers":
+        """Build from the two shared informer factories (the reference keeps
+        throttle kinds and core kinds in separate factories because the
+        framework's pod informer lacks a namespace indexer, plugin.go:81-84)."""
+        return cls(
+            throttles=ThrottleLister(schedule_factory.throttles().indexer),
+            cluster_throttles=ClusterThrottleLister(
+                schedule_factory.cluster_throttles().indexer
+            ),
+            pods=PodLister(core_factory.pods().indexer),
+            namespaces=NamespaceLister(core_factory.namespaces().indexer),
+        )

@@ -55,7 +55,12 @@ class ClusterThrottleController(ControllerBase):
         device_manager: Optional[DeviceStateManager] = None,
         metrics_recorder=None,
         resync_interval=None,
+        listers=None,
+        informers=None,
+        status_writer=None,
     ):
+        """See ThrottleController.__init__ for the listers / informers /
+        status_writer contract (plugin.go:76-88 composition)."""
         super().__init__(
             name="ClusterThrottleController",
             target_kind="ClusterThrottle",
@@ -66,6 +71,9 @@ class ClusterThrottleController(ControllerBase):
             resync_interval=resync_interval,
         )
         self.store = store
+        self.listers = listers
+        self.informers = informers
+        self.status_writer = status_writer if status_writer is not None else store
         self.cache = ReservedResourceAmounts(num_key_mutex)
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
@@ -74,11 +82,43 @@ class ClusterThrottleController(ControllerBase):
         self.list_keys_func = self._list_responsible_keys
         self._setup_event_handlers()
 
+    # ------------------------------------------------------------- data reads
+    # (lister-backed when wired, plugin.go:76-88; store fallback otherwise)
+
+    def _get_cluster_throttle(self, name: str) -> ClusterThrottle:
+        if self.listers is not None:
+            try:
+                return self.listers.cluster_throttles.get(name)
+            except KeyError:
+                raise NotFoundError(f"ClusterThrottle {name!r} not found")
+        return self.store.get_cluster_throttle(name)
+
+    def _list_cluster_throttles(self) -> List[ClusterThrottle]:
+        if self.listers is not None:
+            return self.listers.cluster_throttles.list()
+        return self.store.list_cluster_throttles()
+
+    def _get_namespace(self, name: str):
+        if self.listers is not None:
+            try:
+                return self.listers.namespaces.get(name)
+            except KeyError:
+                return None
+        return self.store.get_namespace(name)
+
+    def _list_namespaces(self):
+        if self.listers is not None:
+            return self.listers.namespaces.list()
+        return self.store.list_namespaces()
+
+    def _list_pods(self, namespace: str) -> List[Pod]:
+        if self.listers is not None:
+            return self.listers.pods.pods(namespace).list()
+        return self.store.list_pods(namespace)
+
     def _list_responsible_keys(self) -> List[str]:
         return [
-            t.key
-            for t in self.store.list_cluster_throttles()
-            if self.is_responsible_for(t)
+            t.key for t in self._list_cluster_throttles() if self.is_responsible_for(t)
         ]
 
     def is_responsible_for(self, thr: ClusterThrottle) -> bool:
@@ -103,7 +143,7 @@ class ClusterThrottleController(ControllerBase):
         thrs: Dict[str, ClusterThrottle] = {}
         for key in dict.fromkeys(keys):
             try:
-                thrs[key] = self.store.get_cluster_throttle(key.lstrip("/"))
+                thrs[key] = self._get_cluster_throttle(key.lstrip("/"))
             except NotFoundError:
                 pass
         if not thrs:
@@ -171,7 +211,7 @@ class ClusterThrottleController(ControllerBase):
                     self.unreserve_on_throttle(p, thr)
 
         if new_status != thr.status:
-            self.store.update_cluster_throttle_status(thr.with_status(new_status))
+            self.status_writer.update_cluster_throttle_status(thr.with_status(new_status))
             if self.metrics_recorder is not None:
                 self.metrics_recorder.record(thr.with_status(new_status))
             unreserve_affected()
@@ -196,11 +236,11 @@ class ClusterThrottleController(ControllerBase):
         else:
             ns_map = {}
             pods = []
-            for ns in self.store.list_namespaces():
+            for ns in self._list_namespaces():
                 if not thr.spec.selector.matches_to_namespace(ns):
                     continue
                 ns_map[ns.name] = ns
-                pods.extend(self.store.list_pods(ns.name))
+                pods.extend(self._list_pods(ns.name))
             pods = [
                 p
                 for p in pods
@@ -216,7 +256,7 @@ class ClusterThrottleController(ControllerBase):
         return non_terminated, terminated
 
     def affected_cluster_throttle_keys(self, pod: Pod) -> List[str]:
-        ns = self.store.get_namespace(pod.namespace)
+        ns = self._get_namespace(pod.namespace)
         if ns is None:
             # Go: lister Get error propagates (clusterthrottle_controller.go:273-276)
             raise NotFoundError(f"namespace {pod.namespace!r} not found")
@@ -225,7 +265,7 @@ class ClusterThrottleController(ControllerBase):
         return [t.key for t in self._scan_cluster_throttles(pod, ns)]
 
     def affected_cluster_throttles(self, pod: Pod) -> List[ClusterThrottle]:
-        ns = self.store.get_namespace(pod.namespace)
+        ns = self._get_namespace(pod.namespace)
         if ns is None:
             # Go: lister Get error propagates (clusterthrottle_controller.go:273-276)
             raise NotFoundError(f"namespace {pod.namespace!r} not found")
@@ -233,7 +273,7 @@ class ClusterThrottleController(ControllerBase):
             affected = []
             for key in self.device_manager.affected_throttle_keys(self.KIND, pod):
                 try:
-                    thr = self.store.get_cluster_throttle(key.lstrip("/"))
+                    thr = self._get_cluster_throttle(key.lstrip("/"))
                 except NotFoundError:
                     continue
                 if self.is_responsible_for(thr):
@@ -243,7 +283,7 @@ class ClusterThrottleController(ControllerBase):
 
     def _scan_cluster_throttles(self, pod: Pod, ns) -> List[ClusterThrottle]:
         affected = []
-        for thr in self.store.list_cluster_throttles():
+        for thr in self._list_cluster_throttles():
             if not self.is_responsible_for(thr):
                 continue
             if thr.spec.selector.matches_to_pod(pod, ns):
@@ -282,12 +322,12 @@ class ClusterThrottleController(ControllerBase):
         if self.device_manager is not None:
             # the missing-namespace error contract holds on the device path
             # too (clusterthrottle_controller.go:273-276)
-            if self.store.get_namespace(pod.namespace) is None:
+            if self._get_namespace(pod.namespace) is None:
                 raise NotFoundError(f"namespace {pod.namespace!r} not found")
             results = self.device_manager.check_pod(pod, self.KIND, is_throttled_on_equal)
             active, insufficient, exceeds, affected = [], [], [], []
             for key, status in results.items():
-                thr = self.store.get_cluster_throttle(key.lstrip("/"))
+                thr = self._get_cluster_throttle(key.lstrip("/"))
                 affected.append(thr)
                 if status == "active":
                     active.append(thr)
@@ -314,16 +354,25 @@ class ClusterThrottleController(ControllerBase):
     # ---------------------------------------------------------- event wiring
 
     def _setup_event_handlers(self) -> None:
-        self.store.add_event_handler("ClusterThrottle", self._on_throttle_event)
-        self.store.add_event_handler("Pod", self._on_pod_event)
         # The reference watches namespaces with NO handlers
         # (clusterthrottle_controller.go:429) and leans on the 5-min informer
         # resync; here a namespace event whose selector match flips enqueues
         # the affected clusterthrottles directly (no replay: preexisting
         # namespaces carry no pending status change).
-        self.store.add_event_handler(
-            "Namespace", self._on_namespace_event, replay=False
-        )
+        if self.informers is not None:
+            self.informers.cluster_throttles().add_event_handler(
+                self._on_throttle_event
+            )
+            self.informers.pods().add_event_handler(self._on_pod_event)
+            self.informers.namespaces().add_event_handler(
+                self._on_namespace_event, replay=False
+            )
+        else:
+            self.store.add_event_handler("ClusterThrottle", self._on_throttle_event)
+            self.store.add_event_handler("Pod", self._on_pod_event)
+            self.store.add_event_handler(
+                "Namespace", self._on_namespace_event, replay=False
+            )
 
     def _on_namespace_event(self, event: Event) -> None:
         """Enqueue responsible clusterthrottles whose namespaceSelector match
@@ -333,27 +382,27 @@ class ClusterThrottleController(ControllerBase):
         without it, ``status.used`` stays wrong until a pod event or resync.
 
         A namespace label change affects all pods of the namespace uniformly
-        (the term is namespaceSelector ∧ podSelector,
-        clusterthrottle_selector.go:112-141), so only a flip of the
-        namespace-side match can change any pod's membership; equal
-        old/new match means no status can have changed and no enqueue is
-        needed.
+        within one selector term (the term is namespaceSelector ∧
+        podSelector, clusterthrottle_selector.go:112-141), so membership can
+        only change when some TERM's namespace-side match flips. The check
+        must be per-term, not on the OR-aggregate: a relabel that moves the
+        namespace from term A to term B keeps the aggregate True on both
+        sides while the counted pod set (term A's podSelector vs term B's)
+        changes completely.
         """
         old_ns = event.old_obj if event.type == EventType.MODIFIED else (
             event.obj if event.type == EventType.DELETED else None
         )
         new_ns = event.obj if event.type != EventType.DELETED else None
-        for thr in self.store.list_cluster_throttles():
+        for thr in self._list_cluster_throttles():
             if not self.is_responsible_for(thr):
                 continue
-            old_match = old_ns is not None and thr.spec.selector.matches_to_namespace(
-                old_ns
-            )
-            new_match = new_ns is not None and thr.spec.selector.matches_to_namespace(
-                new_ns
-            )
-            if old_match != new_match:
-                self.enqueue(thr.key)
+            for term in thr.spec.selector.selector_terms:
+                old_match = old_ns is not None and term.matches_to_namespace(old_ns)
+                new_match = new_ns is not None and term.matches_to_namespace(new_ns)
+                if old_match != new_match:
+                    self.enqueue(thr.key)
+                    break
 
     def _on_throttle_event(self, event: Event) -> None:
         thr = event.obj

@@ -49,7 +49,18 @@ class ThrottleController(ControllerBase):
         device_manager: Optional[DeviceStateManager] = None,
         metrics_recorder=None,
         resync_interval=None,
+        listers=None,
+        informers=None,
+        status_writer=None,
     ):
+        """``listers`` (client.listers.Listers) routes every read through the
+        indexer-backed lister layer and ``informers`` (SharedInformerFactory)
+        sources events from shared informers instead of raw store handlers —
+        the reference's composition (plugin.go:76-88). Without them the
+        controller falls back to direct store access (standalone/unit use).
+        ``status_writer`` is where status updates go: the store (default) or
+        a RemoteStatusWriter PUTting the real apiserver's status
+        subresource (throttle_controller.go:170)."""
         super().__init__(
             name="ThrottleController",
             target_kind="Throttle",
@@ -60,6 +71,9 @@ class ThrottleController(ControllerBase):
             resync_interval=resync_interval,
         )
         self.store = store
+        self.listers = listers
+        self.informers = informers
+        self.status_writer = status_writer if status_writer is not None else store
         self.cache = ReservedResourceAmounts(num_key_mutex)
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
@@ -68,10 +82,34 @@ class ThrottleController(ControllerBase):
         self.list_keys_func = self._list_responsible_keys
         self._setup_event_handlers()
 
+    # ------------------------------------------------------------- data reads
+    # (lister-backed when wired, plugin.go:76-88; store fallback otherwise)
+
+    def _get_throttle(self, namespace: str, name: str) -> Throttle:
+        if self.listers is not None:
+            try:
+                return self.listers.throttles.throttles(namespace).get(name)
+            except KeyError:
+                raise NotFoundError(f"Throttle {namespace}/{name} not found")
+        return self.store.get_throttle(namespace, name)
+
+    def _list_throttles(self, namespace: Optional[str] = None) -> List[Throttle]:
+        if self.listers is not None:
+            if namespace is None:
+                return self.listers.throttles.list()
+            return self.listers.throttles.throttles(namespace).list()
+        return self.store.list_throttles(namespace)
+
+    def _list_pods(self, namespace: str) -> List[Pod]:
+        if self.listers is not None:
+            # the namespace-indexed pod lister — the very indexer the
+            # reference builds its second informer factory for
+            # (plugin.go:81-84)
+            return self.listers.pods.pods(namespace).list()
+        return self.store.list_pods(namespace)
+
     def _list_responsible_keys(self) -> List[str]:
-        return [
-            t.key for t in self.store.list_throttles() if self.is_responsible_for(t)
-        ]
+        return [t.key for t in self._list_throttles() if self.is_responsible_for(t)]
 
     # ------------------------------------------------------------ predicates
 
@@ -100,7 +138,7 @@ class ThrottleController(ControllerBase):
         for key in dict.fromkeys(keys):
             namespace, _, name = key.partition("/")
             try:
-                thrs[key] = self.store.get_throttle(namespace, name)
+                thrs[key] = self._get_throttle(namespace, name)
             except NotFoundError:
                 pass  # deleted — nothing to do (throttle_controller.go:96-99)
         if not thrs:
@@ -172,7 +210,7 @@ class ThrottleController(ControllerBase):
                     self.unreserve_on_throttle(p, thr)
 
         if new_status != thr.status:
-            self.store.update_throttle_status(thr.with_status(new_status))
+            self.status_writer.update_throttle_status(thr.with_status(new_status))
             if self.metrics_recorder is not None:
                 self.metrics_recorder.record(thr.with_status(new_status))
             unreserve_affected()
@@ -198,7 +236,7 @@ class ThrottleController(ControllerBase):
         else:
             pods = [
                 p
-                for p in self.store.list_pods(thr.namespace)
+                for p in self._list_pods(thr.namespace)
                 if thr.spec.selector.matches_to_pod(p)
             ]
         for pod in pods:
@@ -221,14 +259,14 @@ class ThrottleController(ControllerBase):
             for key in self.device_manager.affected_throttle_keys(self.KIND, pod):
                 namespace, _, name = key.partition("/")
                 try:
-                    thr = self.store.get_throttle(namespace, name)
+                    thr = self._get_throttle(namespace, name)
                 except NotFoundError:
                     continue
                 if self.is_responsible_for(thr):
                     affected.append(thr)
             return affected
         affected = []
-        for thr in self.store.list_throttles(pod.namespace):
+        for thr in self._list_throttles(pod.namespace):
             if not self.is_responsible_for(thr):
                 continue
             if thr.spec.selector.matches_to_pod(pod):
@@ -272,7 +310,7 @@ class ThrottleController(ControllerBase):
             active, insufficient, exceeds, affected = [], [], [], []
             for key, status in results.items():
                 namespace, _, name = key.partition("/")
-                thr = self.store.get_throttle(namespace, name)
+                thr = self._get_throttle(namespace, name)
                 affected.append(thr)
                 if status == "active":
                     active.append(thr)
@@ -299,8 +337,16 @@ class ThrottleController(ControllerBase):
     # ---------------------------------------------------------- event wiring
 
     def _setup_event_handlers(self) -> None:
-        self.store.add_event_handler("Throttle", self._on_throttle_event)
-        self.store.add_event_handler("Pod", self._on_pod_event)
+        if self.informers is not None:
+            # shared-informer subscription (mustSetupEventHandler,
+            # throttle_controller.go:400): the informer mirrors the store
+            # into its indexer BEFORE fanning out, so lister reads from a
+            # handler always observe a cache >= the event
+            self.informers.throttles().add_event_handler(self._on_throttle_event)
+            self.informers.pods().add_event_handler(self._on_pod_event)
+        else:
+            self.store.add_event_handler("Throttle", self._on_throttle_event)
+            self.store.add_event_handler("Pod", self._on_pod_event)
 
     def _on_throttle_event(self, event: Event) -> None:
         thr = event.obj

@@ -184,6 +184,9 @@ class Store:
     def update_namespace(self, ns: Namespace) -> Namespace:
         return self._update("Namespace", ns)
 
+    def delete_namespace(self, name: str) -> Namespace:
+        return self._delete("Namespace", name)
+
     def get_namespace(self, name: str) -> Optional[Namespace]:
         try:
             return self._get("Namespace", name)
@@ -299,3 +302,12 @@ class Store:
     def resource_version(self, kind: str, key: str) -> int:
         with self._lock:
             return self._versions[kind][key]
+
+    @property
+    def latest_resource_version(self) -> int:
+        """The highest resourceVersion assigned so far (the list RV a
+        wire-protocol LIST response reports). Inside an event handler this is
+        exactly the dispatching event's RV — dispatch runs under the store
+        lock right after the bump."""
+        with self._lock:
+            return self._rv

@@ -10,7 +10,12 @@ reference (plugin_args.go:53-55 → plugin.go:93,104 → dropped; override
 wakeups are event-driven via NextOverrideHappensIn). Here it IS honored: the
 plugin passes it to both controllers as ``resync_interval``, the periodic
 enqueue-all backstop (controllers/base.py ``_resync``) that replaces the
-reference's 5-minute informer resync.
+reference's 5-minute informer resync. Note the cadence tradeoff: the 15s
+default re-enqueues every responsible key 20× more often than the
+reference's 5-minute resync — cheap here because the workqueue dedups and
+the batched reconcile pays one device aggregate per drain, but deployments
+with very large throttle counts that don't need fast staleness repair can
+raise it (e.g. ``"5m"``).
 """
 
 from __future__ import annotations

@@ -13,6 +13,7 @@ from typing import List, Optional, Sequence
 
 from ..api.pod import Pod
 from ..api.types import cluster_throttle_names, throttle_names
+from ..client import Clientset, InformerBundle, Listers, SharedInformerFactory
 from ..controllers import ClusterThrottleController, ThrottleController
 from ..engine.devicestate import DeviceStateManager
 from ..engine.store import Store
@@ -42,6 +43,7 @@ class KubeThrottler:
         use_device: bool = True,
         start_workers: bool = False,
         metrics_registry=None,
+        status_writer=None,
     ):
         clock = clock or RealClock()
         self.args = args
@@ -49,11 +51,36 @@ class KubeThrottler:
         self.event_recorder = event_recorder
         self.metrics_registry = metrics_registry or Registry()
         self.tracer = PhaseTracer(self.metrics_registry)
+        # ORDER MATTERS: the device mirror registers its store handlers
+        # FIRST so its rows/masks update before the informer fan-out reaches
+        # the controllers' enqueues — a worker draining the key immediately
+        # then reconciles against device state >= the event.
         self.device_manager = (
             DeviceStateManager(store, args.name, args.target_scheduler_name)
             if use_device
             else None
         )
+        # Generated-machinery analog, wired for real (plugin.go:71-130):
+        # a typed clientset over the cache, the schedule-group informer
+        # factory plus the separate core factory (whose pod informer carries
+        # the namespace indexer, plugin.go:81-84), and indexer-backed listers
+        # that every controller read goes through. Informer-level resync is
+        # disabled: the controllers' resync_interval
+        # (reconcileTemporaryThresholdInterval) is the periodic backstop.
+        self.clientset = Clientset(store)
+        self.informer_factory = SharedInformerFactory(store, resync_period=0.0)
+        self.core_informer_factory = SharedInformerFactory(store, resync_period=0.0)
+        self.informers = InformerBundle(self.informer_factory, self.core_informer_factory)
+        self.listers = Listers.from_factories(
+            self.informer_factory, self.core_informer_factory
+        )
+        self.informer_factory.start()
+        self.core_informer_factory.start()
+        if not (
+            self.informer_factory.wait_for_cache_sync()
+            and self.core_informer_factory.wait_for_cache_sync()
+        ):  # pragma: no cover — the store mirror syncs synchronously
+            raise RuntimeError("informer caches failed to sync")
         self.throttle_ctr = ThrottleController(
             throttler_name=args.name,
             target_scheduler_name=args.target_scheduler_name,
@@ -64,6 +91,9 @@ class KubeThrottler:
             device_manager=self.device_manager,
             metrics_recorder=ThrottleMetricsRecorder(self.metrics_registry),
             resync_interval=args.reconcile_temporary_threshold_interval,
+            listers=self.listers,
+            informers=self.informers,
+            status_writer=status_writer,
         )
         self.cluster_throttle_ctr = ClusterThrottleController(
             throttler_name=args.name,
@@ -75,6 +105,9 @@ class KubeThrottler:
             device_manager=self.device_manager,
             metrics_recorder=ClusterThrottleMetricsRecorder(self.metrics_registry),
             resync_interval=args.reconcile_temporary_threshold_interval,
+            listers=self.listers,
+            informers=self.informers,
+            status_writer=status_writer,
         )
         if self.device_manager is not None:
             self.device_manager.tracer = self.tracer
@@ -170,13 +203,13 @@ class KubeThrottler:
         import numpy as np
 
         with self.tracer.trace("prefilter_batch"):
-            known_ns = {ns.name for ns in self.store.list_namespaces()}
+            known_ns = {ns.name for ns in self.listers.namespaces.list()}
             schedulable: dict = {}
             errors: list = []
             if self.device_manager is None:
                 # host oracle, side-effect-free (no Warning events — triage
                 # only, matching the device path)
-                for pod in self.store.list_pods():
+                for pod in self.listers.pods.list():
                     try:
                         ta, ti, te, _ = self.throttle_ctr.check_throttled(pod, False)
                         ca, ci, ce, _ = self.cluster_throttle_ctr.check_throttled(pod, False)
@@ -254,6 +287,8 @@ class KubeThrottler:
     def stop(self) -> None:
         self.throttle_ctr.stop()
         self.cluster_throttle_ctr.stop()
+        self.informer_factory.shutdown()
+        self.core_informer_factory.shutdown()
 
     def run_pending_once(self) -> int:
         """Deterministic single-threaded drain (tests / embedding)."""

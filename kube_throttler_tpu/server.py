@@ -16,8 +16,9 @@ scheduler (or test driver) can use it without embedding Python:
 - ``POST /v1/bind``                  {"podKey", "nodeName"} — scheduler-sim
                                      convenience: marks the pod scheduled+Running
 
-All handlers are thin wrappers over Store / KubeThrottler; concurrency is
-whatever the plugin already guarantees.
+Handlers are thin wrappers over the plugin's typed clientset + listers
+(the client layer the reference reads/writes through, plugin.go:76-88);
+concurrency is whatever the plugin already guarantees.
 """
 
 from __future__ import annotations
@@ -63,9 +64,23 @@ def _throttle_to_dict(thr) -> dict:
 
 
 class ThrottlerHTTPServer:
-    def __init__(self, plugin: KubeThrottler, host: str = "127.0.0.1", port: int = 10259):
+    def __init__(
+        self,
+        plugin: KubeThrottler,
+        host: str = "127.0.0.1",
+        port: int = 10259,
+        remote: bool = False,
+    ):
+        """``remote=True`` (daemon synced from a real apiserver via
+        reflectors) disables the local object-mutation endpoints: a local
+        write to a reflector-owned kind would be silently reverted by the
+        next watch event — mutate the real cluster instead. Admission
+        endpoints (/v1/prefilter, reserve, unreserve) stay available."""
         self.plugin = plugin
+        self.remote = remote
         self.store = plugin.store
+        self.clientset = plugin.clientset
+        self.listers = plugin.listers
         # serializes get-then-update pod mutations (re-apply, bind): the
         # handler pool is threaded and a lost update here silently unbinds
         # a running pod
@@ -149,9 +164,11 @@ class ThrottlerHTTPServer:
                 content_type="text/plain; version=0.0.4",
             )
         elif h.path == "/v1/throttles":
-            h._send(200, [_throttle_to_dict(t) for t in self.store.list_throttles()])
+            h._send(200, [_throttle_to_dict(t) for t in self.listers.throttles.list()])
         elif h.path == "/v1/clusterthrottles":
-            h._send(200, [_throttle_to_dict(t) for t in self.store.list_cluster_throttles()])
+            h._send(
+                200, [_throttle_to_dict(t) for t in self.listers.cluster_throttles.list()]
+            )
         elif h.path == "/v1/pods":
             h._send(
                 200,
@@ -162,7 +179,7 @@ class ThrottlerHTTPServer:
                         "phase": p.status.phase,
                         "labels": p.labels,
                     }
-                    for p in self.store.list_pods()
+                    for p in self.listers.pods.list()
                 ],
             )
         else:
@@ -175,47 +192,57 @@ class ThrottlerHTTPServer:
         pod = object_from_dict(body)
         return pod
 
+    _REMOTE_REFUSAL = (
+        "this daemon mirrors a remote apiserver (kubeconfig mode); local "
+        "object writes would be reverted by the watch stream — mutate the "
+        "objects on the cluster instead"
+    )
+
     def _post(self, h) -> None:
         body = h._body()
+        if self.remote and h.path in ("/v1/objects", "/v1/bind"):
+            h._send(409, {"error": self._REMOTE_REFUSAL})
+            return
         if h.path == "/v1/objects":
             kind = body.get("kind", "")
+            core = self.clientset.core_v1()
+            schedule = self.clientset.schedule_v1alpha1()
             if kind == "Namespace":
                 ns = Namespace(
                     name=body["metadata"]["name"],
                     labels=dict(body["metadata"].get("labels") or {}),
                 )
                 try:
-                    self.store.create_namespace(ns)
+                    core.namespaces().create(ns)
                 except ValueError:
-                    self.store.update_namespace(ns)
+                    core.namespaces().update(ns)
                 h._send(200, {"applied": f"namespace/{ns.name}"})
                 return
             obj = object_from_dict(body)
             try:
                 if kind == "Pod":
-                    self.store.create_pod(obj)
+                    core.pods(obj.namespace).create(obj)
                 elif kind == "Throttle":
-                    self.store.create_throttle(obj)
+                    schedule.throttles(obj.namespace).create(obj)
                 else:
-                    self.store.create_cluster_throttle(obj)
+                    schedule.cluster_throttles().create(obj)
             except ValueError:
                 if kind == "Pod":
                     # a manifest re-apply must not clobber server-owned state:
                     # nodeName (set by bind) and phase live on the stored pod
                     with self._pod_write_lock:
-                        current = self.store.get_pod(obj.namespace, obj.name)
+                        current = core.pods(obj.namespace).get(obj.name)
                         if not obj.spec.node_name:
                             obj = replace(obj, spec=replace(obj.spec, node_name=current.spec.node_name))
                         if "status" not in body:
                             obj = replace(obj, status=replace(current.status))
-                        self.store.update_pod(obj)
+                        core.pods(obj.namespace).update(obj)
                 elif kind == "Throttle":
-                    # spec update must not clobber live status
-                    current = self.store.get_throttle(obj.namespace, obj.name)
-                    self.store.update_throttle(replace(obj, status=current.status))
+                    # the clientset's update has main-resource semantics: the
+                    # stored status is preserved (status subresource)
+                    schedule.throttles(obj.namespace).update(obj)
                 else:
-                    current = self.store.get_cluster_throttle(obj.name)
-                    self.store.update_cluster_throttle(replace(obj, status=current.status))
+                    schedule.cluster_throttles().update(obj)
             h._send(200, {"applied": getattr(obj, "key", obj.name)})
         elif h.path == "/v1/prefilter":
             pod = self._resolve_pod(body)
@@ -252,6 +279,9 @@ class ThrottlerHTTPServer:
             h._send(404, {"error": f"unknown path {h.path}"})
 
     def _delete(self, h) -> None:
+        if self.remote:
+            h._send(409, {"error": self._REMOTE_REFUSAL})
+            return
         parts = h.path.strip("/").split("/")
         if len(parts) < 3 or parts[0] != "v1" or parts[1] != "objects":
             h._send(404, {"error": f"unknown path {h.path}"})
@@ -260,12 +290,12 @@ class ThrottlerHTTPServer:
         key = "/".join(parts[3:])
         if kind == "pods":
             namespace, _, name = key.partition("/")
-            self.store.delete_pod(namespace, name)
+            self.clientset.core_v1().pods(namespace).delete(name)
         elif kind == "throttles":
             namespace, _, name = key.partition("/")
-            self.store.delete_throttle(namespace, name)
+            self.clientset.schedule_v1alpha1().throttles(namespace).delete(name)
         elif kind == "clusterthrottles":
-            self.store.delete_cluster_throttle(key)
+            self.clientset.schedule_v1alpha1().cluster_throttles().delete(key)
         else:
             h._send(404, {"error": f"unknown kind {kind}"})
             return

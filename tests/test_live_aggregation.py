@@ -216,6 +216,64 @@ class TestFullRebasePaths:
         _assert_status_matches_oracle(store, plugin)
         assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
 
+    def test_namespace_move_between_selector_terms_converges(self):
+        """A relabel that moves the namespace from one selector term to
+        another keeps the OR-aggregate namespace match True on both sides
+        while the counted pod set changes completely — the flip detection
+        must be per term."""
+        store, plugin, _ = _stack()
+        store.create_cluster_throttle(
+            ClusterThrottle(
+                name="ct2",
+                spec=ClusterThrottleSpec(
+                    throttler_name="kube-throttler",
+                    threshold=ResourceAmount.of(pod=10),
+                    selector=ClusterThrottleSelector(
+                        selector_terms=(
+                            ClusterThrottleSelectorTerm(
+                                pod_selector=LabelSelector(match_labels={"grp": "a"}),
+                                namespace_selector=LabelSelector(
+                                    match_labels={"team": "x"}
+                                ),
+                            ),
+                            ClusterThrottleSelectorTerm(
+                                pod_selector=LabelSelector(match_labels={"grp": "b"}),
+                                namespace_selector=LabelSelector(
+                                    match_labels={"team": "y"}
+                                ),
+                            ),
+                        )
+                    ),
+                ),
+            )
+        )
+        store.create_namespace(Namespace("team-ns", labels={"team": "x"}))
+        store.create_pod(
+            _bound(
+                make_pod(
+                    "pa", namespace="team-ns", labels={"grp": "a"}, requests={"cpu": "1"}
+                )
+            )
+        )
+        store.create_pod(
+            _bound(
+                make_pod(
+                    "pb", namespace="team-ns", labels={"grp": "b"}, requests={"cpu": "2"}
+                )
+            )
+        )
+        _assert_status_matches_oracle(store, plugin)
+        ct = store.get_cluster_throttle("ct2")
+        assert ct.status.used.resource_counts == 1  # only pa (term 1)
+
+        # term-1 match flips off, term-2 flips on: counted set pa → pb,
+        # with NO pod poke
+        store.update_namespace(Namespace("team-ns", labels={"team": "y"}))
+        _assert_status_matches_oracle(store, plugin)
+        ct = store.get_cluster_throttle("ct2")
+        assert ct.status.used.resource_counts == 1
+        assert ct.status.used.resource_requests == {"cpu": 2}
+
     def test_resync_backstop_converges_after_missed_event(self):
         """reconcileTemporaryThresholdInterval as the eventual-consistency
         backstop (the analog of the reference's 5-min informer resync,
@@ -238,7 +296,7 @@ class TestFullRebasePaths:
         _assert_status_matches_oracle(store, plugin)
         assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
 
-        store.remove_event_handler("Namespace", ctr._on_namespace_event)
+        plugin.informers.namespaces().remove_event_handler(ctr._on_namespace_event)
         store.update_namespace(Namespace("team-ns", labels={"team": "y"}))
         plugin.run_pending_once()
         # event missed → stale (exactly the round-2 bug, now confined to a
