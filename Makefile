@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress lint gen bench bench-quick walkthrough smoke serve clean native
+.PHONY: test test-stress lint gen bench bench-quick walkthrough smoke serve clean native image
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -19,6 +19,9 @@ lint:            ## syntax + import sanity over the package
 
 gen:             ## regenerate deploy/crd.yaml from the typed API model
 	$(PY) tools/gen_crd.py
+
+image:           ## container image for deploy/deployment.yaml (Dockerfile)
+	tools/build_image.sh
 
 bench:           ## the five BASELINE.json configs (one JSON line on stdout)
 	$(PY) bench.py

@@ -528,6 +528,257 @@ def bench_streaming(rng, T, R, label, n_events=1000):
     return eps
 
 
+def _served_throttle(i, groups):
+    """Throttle i selecting pod group g{i%groups}; threshold class varies so
+    probe verdicts mix (open / tight cpu / pod-count)."""
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ResourceAmount,
+        Throttle,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+        ThrottleSpec,
+    )
+
+    if i % 3 == 0:
+        threshold = ResourceAmount.of(pod=10**6, requests={"cpu": "100000"})
+    elif i % 3 == 1:
+        threshold = ResourceAmount.of(requests={"cpu": f"{(i % 7 + 1) * 2}"})
+    else:
+        threshold = ResourceAmount.of(pod=(i % 50) + 5)
+    return Throttle(
+        name=f"t{i}",
+        spec=ThrottleSpec(
+            throttler_name="kube-throttler",
+            threshold=threshold,
+            selector=ThrottleSelector(
+                selector_terms=(
+                    ThrottleSelectorTerm(
+                        LabelSelector(match_labels={"grp": f"g{i % groups}"})
+                    ),
+                )
+            ),
+        ),
+    )
+
+
+def build_served_stack(P, T, groups=500, label="served"):
+    """The REAL daemon stack at scale: store events → device mirror →
+    controllers → statuses, exactly what production serves from. Returns
+    (store, plugin). Setup cost is logged per phase (it is the honest cost
+    of cold-starting this state)."""
+    import random
+
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    rng = random.Random(0)
+    store = Store()
+    plugin = KubeThrottler(
+        decode_plugin_args(
+            {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+        ),
+        store,
+        use_device=True,
+    )
+    store.create_namespace(Namespace("default"))
+
+    t0 = time.perf_counter()
+    for i in range(T):
+        store.create_throttle(_served_throttle(i, groups))
+    t_thr = time.perf_counter() - t0
+    log(f"[{label}] created {T} throttles in {t_thr:.1f}s")
+
+    t0 = time.perf_counter()
+    from dataclasses import replace as _replace
+
+    for i in range(P):
+        pod = make_pod(
+            f"p{i}",
+            labels={"grp": f"g{rng.randrange(groups)}"},
+            requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+        )
+        pod = _replace(pod, spec=_replace(pod.spec, node_name="node-1"))
+        pod.status.phase = "Running"
+        store.create_pod(pod)
+    t_pods = time.perf_counter() - t0
+    log(f"[{label}] created {P} bound pods in {t_pods:.1f}s "
+        f"({t_pods/P*1e6:.0f}us/event through the live index)")
+
+    t0 = time.perf_counter()
+    n = plugin.run_pending_once()
+    t_rec = time.perf_counter() - t0
+    log(f"[{label}] initial reconcile of {n} keys in {t_rec:.1f}s "
+        f"(batched device aggregates)")
+    return store, plugin
+
+
+def bench_served_prefilter(plugin, label, groups=500, n=2000):
+    """(VERDICT r2 task 4a) END-TO-END plugin.pre_filter latency through
+    DeviceStateManager.check_pod — lock, request encode, mask row, kernel
+    dispatch, decode, reason strings — against the live state. This is the
+    number BASELINE's north star names (<1ms p99 per decision)."""
+    from kube_throttler_tpu.api.pod import make_pod
+
+    probes = [
+        make_pod(
+            f"probe{i}",
+            labels={"grp": f"g{i % groups}"},
+            requests={"cpu": f"{(i % 7 + 1) * 100}m"},
+        )
+        for i in range(64)
+    ]
+    i = [0]
+
+    def one():
+        plugin.pre_filter(probes[i[0] % len(probes)])
+        i[0] += 1
+
+    stats = host_percentiles(one, n)
+    log(
+        f"[{label}] SERVED pre_filter p50 {stats['p50']*1e3:.3f}ms / "
+        f"p99 {stats['p99']*1e3:.3f}ms per decision "
+        f"({1/stats['mean']:,.0f} decisions/sec single-threaded)"
+    )
+
+    # thread scaling (VERDICT r2 task 5 done-bar): the device-state lock
+    # covers only host-side snapshot grabs, so concurrent checkers should
+    # scale until dispatch overhead saturates
+    import threading as _threading
+
+    def measure_threads(k, duration=2.0):
+        stop = _threading.Event()
+        counts = [0] * k
+
+        def worker(idx):
+            j = idx
+            while not stop.is_set():
+                plugin.pre_filter(probes[j % len(probes)])
+                counts[idx] += 1
+                j += k
+
+        threads = [_threading.Thread(target=worker, args=(w,)) for w in range(k)]
+        for th in threads:
+            th.start()
+        time.sleep(duration)
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        return sum(counts) / duration
+
+    rate1 = measure_threads(1)
+    rate4 = measure_threads(4)
+    log(
+        f"[{label}] served check throughput: {rate1:,.0f}/s x1 thread, "
+        f"{rate4:,.0f}/s x4 threads (scaling {rate4/max(rate1,1e-9):.2f}x)"
+    )
+    return stats, rate1, rate4
+
+
+def bench_served_streaming(store, plugin, label, groups=500, duration=5.0):
+    """(VERDICT r2 task 4b) BASELINE cfg5 driven as store events through the
+    CONTROLLERS: pod churn at full rate with workers running; reports the
+    sustained pipeline rate and the event→status-commit lag (time from the
+    first store event touching a throttle to the status write that reflects
+    it — the reference's watch→reconcile→UpdateStatus latency,
+    throttle_controller.go:84-211)."""
+    import random
+    import threading as _threading
+    from dataclasses import replace as _replace
+
+    from kube_throttler_tpu.api.pod import make_pod
+    from kube_throttler_tpu.engine.store import EventType
+
+    rng = random.Random(1)
+    # key → time of the first event not yet reflected in a status write
+    pending: dict = {}
+    pend_lock = _threading.Lock()
+    lags: list = []
+    group_keys: dict = {}
+    for thr in store.list_throttles():
+        g = thr.spec.selector.selector_terms[0].pod_selector.match_labels["grp"]
+        group_keys.setdefault(g, []).append(thr.key)
+
+    def on_throttle_write(event):
+        if event.type != EventType.MODIFIED:
+            return
+        now = time.perf_counter()
+        with pend_lock:
+            t0 = pending.pop(event.obj.key, None)
+        if t0 is not None:
+            lags.append(now - t0)
+
+    store.add_event_handler("Throttle", on_throttle_write, replay=False)
+    plugin.start()
+    try:
+        pods = store.list_pods()
+        cur_cpu: dict = {}  # pod name → last cpu we wrote (lag accounting)
+        n_events = 0
+        t_start = time.perf_counter()
+        deadline = t_start + duration
+        while time.perf_counter() < deadline:
+            pod = pods[rng.randrange(len(pods))]
+            g = pod.labels["grp"]
+            # a REAL state change every time: pick a cpu value different
+            # from the last one written, so every event flips some
+            # throttle's used and the pending→write lag pairing is sound
+            # (a no-op event would leave a stale pending timestamp that
+            # poisons the next genuine write's lag sample)
+            prev = cur_cpu.get(pod.name)
+            if prev is None:  # seed from the pod's actual stored request
+                from kube_throttler_tpu.resourcelist import pod_request_resource_list
+
+                stored = pod_request_resource_list(pod).get("cpu")
+                prev = int(stored * 1000) if stored else 0
+            new_cpu = rng.randrange(1, 8) * 100
+            if new_cpu == prev:
+                new_cpu = new_cpu % 700 + 100
+            cur_cpu[pod.name] = new_cpu
+            updated = make_pod(
+                pod.name,
+                labels=pod.labels,
+                requests={"cpu": f"{new_cpu}m"},
+            )
+            updated = _replace(
+                updated, spec=_replace(updated.spec, node_name="node-1")
+            )
+            updated.status.phase = "Running"
+            now = time.perf_counter()
+            with pend_lock:
+                for key in group_keys.get(g, ()):
+                    pending.setdefault(key, now)
+            store.update_pod(updated)
+            n_events += 1
+        t_fired = time.perf_counter() - t_start
+        # drain: wait for both workqueues to empty and writes to land
+        for ctr in (plugin.throttle_ctr, plugin.cluster_throttle_ctr):
+            while len(ctr.workqueue) > 0:
+                time.sleep(0.02)
+        time.sleep(0.2)
+        t_total = time.perf_counter() - t_start
+    finally:
+        plugin.stop()
+        store.remove_event_handler("Throttle", on_throttle_write)
+
+    eps = n_events / t_total
+    lag_arr = np.asarray(lags) if lags else np.asarray([0.0])
+    result = {
+        "events_per_sec": eps,
+        "lag_p50_ms": float(np.percentile(lag_arr, 50)) * 1e3,
+        "lag_p99_ms": float(np.percentile(lag_arr, 99)) * 1e3,
+        "status_writes": len(lags),
+    }
+    log(
+        f"[{label}] cfg5 THROUGH CONTROLLERS: {n_events} events in {t_total:.2f}s "
+        f"-> {eps:,.0f} events/sec sustained (fired in {t_fired:.2f}s); "
+        f"event->status-commit lag p50 {result['lag_p50_ms']:.1f}ms / "
+        f"p99 {result['lag_p99_ms']:.1f}ms over {len(lags)} status writes "
+        f"(target: 1k events/sec)"
+    )
+    return result
+
+
 def bench_example_scenario(label):
     """BASELINE config 1: the example/throttle.yaml t1 + walkthrough pods
     through the FULL plugin stack on the host-oracle path (the 'CPU
@@ -698,35 +949,79 @@ def main():
                 "cfg4:indexed", bench_single_pod_indexed, rng, state, T, R, "cfg4:100kx10k"
             )
 
-        # config 5: streaming reconcile
+        # config 5: streaming reconcile (bare device kernels)
         eps_scan = safe("cfg5:scan", bench_streaming, rng, T, R, "cfg5:streaming")
         eps_batch = safe("cfg5:batched", bench_streaming_batched, rng, T, R, "cfg5:streaming")
         if eps_batch:
-            detail["cfg5_events_per_sec"] = round(eps_batch)
+            detail["cfg5_kernel_events_per_sec"] = round(eps_batch)
         elif eps_scan:
-            detail["cfg5_events_per_sec"] = round(eps_scan)
+            detail["cfg5_kernel_events_per_sec"] = round(eps_scan)
+
+    # ---- the SERVED paths (VERDICT r2 task 4): the full daemon stack at
+    # the cfg4 scale — pre_filter end-to-end through check_pod (headline),
+    # and cfg5 as store events through the controllers ----
+    served_stats = None
+    if devices:
+        stack = safe(
+            "served:setup", build_served_stack, 100_000 // scale, 10_000 // scale
+        )
+        if stack:
+            store_s, plugin_s = stack
+            r = safe("served:prefilter", bench_served_prefilter, plugin_s, "served")
+            if r:
+                served_stats, rate1, rate4 = r
+                detail["served_p50_ms"] = round(served_stats["p50"] * 1e3, 4)
+                detail["served_decisions_per_sec_1t"] = round(rate1)
+                detail["served_decisions_per_sec_4t"] = round(rate4)
+                detail["served_thread_scaling"] = round(rate4 / max(rate1, 1e-9), 2)
+            s = safe(
+                "served:streaming", bench_served_streaming, store_s, plugin_s, "served"
+            )
+            if s:
+                detail["cfg5_served_events_per_sec"] = round(s["events_per_sec"])
+                detail["cfg5_status_lag_p50_ms"] = round(s["lag_p50_ms"], 2)
+                detail["cfg5_status_lag_p99_ms"] = round(s["lag_p99_ms"], 2)
+            safe("served:stop", plugin_s.stop)
 
     target_ms = 1.0  # BASELINE north star: <1ms p99 on one v5e-1
-    if single_stats is not None:
+    if served_stats is not None:
+        # THE headline: end-to-end PreFilter through the real daemon stack
+        value_ms = served_stats["p99"] * 1e3
+        if single_stats is not None:
+            detail["kernel_p99_ms"] = round(
+                max(float(single_stats["p99"]) * 1e3, 1e-4), 4
+            )
+            detail["single_cv"] = round(single_stats["cv"], 4)
+        metric = (
+            "SERVED PreFilter decision p99 latency: plugin.pre_filter end-to-end "
+            "(device-indexed check) vs live 100k-pod/10k-throttle daemon state, "
+            f"1 {platform} chip"
+        )
+        comparable = True
+    elif single_stats is not None:
         value_ms = max(float(single_stats["p99"]) * 1e3, 1e-4)  # slope noise floor
         detail["single_mean_ms"] = round(max(single_stats["mean"] * 1e3, 1e-4), 4)
         detail["single_cv"] = round(single_stats["cv"], 4)
         metric = (
             "PreFilter decision latency, single pod vs 100k-pod/10k-throttle state "
-            f"(p99 over slope estimates, device time, 1 {platform} chip)"
+            "(p99 over slope estimates, bare kernel — served path unavailable, "
+            f"see errors; 1 {platform} chip)"
         )
+        comparable = True
     elif cfg1 is not None:
         # device headline config unavailable (backend down, or cfg4 itself
         # failed — see `errors`): fall back to the honest host-path p99 so the
         # round still records a real measurement rather than nothing.
         value_ms = cfg1["p99"] * 1e3
         metric = "PreFilter decision p99 latency, host-oracle path (device headline config unavailable)"
+        comparable = False
     else:
         value_ms, metric = -1.0, "bench failed; see errors"
+        comparable = False
 
-    # vs_baseline compares against the DEVICE-path north star; a host-only
+    # vs_baseline compares against the device-path north star; a host-only
     # fallback number is not comparable and must not record a fake win.
-    comparable = single_stats is not None and value_ms > 0
+    comparable = comparable and value_ms > 0
     out = {
         "metric": metric,
         "value": round(value_ms, 4),

@@ -1,0 +1,31 @@
+"""kube_throttler_tpu — a TPU-native re-design of everpeace/kube-throttler.
+
+The reference (mounted read-only at /root/reference) is a Kubernetes
+scheduling-framework plugin, written in Go, that throttles pod scheduling:
+pods stay Pending while the aggregate ``resources.requests`` / running-pod
+count matched by a ``Throttle`` / ``ClusterThrottle`` CRD would exceed a
+threshold (reference README.md:3-15).
+
+This package keeps the reference's *semantics* — the ordered 4-state
+admission check, presence-masked per-dimension comparison, temporary
+threshold overrides, the reserve-until-observed handshake — but re-expresses
+the decision core as batched XLA tensor programs:
+
+- host control plane (``engine/``, ``controllers/``, ``plugin/``): typed CRD
+  model, watch-protocol event ingestion, workqueue reconciliation,
+  reservation ledger, metrics, status write-back;
+- device data plane (``ops/``, ``parallel/``): padded int64 milli-unit
+  tensors with presence masks; the (pod × throttle × resource-dim)
+  admission check is one vmapped/jitted kernel; scale-out is data-parallel
+  sharding of the check matrix over a ``jax.sharding.Mesh``.
+
+Exact decimal semantics of k8s ``resource.Quantity`` are preserved via
+integer milli-units (see ``quantity.py``), which requires 64-bit integers:
+x64 mode is enabled at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

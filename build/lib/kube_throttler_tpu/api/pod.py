@@ -1,0 +1,115 @@
+"""Minimal Pod / Namespace model.
+
+The reference consumes upstream ``corev1.Pod``; the new framework is
+standalone, so this module defines exactly the slice of the pod object the
+throttler reads:
+
+- ``metadata``: namespace/name/uid/labels (selector matching, ledger keys);
+- ``spec``: schedulerName + nodeName (count-in predicate), container /
+  init-container requests + overhead (effective request);
+- ``status.phase`` (terminated predicate).
+
+Predicates mirror the reference's pkg/controllers/pod_util.go:
+``is_scheduled`` = NodeName != "" (pod_util.go:300-302 per SURVEY);
+``is_not_finished`` = phase ∉ {Succeeded, Failed}.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Union
+
+from ..quantity import parse_quantity
+from ..resourcelist import ResourceList
+
+_uid_counter = itertools.count(1)
+
+
+def _gen_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class Container:
+    requests: ResourceList = field(default_factory=dict)
+    name: str = ""
+
+    @staticmethod
+    def of(requests: Mapping[str, Union[str, int, float]], name: str = "") -> "Container":
+        return Container(
+            requests={k: parse_quantity(v) for k, v in requests.items()}, name=name
+        )
+
+
+@dataclass
+class PodSpec:
+    scheduler_name: str = ""
+    node_name: str = ""
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    overhead: Optional[ResourceList] = None
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed | Unknown
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    uid: str = field(default_factory=_gen_uid)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def key(self) -> str:
+        """namespace/name — the NamespacedName string form used everywhere."""
+        return f"{self.namespace}/{self.name}"
+
+    def is_scheduled(self) -> bool:
+        return self.spec.node_name != ""
+
+    def is_not_finished(self) -> bool:
+        return self.status.phase not in ("Succeeded", "Failed")
+
+
+@dataclass
+class Namespace:
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    uid: str = field(default_factory=_gen_uid)
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    requests: Optional[Mapping[str, Union[str, int, float]]] = None,
+    init_requests: Optional[List[Mapping[str, Union[str, int, float]]]] = None,
+    overhead: Optional[Mapping[str, Union[str, int, float]]] = None,
+    scheduler_name: str = "my-scheduler",
+    node_name: str = "",
+    phase: str = "Pending",
+) -> Pod:
+    """Test/bench convenience builder (single app container)."""
+    containers = [Container.of(requests or {})]
+    init_containers = [Container.of(r) for r in (init_requests or [])]
+    return Pod(
+        name=name,
+        namespace=namespace,
+        labels=dict(labels or {}),
+        spec=PodSpec(
+            scheduler_name=scheduler_name,
+            node_name=node_name,
+            containers=containers,
+            init_containers=init_containers,
+            overhead={k: parse_quantity(v) for k, v in overhead.items()}
+            if overhead
+            else None,
+        ),
+        status=PodStatus(phase=phase),
+    )

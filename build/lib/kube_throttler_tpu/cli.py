@@ -1,0 +1,251 @@
+"""CLI entry point (reference cmd/: cobra root + kube-scheduler + version).
+
+The reference binary embeds upstream kube-scheduler with the plugin
+registered (cmd/kube_scheduler.go:90-106). The standalone TPU framework has
+no scheduler to embed, so ``serve`` runs the throttler as a daemon: the
+in-memory store + controllers + device mirror + the HTTP surface
+(PreFilter/Reserve/Unreserve + object CRUD + /metrics).
+
+Usage:
+    python -m kube_throttler_tpu.cli serve --name kube-throttler \
+        --target-scheduler-name my-scheduler [--port 10259] [--config cfg.yaml]
+    python -m kube_throttler_tpu.cli version
+
+``--config`` accepts a KubeSchedulerConfiguration-style YAML: the args are
+read from ``profiles[*].pluginConfig[name=kube-throttler].args`` (the same
+shape as deploy/config.yaml in the reference) or from a flat mapping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from . import __version__
+from .api.pod import Namespace
+from .engine.store import Store
+from .utils import tracing
+from .plugin import KubeThrottler, decode_plugin_args
+from .plugin.framework import RecordingEventRecorder
+from .server import ThrottlerHTTPServer
+
+
+def _load_config_file(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def _args_from_config(cfg: Dict[str, Any], path: str) -> Dict[str, Any]:
+    for profile in cfg.get("profiles", []) or []:
+        for pc in profile.get("pluginConfig", []) or []:
+            if pc.get("name") == "kube-throttler":
+                return dict(pc.get("args") or {})
+    if "name" in cfg:
+        return cfg
+    # a config carrying only scheduler-level blocks (e.g. leaderElection) is
+    # fine — plugin args may come from CLI flags; decode_plugin_args
+    # validates the merged result
+    return {}
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(prog="kube-throttler-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="run the throttler daemon")
+    serve.add_argument("--config", help="KubeSchedulerConfiguration-style YAML")
+    serve.add_argument("--name", help="throttler name (spec.throttlerName to own)")
+    serve.add_argument("--target-scheduler-name", help="schedulerName of governed pods")
+    serve.add_argument(
+        "--kubeconfig",
+        help="connect to a real apiserver: list+watch reflectors keep the "
+        "local cache synced and status writes go to the status subresource "
+        "(plugin.go:71-130); without it the daemon runs its own in-memory "
+        "apiserver fed via the HTTP surface",
+    )
+    serve.add_argument("--controller-threadiness", type=int, default=0)
+    serve.add_argument("--num-key-mutex", type=int, default=0)
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=10259)
+    serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
+    serve.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="block until the leadership lease is acquired before serving "
+        "(also honours leaderElection.leaderElect in --config)",
+    )
+    serve.add_argument(
+        "--lock-file",
+        default="",
+        help="flock leadership lease path (default: a 0700 per-user runtime "
+        "dir; with --kubeconfig leader election uses a Lease object on the "
+        "apiserver instead — multi-host capable)",
+    )
+    serve.add_argument(
+        "--nodes",
+        type=int,
+        default=0,
+        help="run the embedded scheduler loop binding pods onto N simulated "
+        "nodes (the reference binary embeds kube-scheduler; 0 = admission "
+        "daemon only, an external scheduler calls /v1/prefilter)",
+    )
+    serve.add_argument("--node-max-pods", type=int, default=300)
+    serve.add_argument(
+        "--v", type=int, default=0, dest="verbosity",
+        help="klog-style verbosity (0-5); change at runtime via PUT /debug/flags/v",
+    )
+
+    sub.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        print(f"kube-throttler-tpu version {__version__}")
+        return 0
+
+    # klog-equivalent logging: INFO to stderr, V-levels gate detail lines
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
+    )
+    tracing.set_verbosity(args.verbosity)
+
+    config: Dict[str, Any] = {}
+    leader_elect = args.leader_elect
+    if args.config:
+        raw_cfg = _load_config_file(args.config)
+        config = _args_from_config(raw_cfg, args.config)
+        # KubeSchedulerConfiguration leaderElection parity (the reference
+        # inherits this from the embedded kube-scheduler)
+        if (raw_cfg.get("leaderElection") or {}).get("leaderElect"):
+            leader_elect = True
+    if args.name:
+        config["name"] = args.name
+    if args.target_scheduler_name:
+        config["targetSchedulerName"] = args.target_scheduler_name
+    if args.kubeconfig:
+        config["kubeconfig"] = args.kubeconfig
+    if args.controller_threadiness:
+        config["controllerThrediness"] = args.controller_threadiness
+    if args.num_key_mutex:
+        config["numKeyMutex"] = args.num_key_mutex
+
+    try:
+        plugin_args = decode_plugin_args(config)
+    except ValueError as e:
+        parser.error(str(e))  # clean usage error, not a traceback
+
+    if plugin_args.kubeconfig and args.nodes > 0:
+        # the embedded scheduler binds pods in the LOCAL store; in remote
+        # mode the reflectors own those objects and would revert every bind
+        parser.error(
+            "--nodes (embedded scheduler) cannot be combined with "
+            "--kubeconfig: bind decisions must go to the real apiserver — "
+            "run an external scheduler against /v1/prefilter instead"
+        )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+
+    elector = None
+    if leader_elect:
+        if plugin_args.kubeconfig and not args.lock_file:
+            # multi-host: a coordination.k8s.io Lease on the shared
+            # apiserver — replicas on different hosts compete for it, like
+            # the reference's embedded kube-scheduler leader election
+            import os as _os
+            import socket
+
+            from .client.transport import ApiClient, parse_kubeconfig
+            from .utils.leaderelect import HttpLeaseElector
+
+            elector = HttpLeaseElector(
+                ApiClient(parse_kubeconfig(plugin_args.kubeconfig)),
+                name=f"kube-throttler-tpu-{plugin_args.name}",
+                identity=f"{socket.gethostname()}-{_os.getpid()}",
+            )
+            print(
+                f"leader election on Lease kube-throttler-tpu-{plugin_args.name}: "
+                "waiting...",
+                flush=True,
+            )
+        else:
+            from .utils.leaderelect import FileLeaseElector, default_lease_path
+
+            lock_path = args.lock_file or default_lease_path(plugin_args.name)
+            elector = FileLeaseElector(lock_path)
+            print(f"leader election on {lock_path}: waiting for lease...", flush=True)
+        try:
+            if not elector.acquire(stop):
+                return 0  # interrupted while standing by
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr, flush=True)
+            return 1
+
+    store = Store()
+    session = None
+    if plugin_args.kubeconfig:
+        from .client.transport import RemoteSession
+
+        session = RemoteSession.from_kubeconfig(plugin_args.kubeconfig, store)
+        print(
+            f"syncing from apiserver {session.config.server} "
+            f"(kubeconfig={plugin_args.kubeconfig})...",
+            flush=True,
+        )
+        session.start()  # blocks until every reflector listed once
+    else:
+        store.create_namespace(Namespace("default"))
+    plugin = KubeThrottler(
+        plugin_args,
+        store,
+        event_recorder=RecordingEventRecorder(),
+        use_device=not args.no_device,
+        start_workers=True,
+        status_writer=session.status_writer if session is not None else None,
+    )
+    scheduler = None
+    if args.nodes > 0:
+        from .scheduler import Node, Scheduler
+
+        scheduler = Scheduler(
+            plugin,
+            store,
+            nodes=[Node(f"node-{i+1}", max_pods=args.node_max_pods) for i in range(args.nodes)],
+        )
+        scheduler.start()
+
+    server = ThrottlerHTTPServer(
+        plugin, host=args.host, port=args.port, remote=session is not None
+    )
+    server.start()
+    print(
+        f"kube-throttler-tpu serving on {args.host}:{server.port} "
+        f"(throttler={plugin_args.name}, scheduler={plugin_args.target_scheduler_name}, "
+        f"device={'on' if not args.no_device else 'off'}, "
+        f"embedded-scheduler={'%d nodes' % args.nodes if args.nodes else 'off'})",
+        flush=True,
+    )
+
+    stop.wait()
+    server.stop()
+    if scheduler is not None:
+        scheduler.stop()
+    if session is not None:
+        session.stop()
+    plugin.stop()
+    if elector is not None:
+        elector.release()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,272 @@
+"""Shared informers + indexer cache — the informer-gen analog
+(pkg/generated/informers/externalversions/).
+
+The reference builds a SharedInformerFactory with a 5-minute resync
+(plugin.go:76-79) plus a second factory for Pods/Namespaces with a
+namespace indexer (plugin.go:81-88). Here:
+
+- :class:`Indexer` — thread-safe keyed cache with named secondary indexes
+  (client-go ``cache.Indexer``; the namespace index is built in).
+- :class:`SharedIndexInformer` — one per kind, shared via the factory;
+  mirrors the store into its indexer, fans events out to its own handlers,
+  and runs a periodic resync that re-delivers MODIFIED(obj, obj) "sync"
+  events exactly like client-go's resync.
+- :class:`SharedInformerFactory` — lazily creates/shares informers,
+  ``start()`` / ``wait_for_cache_sync()`` / ``shutdown()`` lifecycle
+  (factory.go:126-181).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Set
+
+from ..engine.store import Event, EventType, Store, key_of
+
+Handler = Callable[[Event], None]
+
+
+class Indexer:
+    """Keyed object cache with named secondary indexes."""
+
+    def __init__(self, index_funcs: Optional[Dict[str, Callable[[object], List[str]]]] = None):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, object] = {}
+        self._index_funcs = index_funcs or {}
+        # index name -> index value -> set of object keys
+        self._indices: Dict[str, Dict[str, Set[str]]] = {
+            name: defaultdict(set) for name in self._index_funcs
+        }
+
+    def _unindex(self, key: str, obj: object) -> None:
+        for name, fn in self._index_funcs.items():
+            for value in fn(obj):
+                bucket = self._indices[name].get(value)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._indices[name][value]
+
+    def _index(self, key: str, obj: object) -> None:
+        for name, fn in self._index_funcs.items():
+            for value in fn(obj):
+                self._indices[name][value].add(key)
+
+    def upsert(self, key: str, obj: object) -> None:
+        with self._lock:
+            old = self._objects.get(key)
+            if old is not None:
+                self._unindex(key, old)
+            self._objects[key] = obj
+            self._index(key, obj)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            old = self._objects.pop(key, None)
+            if old is not None:
+                self._unindex(key, old)
+
+    def get(self, key: str):
+        with self._lock:
+            return self._objects.get(key)
+
+    def list(self) -> List[object]:
+        with self._lock:
+            return list(self._objects.values())
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._objects.keys())
+
+    def by_index(self, index_name: str, value: str) -> List[object]:
+        with self._lock:
+            keys = self._indices[index_name].get(value, set())
+            return [self._objects[k] for k in keys if k in self._objects]
+
+
+NAMESPACE_INDEX = "namespace"
+
+
+class SharedIndexInformer:
+    """One shared informer for one kind; handlers added late get a replay of
+    the cache as synthetic ADDED events (cache-sync semantics)."""
+
+    def __init__(self, store: Store, kind: str, resync_period: float) -> None:
+        self._store = store
+        self.kind = kind
+        self._resync_period = resync_period
+        index_funcs = {}
+        if kind in ("Pod", "Throttle"):
+            index_funcs[NAMESPACE_INDEX] = lambda obj: [obj.namespace]
+        self.indexer = Indexer(index_funcs)
+        self._handlers: List[Handler] = []
+        self._lock = threading.RLock()
+        # ALL handler deliveries (store events and resync) serialize through
+        # this lock — client-go's contract is per-listener serial delivery,
+        # and without it the resync thread could interleave with a mutator
+        # thread inside one handler, or deliver MODIFIED after DELETED.
+        # Lock order is store-lock → dispatch-lock (store events arrive
+        # holding the store lock); handlers must therefore never mutate the
+        # store synchronously — enqueue only, like informer handlers.
+        self._dispatch_lock = threading.RLock()
+        self._synced = threading.Event()
+        self._stop: Optional[threading.Event] = None
+        self._resync_thread: Optional[threading.Thread] = None
+
+        # the store-facing subscription mirrors every event into the indexer
+        # BEFORE fanning out, so handlers observe a cache ≥ the event
+        self._store.add_event_handler(kind, self._on_store_event, replay=True)
+        self._synced.set()
+
+    def _on_store_event(self, event: Event) -> None:
+        with self._dispatch_lock:
+            key = key_of(self.kind, event.obj)
+            if event.type == EventType.DELETED:
+                self.indexer.delete(key)
+            else:
+                self.indexer.upsert(key, event.obj)
+            with self._lock:
+                handlers = list(self._handlers)
+            for h in handlers:
+                h(event)
+
+    def add_event_handler(self, handler: Handler, replay: bool = True) -> None:
+        # registration + replay under the dispatch lock: otherwise a
+        # concurrent DELETED could reach the new handler before the stale
+        # replay ADDED, resurrecting a deleted object downstream
+        with self._dispatch_lock:
+            with self._lock:
+                self._handlers.append(handler)
+            if replay:
+                for obj in self.indexer.list():
+                    handler(Event(EventType.ADDED, self.kind, obj))
+
+    def remove_event_handler(self, handler: Handler) -> None:
+        with self._lock:
+            try:
+                self._handlers.remove(handler)
+            except ValueError:
+                pass
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def run(self, stop: threading.Event) -> None:
+        """Start the resync loop (no-op when resync_period == 0)."""
+        self._stop = stop
+        if self._resync_period <= 0 or self._resync_thread is not None:
+            return
+
+        def loop() -> None:
+            while not stop.wait(self._resync_period):
+                for key in self.indexer.keys():
+                    with self._dispatch_lock:
+                        # re-read under the dispatch lock: if the object was
+                        # deleted since the snapshot, skip — a sync event
+                        # must never resurrect a deleted object downstream
+                        obj = self.indexer.get(key)
+                        if obj is None:
+                            continue
+                        with self._lock:
+                            handlers = list(self._handlers)
+                        for h in handlers:
+                            h(Event(EventType.MODIFIED, self.kind, obj, old_obj=obj))
+
+        self._resync_thread = threading.Thread(
+            target=loop, name=f"resync-{self.kind}", daemon=True
+        )
+        self._resync_thread.start()
+
+    def detach(self) -> None:
+        self._store.remove_event_handler(self.kind, self._on_store_event)
+
+
+class InformerBundle:
+    """Routes each kind to the factory that owns it — the reference keeps
+    throttle kinds in the schedule factory and Pods/Namespaces in a second
+    core factory built specifically for its namespace indexer
+    (plugin.go:76-88). Controllers subscribe through this facade."""
+
+    def __init__(
+        self, schedule_factory: "SharedInformerFactory", core_factory: "SharedInformerFactory"
+    ) -> None:
+        self.schedule_factory = schedule_factory
+        self.core_factory = core_factory
+
+    def throttles(self) -> "SharedIndexInformer":
+        return self.schedule_factory.throttles()
+
+    def cluster_throttles(self) -> "SharedIndexInformer":
+        return self.schedule_factory.cluster_throttles()
+
+    def pods(self) -> "SharedIndexInformer":
+        return self.core_factory.pods()
+
+    def namespaces(self) -> "SharedIndexInformer":
+        return self.core_factory.namespaces()
+
+
+class SharedInformerFactory:
+    """factory.go:126-181: lazily shared informers, start-once lifecycle."""
+
+    DEFAULT_RESYNC = 300.0  # 5 minutes (plugin.go:77)
+
+    def __init__(self, store: Store, resync_period: float = DEFAULT_RESYNC) -> None:
+        self._store = store
+        self._resync = resync_period
+        self._lock = threading.Lock()
+        self._informers: Dict[str, SharedIndexInformer] = {}
+        self._stop = threading.Event()
+        self._started = False
+        self._shutdown = False
+
+    def _informer(self, kind: str) -> SharedIndexInformer:
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("SharedInformerFactory has been shut down")
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = SharedIndexInformer(self._store, kind, self._resync)
+                self._informers[kind] = inf
+                if self._started:
+                    inf.run(self._stop)
+            return inf
+
+    def throttles(self) -> SharedIndexInformer:
+        return self._informer("Throttle")
+
+    def cluster_throttles(self) -> SharedIndexInformer:
+        return self._informer("ClusterThrottle")
+
+    def pods(self) -> SharedIndexInformer:
+        return self._informer("Pod")
+
+    def namespaces(self) -> SharedIndexInformer:
+        return self._informer("Namespace")
+
+    def start(self) -> None:
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("SharedInformerFactory has been shut down")
+            self._started = True
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.run(self._stop)
+
+    def wait_for_cache_sync(self) -> bool:
+        """True once every informer's cache is warm. The store mirror is
+        synchronous, so this never blocks — kept for lifecycle parity with
+        WaitForCacheSync (plugin.go:114-130)."""
+        with self._lock:
+            informers = list(self._informers.values())
+        return all(inf.has_synced() for inf in informers)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._shutdown = True
+            informers = list(self._informers.values())
+            self._informers.clear()
+        for inf in informers:
+            inf.detach()

@@ -1,0 +1,565 @@
+"""Kubernetes apiserver wire transport: list+watch reflectors feeding the
+local :class:`~kube_throttler_tpu.engine.store.Store`, plus a remote status
+writer — the analog of the reference's client-go stack
+(plugin.go:71-130: ``clientcmd.BuildConfigFromFlags(kubeconfig)`` →
+clientset + SharedInformerFactory → WaitForCacheSync).
+
+Design: the in-process ``Store`` stays the single informer-cache the whole
+daemon reads (device mirror, informers/listers, controllers). In remote
+mode a :class:`Reflector` per kind keeps that cache synced with a real
+apiserver over the list+watch wire protocol:
+
+- LIST once, diff against the cache (synthesizing ADDED/MODIFIED/DELETED so
+  downstream handlers observe a consistent stream), remember the list
+  resourceVersion;
+- WATCH from that resourceVersion with ``allowWatchBookmarks``; BOOKMARK
+  events advance the resume point without touching the cache
+  (client-go reflector.go semantics);
+- a closed/timed-out stream re-watches from the last seen resourceVersion;
+  ``410 Gone`` (resourceVersion too old) falls back to a full relist —
+  exactly client-go's ListAndWatch loop.
+
+Status write-back goes straight to the apiserver (UpdateStatus,
+throttle_controller.go:170); the local cache is NOT updated in place — the
+write echoes back through the watch, which is the reference's
+update-then-observe loop (§3.4 of SURVEY.md). Conflicts (409) surface as
+:class:`~kube_throttler_tpu.engine.store.ConflictError` so the reconcile
+requeues rate-limited, like client-go retry-on-conflict.
+
+Only stdlib (http.client/json/ssl) — no kubernetes python client exists in
+this environment, and the wire protocol is small enough to speak directly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import ssl
+import threading
+import time
+from dataclasses import dataclass, field
+from http.client import HTTPConnection, HTTPSConnection
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from urllib.parse import urlencode, urlsplit
+
+from ..api.serialization import object_from_dict, object_to_dict
+from ..api.types import ClusterThrottle, Throttle
+from ..engine.store import ConflictError, NotFoundError, Store, key_of
+
+logger = logging.getLogger(__name__)
+
+GROUP = "schedule.k8s.everpeace.github.com"
+VERSION = "v1alpha1"
+
+# collection paths per kind (cluster-wide list+watch, like the reference's
+# cluster-scoped informer factories)
+COLLECTION_PATHS = {
+    "Pod": "/api/v1/pods",
+    "Namespace": "/api/v1/namespaces",
+    "Throttle": f"/apis/{GROUP}/{VERSION}/throttles",
+    "ClusterThrottle": f"/apis/{GROUP}/{VERSION}/clusterthrottles",
+}
+
+LIST_KINDS = {
+    "Pod": "PodList",
+    "Namespace": "NamespaceList",
+    "Throttle": "ThrottleList",
+    "ClusterThrottle": "ClusterThrottleList",
+}
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class GoneError(ApiError):
+    """410: the requested resourceVersion is no longer retained — relist."""
+
+    def __init__(self, message: str = "resourceVersion too old"):
+        super().__init__(410, message)
+
+
+@dataclass(frozen=True)
+class RestConfig:
+    """The slice of a kubeconfig the transport needs (the analog of
+    clientcmd's rest.Config)."""
+
+    server: str
+    token: str = ""
+    verify_tls: bool = True
+    ca_file: str = ""
+
+
+def parse_kubeconfig(path: str) -> RestConfig:
+    """Minimal kubeconfig loader: current-context → cluster server + user
+    token. Client certs are not supported (token / insecure only); a
+    cluster with ``insecure-skip-tls-verify`` or plain http works for the
+    integration tier."""
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+
+    def by_name(items, name):
+        for item in items or []:
+            if item.get("name") == name:
+                return item
+        raise ValueError(f"kubeconfig: no entry named {name!r}")
+
+    current = cfg.get("current-context") or ""
+    if not current:
+        contexts = cfg.get("contexts") or []
+        if not contexts:
+            raise ValueError("kubeconfig: no contexts")
+        current = contexts[0]["name"]
+    ctx = by_name(cfg.get("contexts"), current).get("context", {})
+    cluster = by_name(cfg.get("clusters"), ctx.get("cluster", "")).get("cluster", {})
+    user: Dict[str, Any] = {}
+    if ctx.get("user"):
+        user = by_name(cfg.get("users"), ctx["user"]).get("user", {}) or {}
+    return RestConfig(
+        server=str(cluster.get("server", "")).rstrip("/"),
+        token=str(user.get("token", "") or ""),
+        verify_tls=not bool(cluster.get("insecure-skip-tls-verify")),
+        ca_file=str(cluster.get("certificate-authority", "") or ""),
+    )
+
+
+class ApiClient:
+    """Blocking REST client for the four watched kinds + status subresource.
+
+    One short-lived connection per request; ``watch`` holds a streaming
+    connection and yields decoded watch events.
+    """
+
+    def __init__(self, config: RestConfig, timeout: float = 10.0):
+        self.config = config
+        self.timeout = timeout
+        split = urlsplit(config.server)
+        if split.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported server scheme: {config.server!r}")
+        self._scheme = split.scheme
+        self._host = split.hostname or "127.0.0.1"
+        self._port = split.port or (443 if self._scheme == "https" else 80)
+
+    # -- connection plumbing ----------------------------------------------
+
+    def _connect(self, timeout: float):
+        if self._scheme == "https":
+            if self.config.verify_tls:
+                ctx = ssl.create_default_context(
+                    cafile=self.config.ca_file or None
+                )
+            else:
+                ctx = ssl._create_unverified_context()
+            return HTTPSConnection(self._host, self._port, timeout=timeout, context=ctx)
+        return HTTPConnection(self._host, self._port, timeout=timeout)
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Accept": "application/json"}
+        if self.config.token:
+            headers["Authorization"] = f"Bearer {self.config.token}"
+        return headers
+
+    def _request(
+        self, method: str, path: str, body: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        conn = self._connect(self.timeout)
+        try:
+            headers = self._headers()
+            payload = None
+            if body is not None:
+                payload = json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 409:
+                raise ConflictError(path)
+            if resp.status == 404:
+                raise NotFoundError(path)
+            if resp.status == 410:
+                raise GoneError(data.decode(errors="replace")[:200])
+            if resp.status >= 400:
+                raise ApiError(resp.status, data.decode(errors="replace")[:200])
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
+    # -- verbs -------------------------------------------------------------
+
+    def list(self, kind: str) -> Tuple[List[Dict[str, Any]], str]:
+        """LIST a collection → (item dicts, list resourceVersion)."""
+        doc = self._request("GET", COLLECTION_PATHS[kind])
+        rv = str((doc.get("metadata") or {}).get("resourceVersion", "0"))
+        return list(doc.get("items") or []), rv
+
+    # a real apiserver bookmarks roughly once a minute on a quiet cluster;
+    # the server-side timeoutSeconds ends the stream gracefully well before
+    # the socket read timeout would tear the connection down, so idle
+    # watches are NOT reconnect churn (client-go uses 5-10 min here)
+    WATCH_TIMEOUT_SECONDS = 300
+
+    def watch(
+        self,
+        kind: str,
+        resource_version: str,
+        stop: Optional[threading.Event] = None,
+        read_timeout: Optional[float] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """WATCH a collection from ``resource_version``; yields raw watch
+        event dicts ``{"type": ..., "object": {...}}`` (BOOKMARK included —
+        the reflector advances its resume point on them). The stream ends
+        on server close / timeoutSeconds expiry / read timeout (caller
+        re-watches from the last RV) and raises :class:`GoneError` on an
+        ERROR event carrying 410."""
+        if read_timeout is None:
+            read_timeout = self.WATCH_TIMEOUT_SECONDS + 30.0
+        query = urlencode(
+            {
+                "watch": "true",
+                "resourceVersion": resource_version,
+                "allowWatchBookmarks": "true",
+                "timeoutSeconds": str(self.WATCH_TIMEOUT_SECONDS),
+            }
+        )
+        conn = self._connect(read_timeout)
+        try:
+            conn.request(
+                "GET", f"{COLLECTION_PATHS[kind]}?{query}", headers=self._headers()
+            )
+            resp = conn.getresponse()
+            if resp.status == 410:
+                resp.read()
+                raise GoneError()
+            if resp.status >= 400:
+                raise ApiError(resp.status, resp.read().decode(errors="replace")[:200])
+            while stop is None or not stop.is_set():
+                try:
+                    line = resp.readline()
+                except (socket.timeout, TimeoutError):
+                    return  # idle stream — caller resumes from last RV
+                except (OSError, ssl.SSLError):
+                    return  # connection torn down
+                if not line:
+                    return  # server closed the stream
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                if event.get("type") == "ERROR":
+                    obj = event.get("object") or {}
+                    if obj.get("code") == 410:
+                        raise GoneError(str(obj.get("message", "")))
+                    raise ApiError(
+                        int(obj.get("code", 500)), str(obj.get("message", ""))
+                    )
+                yield event
+        finally:
+            conn.close()
+
+    def get(self, path: str) -> Dict[str, Any]:
+        """GET a JSON document; 404 raises NotFoundError."""
+        return self._request("GET", path)
+
+    def post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST (create) a JSON document; 409 raises ConflictError."""
+        return self._request("POST", path, body=body)
+
+    def put(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        """PUT a JSON document (status-subresource / lease writes). The body
+        must carry ``metadata.resourceVersion`` for optimistic concurrency;
+        409 raises ConflictError."""
+        return self._request("PUT", path, body=body)
+
+
+@dataclass
+class RemoteVersions:
+    """Last-seen remote resourceVersion per (kind, key) — shared between the
+    reflectors (writers) and the status writer (reader), because the local
+    Store assigns its own local versions and the apiserver requires the
+    REMOTE one on updates."""
+
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _versions: Dict[Tuple[str, str], str] = field(default_factory=dict)
+
+    def set(self, kind: str, key: str, rv: str) -> None:
+        with self._lock:
+            self._versions[(kind, key)] = rv
+
+    def get(self, kind: str, key: str) -> str:
+        with self._lock:
+            return self._versions.get((kind, key), "")
+
+    def drop(self, kind: str, key: str) -> None:
+        with self._lock:
+            self._versions.pop((kind, key), None)
+
+
+class Reflector:
+    """client-go reflector for one kind: ListAndWatch into the Store."""
+
+    def __init__(
+        self,
+        client: ApiClient,
+        kind: str,
+        store: Store,
+        versions: Optional[RemoteVersions] = None,
+        backoff: float = 1.0,
+    ):
+        self.client = client
+        self.kind = kind
+        self.store = store
+        self.versions = versions
+        self.backoff = backoff
+        self.last_resource_version = "0"
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- store application -------------------------------------------------
+
+    def _obj_from(self, item: Dict[str, Any]):
+        obj = object_from_dict({**item, "kind": self.kind})
+        rv = str((item.get("metadata") or {}).get("resourceVersion", ""))
+        if self.versions is not None and rv:
+            self.versions.set(self.kind, key_of(self.kind, obj), rv)
+        return obj
+
+    def _upsert(self, obj) -> None:
+        store = self.store
+        try:
+            if self.kind == "Pod":
+                store.update_pod(obj)
+            elif self.kind == "Namespace":
+                store.update_namespace(obj)
+            elif self.kind == "Throttle":
+                store.update_throttle(obj)
+            else:
+                store.update_cluster_throttle(obj)
+        except NotFoundError:
+            self._create(obj)
+
+    def _create(self, obj) -> None:
+        store = self.store
+        try:
+            if self.kind == "Pod":
+                store.create_pod(obj)
+            elif self.kind == "Namespace":
+                store.create_namespace(obj)
+            elif self.kind == "Throttle":
+                store.create_throttle(obj)
+            else:
+                store.create_cluster_throttle(obj)
+        except ValueError:
+            self._upsert(obj)  # raced: exists already
+
+    def _delete(self, obj) -> None:
+        key = key_of(self.kind, obj)
+        if self.versions is not None:
+            self.versions.drop(self.kind, key)
+        try:
+            if self.kind == "Pod":
+                self.store.delete_pod(obj.namespace, obj.name)
+            elif self.kind == "Namespace":
+                self.store.delete_namespace(obj.name)
+            elif self.kind == "Throttle":
+                self.store.delete_throttle(obj.namespace, obj.name)
+            else:
+                self.store.delete_cluster_throttle(obj.name)
+        except NotFoundError:
+            pass
+
+    def _current_keys(self) -> Dict[str, Any]:
+        if self.kind == "Pod":
+            objs = self.store.list_pods()
+        elif self.kind == "Namespace":
+            objs = self.store.list_namespaces()
+        elif self.kind == "Throttle":
+            objs = self.store.list_throttles()
+        else:
+            objs = self.store.list_cluster_throttles()
+        return {key_of(self.kind, o): o for o in objs}
+
+    def _sync_list(self, items: List[Dict[str, Any]]) -> None:
+        """Reconcile the cache with a full LIST: synthesize the minimal
+        ADDED/MODIFIED/DELETED set (client-go's Replace)."""
+        desired = {}
+        for item in items:
+            obj = self._obj_from(item)
+            desired[key_of(self.kind, obj)] = obj
+        current = self._current_keys()
+        for key, obj in current.items():
+            if key not in desired:
+                self._delete(obj)
+        for key, obj in desired.items():
+            if key not in current:
+                self._create(obj)
+            elif current[key] != obj:
+                self._upsert(obj)
+
+    def _apply_event(self, event: Dict[str, Any]) -> None:
+        etype = event.get("type")
+        item = event.get("object") or {}
+        rv = str((item.get("metadata") or {}).get("resourceVersion", ""))
+        if etype == "BOOKMARK":
+            if rv:
+                self.last_resource_version = rv
+            return
+        obj = self._obj_from(item)
+        if etype == "ADDED":
+            self._create(obj)
+        elif etype == "MODIFIED":
+            self._upsert(obj)
+        elif etype == "DELETED":
+            self._delete(obj)
+        else:
+            logger.warning("reflector %s: unknown watch event %r", self.kind, etype)
+            return
+        if rv:
+            self.last_resource_version = rv
+
+    # -- loop --------------------------------------------------------------
+
+    def list_and_watch_once(self) -> None:
+        """One LIST + one WATCH stream (until it ends). Split out for
+        deterministic tests."""
+        items, rv = self.client.list(self.kind)
+        self._sync_list(items)
+        self.last_resource_version = rv
+        self._synced.set()
+        for event in self.client.watch(self.kind, rv, stop=self._stop):
+            self._apply_event(event)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                items, rv = self.client.list(self.kind)
+                self._sync_list(items)
+                self.last_resource_version = rv
+                self._synced.set()
+            except Exception:
+                if self._stop.is_set():
+                    return
+                logger.exception("reflector %s: list failed; backing off", self.kind)
+                self._stop.wait(self.backoff)
+                continue
+            # watch → re-watch from last RV; Gone → fall through to relist
+            while not self._stop.is_set():
+                try:
+                    for event in self.client.watch(
+                        self.kind, self.last_resource_version, stop=self._stop
+                    ):
+                        self._apply_event(event)
+                except GoneError:
+                    logger.info(
+                        "reflector %s: resourceVersion %s gone, relisting",
+                        self.kind,
+                        self.last_resource_version,
+                    )
+                    break
+                except Exception:
+                    if self._stop.is_set():
+                        return
+                    logger.exception(
+                        "reflector %s: watch failed; backing off", self.kind
+                    )
+                    self._stop.wait(self.backoff)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"reflector-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def wait_for_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+
+class RemoteStatusWriter:
+    """Store-compatible status-writer facade the controllers call in remote
+    mode (``update_throttle_status`` / ``update_cluster_throttle_status``):
+    PUTs the status subresource with the last-seen REMOTE resourceVersion.
+    The local cache is left alone — the watch echoes the write back, closing
+    the reference's update-then-observe loop (§3.4)."""
+
+    def __init__(self, client: ApiClient, versions: RemoteVersions):
+        self.client = client
+        self.versions = versions
+
+    def _put(self, kind: str, obj) -> None:
+        body = object_to_dict(obj)
+        rv = self.versions.get(kind, key_of(kind, obj))
+        if rv:
+            body["metadata"]["resourceVersion"] = rv
+        if isinstance(obj, Throttle):
+            path = (
+                f"/apis/{GROUP}/{VERSION}/namespaces/{obj.namespace}"
+                f"/throttles/{obj.name}/status"
+            )
+        else:
+            path = f"/apis/{GROUP}/{VERSION}/clusterthrottles/{obj.name}/status"
+        doc = self.client.put(path, body)
+        new_rv = str((doc.get("metadata") or {}).get("resourceVersion", ""))
+        if new_rv:
+            # remember the post-write RV so a second write racing the watch
+            # echo doesn't 409 against our own update
+            self.versions.set(kind, key_of(kind, obj), new_rv)
+
+    def update_throttle_status(self, thr: Throttle, expected_version=None) -> Throttle:
+        self._put("Throttle", thr)
+        return thr
+
+    def update_cluster_throttle_status(
+        self, thr: ClusterThrottle, expected_version=None
+    ) -> ClusterThrottle:
+        self._put("ClusterThrottle", thr)
+        return thr
+
+
+class RemoteSession:
+    """Everything the daemon needs to run against a real apiserver: four
+    reflectors feeding the local Store + the remote status writer. The
+    plugin-side analog of plugin.go:71-130 (build config → clients →
+    informers → WaitForCacheSync)."""
+
+    KINDS = ("Namespace", "Throttle", "ClusterThrottle", "Pod")
+
+    def __init__(self, config: RestConfig, store: Store):
+        self.config = config
+        self.store = store
+        self.client = ApiClient(config)
+        self.versions = RemoteVersions()
+        self.reflectors = {
+            kind: Reflector(self.client, kind, store, versions=self.versions)
+            for kind in self.KINDS
+        }
+        self.status_writer = RemoteStatusWriter(self.client, self.versions)
+
+    @classmethod
+    def from_kubeconfig(cls, path: str, store: Store) -> "RemoteSession":
+        return cls(parse_kubeconfig(path), store)
+
+    def start(self, sync_timeout: float = 30.0) -> None:
+        """Start reflectors; namespaces first so namespaced objects land in
+        existing namespaces. Blocks until every cache lists once
+        (WaitForCacheSync, plugin.go:114-130)."""
+        for kind in self.KINDS:
+            self.reflectors[kind].start()
+            if not self.reflectors[kind].wait_for_sync(sync_timeout):
+                raise TimeoutError(f"reflector {kind} did not sync")
+
+    def stop(self) -> None:
+        for refl in self.reflectors.values():
+            refl.stop()

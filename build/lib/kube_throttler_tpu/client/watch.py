@@ -1,0 +1,88 @@
+"""Watch streams over the store (the clientset's Watch verb).
+
+The reference's generated clients expose ``Watch(ctx, opts)`` returning a
+``watch.Interface`` whose ``ResultChan()`` yields typed events
+(clientset/versioned/typed/schedule/v1alpha1/throttle.go:110-125). Here a
+``Watch`` is an iterator over :class:`~..engine.store.Event` objects fed by
+the store's synchronous dispatch, decoupled through a queue so consumers run
+on their own thread at their own pace.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+from ..engine.store import Event, EventType, Store
+
+
+class Watch:
+    """A stoppable stream of events for one kind.
+
+    With ``replay`` the stream begins with synthetic ADDED events for every
+    object currently in the store (list-then-watch semantics).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(
+        self,
+        store: Store,
+        kind: str,
+        filter: Optional[Callable[[Event], bool]] = None,
+        replay: bool = False,
+    ) -> None:
+        self._store = store
+        self._kind = kind
+        self._filter = filter
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stopped = threading.Event()
+        self._terminal = False  # consumer-side: sentinel observed
+
+        def handler(event: Event) -> None:
+            if self._stopped.is_set():
+                return
+            if self._filter is None or self._filter(event):
+                self._queue.put(event)
+
+        self._handler = handler
+        store.add_event_handler(kind, handler, replay=replay)
+
+    def stop(self) -> None:
+        """Terminate the stream; pending and future ``next()`` calls raise
+        StopIteration once drained."""
+        if not self._stopped.is_set():
+            self._stopped.set()
+            self._store.remove_event_handler(self._kind, self._handler)
+            self._queue.put(self._SENTINEL)
+
+    def next(self, timeout: Optional[float] = None) -> Event:
+        """Block for the next event. Raises ``queue.Empty`` on timeout,
+        ``StopIteration`` after :meth:`stop`."""
+        # once the sentinel has been observed the stream is terminal — a
+        # straggler event that raced in behind the sentinel must never be
+        # returned, so the flag (not the queue contents) is authoritative
+        if self._terminal:
+            raise StopIteration
+        item = self._queue.get(timeout=timeout)
+        if item is self._SENTINEL:
+            self._terminal = True
+            raise StopIteration
+        return item
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            try:
+                yield self.next()
+            except StopIteration:
+                return
+
+    def __enter__(self) -> "Watch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+__all__ = ["Watch", "Event", "EventType"]

@@ -1,0 +1,173 @@
+"""Shared controller worker-pool (reference controller.go:34-122).
+
+N worker threads drain a rate-limiting workqueue; reconcile errors re-queue
+with exponential backoff (controller.go:106-108); success forgets the key.
+``enqueue_after`` drives override-boundary self-wakeups.
+
+A periodic **resync** (``resync_interval`` + ``list_keys_func``) re-enqueues
+every live key on a fixed cadence — the eventual-consistency backstop the
+reference gets from its 5-minute informer resync (plugin.go:77,86): any
+status left stale by a missed/unwirable event converges within one interval.
+It rides the same delayed-queue machinery as ``enqueue_after`` via a
+reserved sentinel key, so FakeClock tests drive it deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from datetime import timedelta
+from typing import Callable, List, Optional
+
+from ..engine.workqueue import RateLimitingQueue, ShutDown
+from ..utils.tracing import NoopTracer, vlog
+from ..utils.clock import Clock, RealClock
+
+logger = logging.getLogger(__name__)
+
+# Reserved workqueue key that triggers a full re-enqueue of live keys.
+# "\x00" cannot appear in a Kubernetes object name, so it can never collide
+# with a real reconcile key.
+RESYNC_KEY = "\x00resync"
+
+
+class ControllerBase:
+    def __init__(
+        self,
+        name: str,
+        target_kind: str,
+        throttler_name: str,
+        target_scheduler_name: str,
+        clock: Optional[Clock] = None,
+        threadiness: int = 1,
+        resync_interval: Optional[timedelta] = None,
+    ):
+        self.name = name
+        self.target_kind = target_kind
+        self.throttler_name = throttler_name
+        self.target_scheduler_name = target_scheduler_name
+        self.clock = clock or RealClock()
+        self.threadiness = threadiness
+        self.workqueue = RateLimitingQueue(name, clock=self.clock)
+        self.reconcile_func: Callable[[str], None] = lambda key: None
+        # optional batched reconcile: a worker drains up to batch_max ready
+        # keys and hands them over in one call, so a shared step (the device
+        # used-aggregate flush+gather) is paid once per drain, not per key.
+        # Returns {key: exception} for the keys to requeue.
+        self.reconcile_batch_func: Optional[Callable[[List[str]], dict]] = None
+        self.batch_max = 256
+        # phase tracer (utils.tracing.PhaseTracer); set by the plugin so
+        # reconcile latency lands in the same histogram family as the hot path
+        self.tracer = NoopTracer()
+        # periodic resync: every resync_interval, every key returned by
+        # list_keys_func is re-enqueued (dedup'd by the workqueue)
+        self.resync_interval = resync_interval
+        self.list_keys_func: Optional[Callable[[], List[str]]] = None
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        if self.resync_interval is not None:
+            self.workqueue.add_after(RESYNC_KEY, self.resync_interval)
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for i in range(self.threadiness):
+            t = threading.Thread(
+                target=self._run_worker, name=f"{self.name}-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        logger.info("Started %s workers name=%s threadiness=%d", self.name, self.throttler_name, self.threadiness)
+
+    def stop(self) -> None:
+        self.workqueue.shut_down()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        self._started = False
+
+    def enqueue(self, key: str) -> None:
+        self.workqueue.add(key)
+
+    def enqueue_after(self, key: str, duration: timedelta) -> None:
+        self.workqueue.add_after(key, duration)
+
+    def _resync(self) -> None:
+        """Re-enqueue every live key, then re-arm the next tick. Errors in
+        ``list_keys_func`` skip one tick but never kill the cadence."""
+        try:
+            if self.list_keys_func is not None:
+                keys = self.list_keys_func()
+                vlog(4, "%s: periodic resync, re-enqueuing %d keys", self.name, len(keys))
+                for key in keys:
+                    self.workqueue.add(key)
+        except Exception:
+            logger.exception("%s: resync key listing failed", self.name)
+        finally:
+            self.workqueue.forget(RESYNC_KEY)
+            self.workqueue.done(RESYNC_KEY)
+            if self.resync_interval is not None:
+                self.workqueue.add_after(RESYNC_KEY, self.resync_interval)
+
+    def _process_batch(self, keys: List[str]) -> None:
+        """Run the (batched) reconcile for drained keys; requeue failures
+        rate-limited (controller.go:106-108), forget successes."""
+        if RESYNC_KEY in keys:
+            keys = [k for k in keys if k != RESYNC_KEY]
+            self._resync()
+            if not keys:
+                return
+        failures: dict = {}
+        try:
+            vlog(4, "%s: reconciling batch %r", self.name, keys)
+            with self.tracer.trace("reconcile"):
+                if self.reconcile_batch_func is not None:
+                    failures = self.reconcile_batch_func(keys) or {}
+                else:
+                    for key in keys:
+                        try:
+                            self.reconcile_func(key)
+                        except Exception as e:
+                            failures[key] = e
+        except Exception as e:  # batch-level crash fails every key
+            failures = {key: e for key in keys}
+        for key in keys:
+            if key in failures:
+                self.workqueue.add_rate_limited(key)
+                logger.error(
+                    "error reconciling %r, requeuing", key, exc_info=failures[key]
+                )
+            else:
+                self.workqueue.forget(key)
+            self.workqueue.done(key)
+
+    def _drain_more(self, first: str) -> List[str]:
+        keys = [first]
+        if self.reconcile_batch_func is not None:
+            while len(keys) < self.batch_max:
+                nxt = self.workqueue.try_get()
+                if nxt is None:
+                    break
+                keys.append(nxt)
+        return keys
+
+    def _run_worker(self) -> None:
+        while True:
+            try:
+                key = self.workqueue.get()
+            except ShutDown:
+                return
+            self._process_batch(self._drain_more(key))
+
+    def run_pending_once(self, max_items: int = 10000) -> int:
+        """Synchronously drain currently-ready queue items on the calling
+        thread (deterministic tests / single-threaded embedding). Returns the
+        number of reconciles executed."""
+        n = 0
+        while len(self.workqueue) > 0 and n < max_items:
+            key = self.workqueue.get(timeout=0.01)
+            keys = self._drain_more(key)
+            self._process_batch(keys)
+            n += len(keys)
+        return n

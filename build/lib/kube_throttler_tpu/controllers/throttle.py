@@ -1,0 +1,393 @@
+"""ThrottleController — namespaced reconciler (reference
+throttle_controller.go).
+
+Responsibilities, mirroring the Go controller one-for-one:
+
+- ``reconcile(key)``          (throttle_controller.go:84-211)
+- ``affected_pods``           (221-246; the terminated-slice append bug at
+                               241 is deliberately FIXED here)
+- ``affected_throttles``      (248-269)
+- ``reserve`` / ``unreserve`` (271-347)
+- ``check_throttled``         (349-397)
+- event handlers incl. the symmetric-difference reservation move on pod
+  label changes (400-536)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..api.pod import Pod
+from ..api.types import (
+    CalculatedThreshold,
+    ResourceAmount,
+    Throttle,
+    ThrottleStatus,
+    resource_amount_of_pod,
+)
+from ..engine.devicestate import DeviceStateManager
+from ..engine.reservations import ReservedResourceAmounts
+from ..engine.store import Event, EventType, NotFoundError, Store
+from ..utils.clock import Clock
+from .base import ControllerBase
+
+logger = logging.getLogger(__name__)
+
+
+class ThrottleController(ControllerBase):
+    KIND = "throttle"
+
+    def __init__(
+        self,
+        throttler_name: str,
+        target_scheduler_name: str,
+        store: Store,
+        clock: Optional[Clock] = None,
+        threadiness: int = 1,
+        num_key_mutex: int = 128,
+        device_manager: Optional[DeviceStateManager] = None,
+        metrics_recorder=None,
+        resync_interval=None,
+        listers=None,
+        informers=None,
+        status_writer=None,
+    ):
+        """``listers`` (client.listers.Listers) routes every read through the
+        indexer-backed lister layer and ``informers`` (SharedInformerFactory)
+        sources events from shared informers instead of raw store handlers —
+        the reference's composition (plugin.go:76-88). Without them the
+        controller falls back to direct store access (standalone/unit use).
+        ``status_writer`` is where status updates go: the store (default) or
+        a RemoteStatusWriter PUTting the real apiserver's status
+        subresource (throttle_controller.go:170)."""
+        super().__init__(
+            name="ThrottleController",
+            target_kind="Throttle",
+            throttler_name=throttler_name,
+            target_scheduler_name=target_scheduler_name,
+            clock=clock,
+            threadiness=threadiness,
+            resync_interval=resync_interval,
+        )
+        self.store = store
+        self.listers = listers
+        self.informers = informers
+        self.status_writer = status_writer if status_writer is not None else store
+        self.cache = ReservedResourceAmounts(num_key_mutex)
+        self.device_manager = device_manager
+        self.metrics_recorder = metrics_recorder
+        self.reconcile_func = self.reconcile
+        self.reconcile_batch_func = self.reconcile_batch
+        self.list_keys_func = self._list_responsible_keys
+        self._setup_event_handlers()
+
+    # ------------------------------------------------------------- data reads
+    # (lister-backed when wired, plugin.go:76-88; store fallback otherwise)
+
+    def _get_throttle(self, namespace: str, name: str) -> Throttle:
+        if self.listers is not None:
+            try:
+                return self.listers.throttles.throttles(namespace).get(name)
+            except KeyError:
+                raise NotFoundError(f"Throttle {namespace}/{name} not found")
+        return self.store.get_throttle(namespace, name)
+
+    def _list_throttles(self, namespace: Optional[str] = None) -> List[Throttle]:
+        if self.listers is not None:
+            if namespace is None:
+                return self.listers.throttles.list()
+            return self.listers.throttles.throttles(namespace).list()
+        return self.store.list_throttles(namespace)
+
+    def _list_pods(self, namespace: str) -> List[Pod]:
+        if self.listers is not None:
+            # the namespace-indexed pod lister — the very indexer the
+            # reference builds its second informer factory for
+            # (plugin.go:81-84)
+            return self.listers.pods.pods(namespace).list()
+        return self.store.list_pods(namespace)
+
+    def _list_responsible_keys(self) -> List[str]:
+        return [t.key for t in self._list_throttles() if self.is_responsible_for(t)]
+
+    # ------------------------------------------------------------ predicates
+
+    def is_responsible_for(self, thr: Throttle) -> bool:
+        return self.throttler_name == thr.spec.throttler_name
+
+    def should_count_in(self, pod: Pod) -> bool:
+        return (
+            pod.spec.scheduler_name == self.target_scheduler_name and pod.is_scheduled()
+        )
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, key: str) -> None:
+        errors = self.reconcile_batch([key])
+        if errors:
+            raise errors[key]
+
+    def reconcile_batch(self, keys: List[str]) -> Dict[str, Exception]:
+        """Reconcile a drained batch of keys: with a device manager, ONE
+        flush+gather of the device used-aggregates serves every key (the
+        streaming data plane — no per-throttle pod scan); per-key status
+        writes are individually fenced. Returns failures for requeue."""
+        now = self.clock.now()
+        thrs: Dict[str, Throttle] = {}
+        for key in dict.fromkeys(keys):
+            namespace, _, name = key.partition("/")
+            try:
+                thrs[key] = self._get_throttle(namespace, name)
+            except NotFoundError:
+                pass  # deleted — nothing to do (throttle_controller.go:96-99)
+        if not thrs:
+            return {}
+        errors: Dict[str, Exception] = {}
+        used_map = None
+        if self.device_manager is not None:
+            try:
+                reserved = {key: self.cache.reserved_pod_keys(key) for key in thrs}
+                used_map = self.device_manager.aggregate_used_for(
+                    self.KIND, list(thrs), reserved
+                )
+            except Exception as e:  # device failure fails the whole batch
+                return {key: e for key in keys}
+        for key, thr in thrs.items():
+            try:
+                if used_map is not None:
+                    used, unreserve_pods = used_map[key]
+                    self._finish_reconcile(key, thr, used, now, None, None, unreserve_pods)
+                else:
+                    non_terminated, terminated = self.affected_pods(thr)
+                    used = ResourceAmount()
+                    for p in non_terminated:
+                        used = used.add(resource_amount_of_pod(p))
+                    self._finish_reconcile(
+                        key, thr, used, now, non_terminated, terminated, None
+                    )
+            except Exception as e:
+                errors[key] = e
+        return errors
+
+    def _finish_reconcile(
+        self,
+        key: str,
+        thr: Throttle,
+        used: ResourceAmount,
+        now,
+        non_terminated: Optional[List[Pod]],
+        terminated: Optional[List[Pod]],
+        unreserve_pods: Optional[List[Pod]] = None,
+    ) -> None:
+        calculated = thr.spec.calculate_threshold(now)
+        new_calculated = thr.status.calculated_threshold
+        if (
+            thr.status.calculated_threshold.threshold != calculated.threshold
+            or thr.status.calculated_threshold.messages != calculated.messages
+        ):
+            # only adopt the fresh calculatedAt when the content changed —
+            # otherwise every reconcile would differ by timestamp alone
+            # (throttle_controller.go:123-132)
+            new_calculated = calculated
+
+        throttled = new_calculated.threshold.is_throttled(used, True)
+        new_status = ThrottleStatus(
+            calculated_threshold=new_calculated, throttled=throttled, used=used
+        )
+
+        def unreserve_affected() -> None:
+            # after the status write, observed pods are safe to un-reserve;
+            # terminated pods too (throttle_controller.go:135-155). The
+            # device path's set (reserved ∩ shouldCountIn ∩ matched) was
+            # computed under the SAME snapshot as the aggregate — unreserve
+            # is a no-op for non-reserved pods, so the sets are equivalent.
+            if non_terminated is not None:
+                for p in non_terminated + terminated:
+                    self.unreserve_on_throttle(p, thr)
+            else:
+                for p in unreserve_pods:
+                    self.unreserve_on_throttle(p, thr)
+
+        if new_status != thr.status:
+            self.status_writer.update_throttle_status(thr.with_status(new_status))
+            if self.metrics_recorder is not None:
+                self.metrics_recorder.record(thr.with_status(new_status))
+            unreserve_affected()
+        else:
+            if self.metrics_recorder is not None:
+                self.metrics_recorder.record(thr)
+            unreserve_affected()
+
+        next_in = thr.spec.next_override_happens_in(now)
+        if next_in is not None:
+            self.enqueue_after(key, next_in)
+
+    # ----------------------------------------------------------- collections
+
+    def affected_pods(self, thr: Throttle) -> Tuple[List[Pod], List[Pod]]:
+        non_terminated: List[Pod] = []
+        terminated: List[Pod] = []
+        if self.device_manager is not None:
+            # selector part answered by the incremental mask column — only
+            # matched pods are touched, never the whole namespace
+            pods = self.device_manager.matched_pods(self.KIND, thr.key)
+            pods = [p for p in pods if p.namespace == thr.namespace]
+        else:
+            pods = [
+                p
+                for p in self._list_pods(thr.namespace)
+                if thr.spec.selector.matches_to_pod(p)
+            ]
+        for pod in pods:
+            if not self.should_count_in(pod):
+                continue
+            if pod.is_not_finished():
+                non_terminated.append(pod)
+            else:
+                terminated.append(pod)
+        return non_terminated, terminated
+
+    def affected_throttle_keys(self, pod: Pod) -> List[str]:
+        if self.device_manager is not None:
+            return self.device_manager.affected_throttle_keys(self.KIND, pod)
+        return [t.key for t in self.affected_throttles(pod)]
+
+    def affected_throttles(self, pod: Pod) -> List[Throttle]:
+        if self.device_manager is not None:
+            affected = []
+            for key in self.device_manager.affected_throttle_keys(self.KIND, pod):
+                namespace, _, name = key.partition("/")
+                try:
+                    thr = self._get_throttle(namespace, name)
+                except NotFoundError:
+                    continue
+                if self.is_responsible_for(thr):
+                    affected.append(thr)
+            return affected
+        affected = []
+        for thr in self._list_throttles(pod.namespace):
+            if not self.is_responsible_for(thr):
+                continue
+            if thr.spec.selector.matches_to_pod(pod):
+                affected.append(thr)
+        return affected
+
+    # ----------------------------------------------------------- reservation
+
+    def reserve(self, pod: Pod) -> None:
+        for thr in self.affected_throttles(pod):
+            self.reserve_on_throttle(pod, thr)
+
+    def reserve_on_throttle(self, pod: Pod, thr: Throttle) -> bool:
+        added = self.cache.add_pod(thr.key, pod)
+        if added and self.device_manager is not None:
+            self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
+        return added
+
+    def unreserve(self, pod: Pod) -> None:
+        for thr in self.affected_throttles(pod):
+            self.unreserve_on_throttle(pod, thr)
+
+    def unreserve_on_throttle(self, pod: Pod, thr: Throttle) -> bool:
+        removed = self.cache.remove_pod(thr.key, pod)
+        if removed and self.device_manager is not None:
+            self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
+        return removed
+
+    # ----------------------------------------------------------------- check
+
+    def check_throttled(
+        self, pod: Pod, is_throttled_on_equal: bool
+    ) -> Tuple[List[Throttle], List[Throttle], List[Throttle], List[Throttle]]:
+        """→ (active, insufficient, pod-requests-exceeds, affected)
+        (throttle_controller.go:349-397).
+
+        With a device manager the classification runs as one kernel call
+        over the mirrored tensors; otherwise the host oracle loops."""
+        if self.device_manager is not None:
+            results = self.device_manager.check_pod(pod, self.KIND, is_throttled_on_equal)
+            active, insufficient, exceeds, affected = [], [], [], []
+            for key, status in results.items():
+                namespace, _, name = key.partition("/")
+                thr = self._get_throttle(namespace, name)
+                affected.append(thr)
+                if status == "active":
+                    active.append(thr)
+                elif status == "insufficient":
+                    insufficient.append(thr)
+                elif status == "pod-requests-exceeds-threshold":
+                    exceeds.append(thr)
+            return active, insufficient, exceeds, affected
+        throttles = self.affected_throttles(pod)
+        active: List[Throttle] = []
+        insufficient: List[Throttle] = []
+        exceeds: List[Throttle] = []
+        for thr in throttles:
+            reserved, _ = self.cache.reserved_resource_amount(thr.key)
+            status = thr.check_throttled_for(pod, reserved, is_throttled_on_equal)
+            if status == "active":
+                active.append(thr)
+            elif status == "insufficient":
+                insufficient.append(thr)
+            elif status == "pod-requests-exceeds-threshold":
+                exceeds.append(thr)
+        return active, insufficient, exceeds, throttles
+
+    # ---------------------------------------------------------- event wiring
+
+    def _setup_event_handlers(self) -> None:
+        if self.informers is not None:
+            # shared-informer subscription (mustSetupEventHandler,
+            # throttle_controller.go:400): the informer mirrors the store
+            # into its indexer BEFORE fanning out, so lister reads from a
+            # handler always observe a cache >= the event
+            self.informers.throttles().add_event_handler(self._on_throttle_event)
+            self.informers.pods().add_event_handler(self._on_pod_event)
+        else:
+            self.store.add_event_handler("Throttle", self._on_throttle_event)
+            self.store.add_event_handler("Pod", self._on_pod_event)
+
+    def _on_throttle_event(self, event: Event) -> None:
+        thr = event.obj
+        if not self.is_responsible_for(thr):
+            return
+        self.enqueue(thr.key)
+
+    def _on_pod_event(self, event: Event) -> None:
+        if event.type == EventType.ADDED:
+            pod = event.obj
+            if not self.should_count_in(pod):
+                return
+            for key in self.affected_throttle_keys(pod):
+                self.enqueue(key)
+        elif event.type == EventType.MODIFIED:
+            old_pod, new_pod = event.old_obj, event.obj
+            if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
+                return
+            old_keys = set(self.affected_throttle_keys(old_pod))
+            new_keys = set(self.affected_throttle_keys(new_pod))
+            moved_from = old_keys - new_keys
+            moved_to = new_keys - old_keys
+            if moved_from or moved_to:
+                # atomic reservation move on label change
+                # (throttle_controller.go:469-500)
+                self.cache.move_throttle_assignment(new_pod, moved_from, moved_to)
+                if self.device_manager is not None:
+                    for key in moved_from | moved_to:
+                        self.device_manager.on_reservation_change(self.KIND, key, self.cache)
+            for key in old_keys | new_keys:
+                self.enqueue(key)
+        else:  # DELETED
+            pod = event.obj
+            if not self.should_count_in(pod):
+                return
+            if pod.is_scheduled():
+                # the deleted pod may still hold reservations
+                # (throttle_controller.go:508-519)
+                try:
+                    self.unreserve(pod)
+                except Exception:
+                    logger.exception("failed to unreserve deleted pod %s", pod.key)
+            for key in self.affected_throttle_keys(pod):
+                self.enqueue(key)

@@ -1,0 +1,897 @@
+"""Host→device state mirror: keeps the check kernel's inputs current.
+
+The reference's PreFilter reads informer caches synchronously per pod
+attempt (plugin.go:148-215). Here the equivalent read path is a device
+kernel over mirrored tensors, so this manager maintains, per kind:
+
+- a ``SelectorIndex`` (the [P,T] mask),
+- pod staging rows (effective requests, int64 milli),
+- throttle staging rows (effective threshold, status.used, status.throttled
+  flags — i.e. exactly the fields ``check_throttled_for`` reads from the
+  CRD object) plus the reservation mirror,
+
+all as numpy staging arrays with dirty tracking; ``_sync`` uploads to device
+only what changed. Stable padded capacities mean the jitted kernels never
+recompile on object churn (they recompile only on capacity growth, which is
+geometric and rare).
+
+Writes arrive synchronously from store watch events (cheap row updates —
+same contract as informer handlers); reads (``check_pod``,
+``check_batch``) are served from device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.tracing import NoopTracer
+from ..api.pod import Pod
+from ..api.types import ClusterThrottle, ResourceAmount, Throttle
+from ..quantity import to_milli
+from ..resourcelist import pod_request_resource_list
+from .index import SelectorIndex
+from .reservations import ReservedResourceAmounts
+from .store import Event, EventType, Store
+from ..ops.check import CHECK_NOT_AFFECTED, STATUS_NAMES, check_pods, check_pods_compact
+from ..ops.schema import DimRegistry, PodBatch, ThrottleState
+
+AnyThrottle = Union[Throttle, ClusterThrottle]
+
+
+class _KindState:
+    """Staging arrays + index for one kind."""
+
+    def __init__(self, kind: str, dims: DimRegistry):
+        self.kind = kind
+        self.dims = dims
+        self.index = SelectorIndex(kind)
+        self.R = dims.capacity
+        pcap, tcap = self.index.capacities
+        self._alloc_pods(pcap)
+        self._alloc_throttles(tcap)
+        self.dirty_pods = True
+        self.dirty_throttles = True
+        self._device_state: Optional[ThrottleState] = None
+        self._device_packed = None  # CheckPrecompPacked cache for check_pod
+        self._device_pods: Optional[PodBatch] = None
+        self._device_mask = None
+        # rows/cols touched by single-object events since the last device
+        # sync — applied as device-side scatters instead of a full re-upload
+        self._dirty_pod_rows: set = set()
+        self._dirty_thr_cols: set = set()
+        # beyond this many pending rows a full upload is cheaper
+        self.row_scatter_max = 256
+
+        # --- live used-aggregation state (reconcile data plane) ----------
+        # Device-resident running aggregates of status.used per throttle
+        # column, fed by pod-event deltas (apply_pod_deltas_batched) with
+        # per-column rebases on selector/threshold edits and a full
+        # aggregate_used rebase on namespace/capacity changes. Replaces the
+        # reference's per-reconcile O(P_ns) pod scan
+        # (throttle_controller.go:103-119).
+        self.agg_cnt = None  # int64[T] on device
+        self.agg_req = None  # int64[T,R] on device
+        self.agg_contrib = None  # int32[T,R] on device
+        self._agg_full_rebase = True
+        self._agg_rebase_cols: set = set()
+        # pending (cols int32[k], sign ±1, req int64[R'], present bool[R'])
+        self._agg_pending: list = []
+        self._agg_pending_max = 8192
+        self._delta_old = None  # snapshot between capture begin/end
+        self._counted_device = None
+        self._counted_dirty = True
+
+    def _alloc_pods(self, pcap: int) -> None:
+        self.pod_req = np.zeros((pcap, self.R), dtype=np.int64)
+        self.pod_present = np.zeros((pcap, self.R), dtype=bool)
+        self.pod_valid = np.zeros(pcap, dtype=bool)
+        # shouldCountIn ∧ is_not_finished per row — membership of status.used
+        self.counted = np.zeros(pcap, dtype=bool)
+        # shouldCountIn alone (phase-independent) — membership of the
+        # reconcile unreserve walk, which includes terminated pods
+        # (throttle_controller.go:135-155)
+        self.count_in = np.zeros(pcap, dtype=bool)
+        self.pcap = pcap
+
+    def _alloc_throttles(self, tcap: int) -> None:
+        z64 = lambda *s: np.zeros(s, dtype=np.int64)
+        zb = lambda *s: np.zeros(s, dtype=bool)
+        R = self.R
+        self.thr_cnt, self.thr_cnt_present = z64(tcap), zb(tcap)
+        self.thr_req, self.thr_req_present = z64(tcap, R), zb(tcap, R)
+        self.used_cnt, self.used_cnt_present = z64(tcap), zb(tcap)
+        self.used_req, self.used_req_present = z64(tcap, R), zb(tcap, R)
+        self.res_cnt, self.res_cnt_present = z64(tcap), zb(tcap)
+        self.res_req, self.res_req_present = z64(tcap, R), zb(tcap, R)
+        self.st_cnt_throttled = zb(tcap)
+        self.st_req_throttled = zb(tcap, R)
+        self.st_req_flag_present = zb(tcap, R)
+        self.thr_valid = zb(tcap)
+        self.tcap = tcap
+
+    # -- growth -----------------------------------------------------------
+
+    def _pad_cols(self, arr: np.ndarray, new_r: int) -> np.ndarray:
+        out = np.zeros(arr.shape[:-1] + (new_r,), dtype=arr.dtype)
+        out[..., : arr.shape[-1]] = arr
+        return out
+
+    def ensure_capacity(self) -> None:
+        """Grow staging to match index capacities / dim registry."""
+        if self.dims.capacity != self.R:
+            new_r = self.dims.capacity
+            for name in (
+                "pod_req", "pod_present", "thr_req", "thr_req_present",
+                "used_req", "used_req_present", "res_req", "res_req_present",
+                "st_req_throttled", "st_req_flag_present",
+            ):
+                setattr(self, name, self._pad_cols(getattr(self, name), new_r))
+            self.R = new_r
+            self.dirty_pods = self.dirty_throttles = True
+        pcap, tcap = self.index.capacities
+        if pcap != self.pcap:
+            for name in ("pod_req", "pod_present"):
+                arr = getattr(self, name)
+                grown = np.zeros((pcap,) + arr.shape[1:], dtype=arr.dtype)
+                grown[: arr.shape[0]] = arr
+                setattr(self, name, grown)
+            for name in ("pod_valid", "counted", "count_in"):
+                arr = getattr(self, name)
+                grown = np.zeros(pcap, dtype=bool)
+                grown[: arr.shape[0]] = arr
+                setattr(self, name, grown)
+            self.pcap = pcap
+            self.dirty_pods = True
+            self._counted_dirty = True
+        if tcap != self.tcap:
+            old = self.tcap
+            for name in (
+                "thr_cnt", "thr_cnt_present", "used_cnt", "used_cnt_present",
+                "res_cnt", "res_cnt_present", "st_cnt_throttled", "thr_valid",
+            ):
+                arr = getattr(self, name)
+                grown = np.zeros(tcap, dtype=arr.dtype)
+                grown[:old] = arr
+                setattr(self, name, grown)
+            for name in (
+                "thr_req", "thr_req_present", "used_req", "used_req_present",
+                "res_req", "res_req_present", "st_req_throttled", "st_req_flag_present",
+            ):
+                arr = getattr(self, name)
+                grown = np.zeros((tcap, self.R), dtype=arr.dtype)
+                grown[:old] = arr
+                setattr(self, name, grown)
+            self.tcap = tcap
+            self.dirty_throttles = True
+
+    # -- row updates ------------------------------------------------------
+
+    def _amount_into_row(
+        self,
+        amount: Optional[ResourceAmount],
+        cnt_name: str,
+        cnt_present_name: str,
+        req_name: str,
+        req_present_name: str,
+        i: int,
+    ) -> None:
+        if amount is None:
+            amount = ResourceAmount()
+        # resolve every dim index FIRST and grow once: ensure_capacity()
+        # REPLACES the staging arrays, so references must only be taken
+        # after any growth has happened
+        entries = [
+            (self.dims.index_of(name), to_milli(q))
+            for name, q in (amount.resource_requests or {}).items()
+        ]
+        if any(j >= self.R for j, _ in entries):
+            self.ensure_capacity()
+        cnt = getattr(self, cnt_name)
+        cnt_present = getattr(self, cnt_present_name)
+        req = getattr(self, req_name)
+        req_present = getattr(self, req_present_name)
+        if amount.resource_counts is not None:
+            cnt[i] = amount.resource_counts
+            cnt_present[i] = True
+        else:
+            cnt[i] = 0
+            cnt_present[i] = False
+        req[i, :] = 0
+        req_present[i, :] = False
+        for j, milli in entries:
+            req[i, j] = milli
+            req_present[i, j] = True
+
+    def _note_thr_col(self, col: int, before: Tuple[int, int]) -> None:
+        """Record a single-throttle change for the scatter path, or escalate
+        to a full re-upload if capacity moved under us."""
+        if (self.tcap, self.R) == before and not self.dirty_throttles:
+            self._dirty_thr_cols.add(col)
+        else:
+            self.dirty_throttles = True
+
+    def _note_pod_row(self, row: int, before: Tuple[int, int]) -> None:
+        if (self.pcap, self.R) == before and not self.dirty_pods:
+            self._dirty_pod_rows.add(row)
+        else:
+            self.dirty_pods = True
+
+    def set_throttle_row(self, thr: AnyThrottle) -> int:
+        from ..api.types import effective_threshold
+
+        col = self.index.upsert_throttle(thr)
+        before = (self.tcap, self.R)
+        self.ensure_capacity()
+        eff = effective_threshold(thr.spec.threshold, thr.status)
+        self._amount_into_row(eff, "thr_cnt", "thr_cnt_present", "thr_req", "thr_req_present", col)
+        self._amount_into_row(
+            thr.status.used, "used_cnt", "used_cnt_present", "used_req", "used_req_present", col
+        )
+        st = thr.status.throttled
+        self.st_cnt_throttled[col] = st.resource_counts_pod
+        self.st_req_throttled[col, :] = False
+        self.st_req_flag_present[col, :] = False
+        for name, flag in (st.resource_requests or {}).items():
+            j = self.dims.index_of(name)
+            if j >= self.R:
+                self.ensure_capacity()
+            self.st_req_flag_present[col, j] = True
+            self.st_req_throttled[col, j] = flag
+        self.thr_valid[col] = True
+        self._note_thr_col(col, before)
+        return col
+
+    def remove_throttle_row(self, key: str) -> Optional[int]:
+        col = self.index.throttle_col(key)
+        self.index.remove_throttle(key)
+        if col is not None:
+            self.thr_valid[col] = False
+            self.res_cnt[col] = 0
+            self.res_cnt_present[col] = False
+            self.res_req[col, :] = 0
+            self.res_req_present[col, :] = False
+            self._note_thr_col(col, (self.tcap, self.R))
+        return col
+
+    def set_reserved_row(self, key: str, amount: ResourceAmount) -> None:
+        col = self.index.throttle_col(key)
+        if col is None:
+            return
+        before = (self.tcap, self.R)
+        self._amount_into_row(amount, "res_cnt", "res_cnt_present", "res_req", "res_req_present", col)
+        self._note_thr_col(col, before)
+
+    def encode_pod_requests_into(
+        self, req: np.ndarray, present: np.ndarray, i: int, pod: Pod
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Canonical pod-request row encoding (shared by the mirror rows and
+        ad-hoc single-pod batches). Returns possibly-regrown arrays."""
+        req[i, :] = 0
+        present[i, :] = False
+        for name, q in pod_request_resource_list(pod).items():
+            j = self.dims.index_of(name)
+            if j >= req.shape[1]:
+                self.ensure_capacity()
+                req = np.pad(req, ((0, 0), (0, self.R - req.shape[1])))
+                present = np.pad(present, ((0, 0), (0, self.R - present.shape[1])))
+            req[i, j] = to_milli(q)
+            present[i, j] = True
+        return req, present
+
+    def set_pod_row(self, pod: Pod, counted: bool = False, count_in: bool = False) -> None:
+        row = self.index.upsert_pod(pod)
+        before = (self.pcap, self.R)
+        self.ensure_capacity()
+        self.pod_req, self.pod_present = self.encode_pod_requests_into(
+            self.pod_req, self.pod_present, row, pod
+        )
+        self.pod_valid[row] = True
+        self.count_in[row] = count_in
+        if self.counted[row] != counted:
+            self.counted[row] = counted
+            self._counted_dirty = True
+        self._note_pod_row(row, before)
+
+    def remove_pod_row(self, key: str) -> None:
+        row = self.index.pod_row(key)
+        self.index.remove_pod(key)
+        if row is not None:
+            self.pod_valid[row] = False
+            self.count_in[row] = False
+            if self.counted[row]:
+                self.counted[row] = False
+                self._counted_dirty = True
+            self._note_pod_row(row, (self.pcap, self.R))
+
+    # -- device sync ------------------------------------------------------
+
+    # (ThrottleState field, staging attribute) in constructor order
+    _THR_FIELDS = (
+        ("valid", "thr_valid"),
+        ("thr_cnt", "thr_cnt"), ("thr_cnt_present", "thr_cnt_present"),
+        ("thr_req", "thr_req"), ("thr_req_present", "thr_req_present"),
+        ("used_cnt", "used_cnt"), ("used_cnt_present", "used_cnt_present"),
+        ("used_req", "used_req"), ("used_req_present", "used_req_present"),
+        ("res_cnt", "res_cnt"), ("res_cnt_present", "res_cnt_present"),
+        ("res_req", "res_req"), ("res_req_present", "res_req_present"),
+        ("st_cnt_throttled", "st_cnt_throttled"),
+        ("st_req_throttled", "st_req_throttled"),
+        ("st_req_flag_present", "st_req_flag_present"),
+    )
+
+    def device_state(self) -> ThrottleState:
+        self.ensure_capacity()
+        if (
+            not self.dirty_throttles
+            and self._device_state is not None
+            and self._dirty_thr_cols
+            and len(self._dirty_thr_cols) <= self.row_scatter_max
+        ):
+            # single-throttle events: scatter only the touched rows of the
+            # 16 [T]/[T,R] tensors instead of re-uploading them all
+            cols = np.fromiter(self._dirty_thr_cols, dtype=np.int64)
+            s = self._device_state
+            self._device_state = ThrottleState(
+                **{
+                    field: getattr(s, field).at[cols].set(getattr(self, attr)[cols])
+                    for field, attr in self._THR_FIELDS
+                }
+            )
+            self._dirty_thr_cols.clear()
+            self._device_packed = None  # derived cache follows the state
+            return self._device_state
+        if self.dirty_throttles or self._device_state is None or self._dirty_thr_cols:
+            self._device_state = ThrottleState(
+                **{
+                    field: jnp.asarray(getattr(self, attr))
+                    for field, attr in self._THR_FIELDS
+                }
+            )
+            self.dirty_throttles = False
+            self._dirty_thr_cols.clear()
+            self._device_packed = None  # derived cache follows the state
+        return self._device_state
+
+    def device_packed(self):
+        """Packed residual-form precomp for the indexed single-pod check,
+        rebuilt lazily on throttle-state change."""
+        from ..ops.fastcheck import pack_check_state, precompute_check_state
+
+        state = self.device_state()  # refreshes + clears dirty_throttles
+        if self._device_packed is None:
+            self._device_packed = pack_check_state(precompute_check_state(state))
+        return self._device_packed
+
+    def device_pods(self) -> Tuple[PodBatch, jnp.ndarray]:
+        self.ensure_capacity()
+        if (
+            self.dirty_pods
+            or self._device_pods is None
+            or len(self._dirty_pod_rows) > self.row_scatter_max
+        ):
+            self._device_pods = PodBatch(
+                valid=jnp.asarray(self.pod_valid),
+                req=jnp.asarray(self.pod_req),
+                req_present=jnp.asarray(self.pod_present),
+            )
+            self._device_mask = jnp.asarray(self.index.mask)
+            self.dirty_pods = False
+            self._dirty_pod_rows.clear()
+            return self._device_pods, self._device_mask
+
+        mask_rebuilt = False
+        if self._device_mask is None or self._device_mask.shape != self.index.mask.shape:
+            # throttle/namespace event invalidated the whole mask; the live
+            # numpy mask already includes any pending row changes
+            self._device_mask = jnp.asarray(self.index.mask)
+            mask_rebuilt = True
+
+        if self._dirty_pod_rows:
+            # single-pod events: ship only the touched rows (device-side
+            # scatter instead of a full [P,R]/[P,T] host→device transfer)
+            rows = np.fromiter(self._dirty_pod_rows, dtype=np.int64)
+            self._device_pods = PodBatch(
+                valid=self._device_pods.valid.at[rows].set(self.pod_valid[rows]),
+                req=self._device_pods.req.at[rows].set(self.pod_req[rows]),
+                req_present=self._device_pods.req_present.at[rows].set(
+                    self.pod_present[rows]
+                ),
+            )
+            if not mask_rebuilt:
+                self._device_mask = self._device_mask.at[rows].set(self.index.mask[rows, :])
+            self._dirty_pod_rows.clear()
+        return self._device_pods, self._device_mask
+
+    def refresh_mask(self) -> None:
+        self._device_mask = None
+
+    # -- live used-aggregation (the reconcile data plane) ------------------
+
+    def _pod_contribution(self, pod_key: str):
+        """Snapshot of a pod's current contribution to the aggregates:
+        (cols, req copy, present copy), or None if it contributes nothing."""
+        row = self.index.pod_row(pod_key)
+        if row is None or not self.pod_valid[row] or not self.counted[row]:
+            return None
+        cols = np.nonzero(self.index.mask[row, :])[0].astype(np.int32)
+        if cols.size == 0:
+            return None
+        return (cols, self.pod_req[row].copy(), self.pod_present[row].copy())
+
+    def capture_pod_delta_begin(self, pod_key: str) -> None:
+        self._delta_old = self._pod_contribution(pod_key)
+
+    def capture_pod_delta_end(self, pod_key: str) -> None:
+        old, self._delta_old = self._delta_old, None
+        new = self._pod_contribution(pod_key)
+        if old is not None and new is not None:
+            if (
+                np.array_equal(old[0], new[0])
+                and np.array_equal(old[1], new[1])
+                and np.array_equal(old[2], new[2])
+            ):
+                return  # no contribution change (e.g. status-only update)
+        if old is None and new is None:
+            return
+        if old is not None:
+            self._agg_pending.append((old[0], -1, old[1], old[2]))
+        if new is not None:
+            self._agg_pending.append((new[0], +1, new[1], new[2]))
+        if len(self._agg_pending) > self._agg_pending_max:
+            # a burst this large is cheaper as one full masked reduction
+            self._agg_full_rebase = True
+            self._agg_pending.clear()
+
+    def mark_col_rebase(self, col: Optional[int]) -> None:
+        """A throttle add/update/delete changed column membership — its
+        incremental aggregate is invalid; recompute it at next flush."""
+        if col is not None:
+            self._agg_rebase_cols.add(int(col))
+
+    def mark_full_rebase(self) -> None:
+        self._agg_full_rebase = True
+        self._agg_pending.clear()
+        self._agg_rebase_cols.clear()
+
+    @staticmethod
+    def _bucket(n: int, lo: int = 8) -> int:
+        k = lo
+        while k < n:
+            k *= 2
+        return k
+
+    def _device_counted(self):
+        if (
+            self._counted_device is None
+            or self._counted_dirty
+            or self._counted_device.shape != (self.pcap,)
+        ):
+            self._counted_device = jnp.asarray(self.counted & self.pod_valid)
+            self._counted_dirty = False
+        return self._counted_device
+
+    def steal_agg_work(self) -> dict:
+        """Under the MAIN lock: capture everything the aggregate flush needs
+        (immutable device handles + the staged delta/rebase markers) and
+        reset the staging, so the dispatch itself can run outside the main
+        lock (under the per-kind agg lock) without blocking check readers."""
+        self.ensure_capacity()
+        pods, mask = self.device_pods()
+        counted = self._device_counted()
+        work = {
+            "pods": pods,
+            "mask": mask,
+            "counted": counted,
+            "full": self._agg_full_rebase,
+            "rebase_cols": self._agg_rebase_cols,
+            "pending": self._agg_pending,
+            "tcap": self.tcap,
+            "R": self.R,
+        }
+        self._agg_full_rebase = False
+        self._agg_rebase_cols = set()
+        self._agg_pending = []
+        return work
+
+    def apply_agg_work(self, work: dict) -> None:
+        """Land stolen aggregate maintenance on device: col rebases and the
+        pod-delta burst each cost ONE dispatch (apply_pod_deltas_batched /
+        rebase_cols); a full rebase is one masked aggregate_used reduction.
+
+        Caller holds the per-kind agg lock (NOT the main lock): ``agg_*``
+        are only ever touched under it, and consecutive flushes are
+        serialized steal-to-apply so an older snapshot can never overwrite
+        a newer one."""
+        from ..ops.aggregate import aggregate_used, apply_pod_deltas_batched, rebase_cols
+
+        pods, mask, counted = work["pods"], work["mask"], work["counted"]
+        tcap, R = work["tcap"], work["R"]
+        shapes_ok = (
+            self.agg_cnt is not None
+            and self.agg_cnt.shape == (tcap,)
+            and self.agg_req.shape == (tcap, R)
+        )
+        if work["full"] or not shapes_ok:
+            self.agg_cnt, self.agg_req, self.agg_contrib = aggregate_used(
+                pods, mask, counted
+            )
+            return
+        pending = work["pending"]
+        if work["rebase_cols"]:
+            # deltas targeting a rebased column are subsumed by the rebase
+            # (it reads current state) — drop them or they double-count
+            rb = work["rebase_cols"]
+            kept = []
+            for cols, sign, req, present in pending:
+                cols_kept = cols[~np.isin(cols, list(rb))]
+                if cols_kept.size:
+                    kept.append((cols_kept, sign, req, present))
+            pending = kept
+            arr = np.fromiter(rb, dtype=np.int32, count=len(rb))
+            k = self._bucket(arr.size)
+            cols_pad = np.full(k, tcap, dtype=np.int32)
+            cols_pad[: arr.size] = arr
+            self.agg_cnt, self.agg_req, self.agg_contrib = rebase_cols(
+                self.agg_cnt, self.agg_req, self.agg_contrib,
+                pods, mask, counted, cols_pad,
+            )
+        if pending:
+            n = len(pending)
+            kmax = self._bucket(max(c.size for c, _, _, _ in pending), lo=4)
+            nb = self._bucket(n)
+            ids = np.full((nb, kmax), tcap, dtype=np.int32)
+            signs = np.zeros((nb, kmax), dtype=np.int64)
+            reqs = np.zeros((nb, R), dtype=np.int64)
+            presents = np.zeros((nb, R), dtype=bool)
+            for i, (cols, sign, req, present) in enumerate(pending):
+                ids[i, : cols.size] = cols
+                signs[i, : cols.size] = sign
+                reqs[i, : req.shape[0]] = req  # pad if R grew since capture
+                presents[i, : present.shape[0]] = present
+            self.agg_cnt, self.agg_req, self.agg_contrib = apply_pod_deltas_batched(
+                self.agg_cnt, self.agg_req, self.agg_contrib, ids, signs, reqs, presents
+            )
+
+    def flush_agg(self) -> None:
+        """Single-threaded convenience (tests): steal + apply in one go.
+        Production goes through DeviceStateManager.aggregate_used_for, which
+        splits the phases across the two locks."""
+        self.apply_agg_work(self.steal_agg_work())
+
+
+class DeviceStateManager:
+    """Wires both kinds' staging to a Store and serves batched checks."""
+
+    def __init__(
+        self,
+        store: Store,
+        throttler_name: str,
+        target_scheduler_name: str,
+        dims: Optional[DimRegistry] = None,
+    ):
+        self.store = store
+        self.throttler_name = throttler_name
+        self.target_scheduler_name = target_scheduler_name
+        self.dims = dims or DimRegistry()
+        self._lock = threading.RLock()
+        self.tracer = NoopTracer()  # set by the plugin; times device checks
+        # check_pod uses the indexed hot path up to this many affected
+        # throttles, the dense [1,T] sweep beyond (tunable for tests)
+        self.indexed_check_max = 1024
+        self.throttle = _KindState("throttle", self.dims)
+        self.clusterthrottle = _KindState("clusterthrottle", self.dims)
+        # per-kind aggregate-flush locks: agg_* arrays are touched only
+        # under these, so the reconcile's device dispatches never hold the
+        # main lock (lock order: agg → main; nothing takes main → agg)
+        self._agg_locks = {
+            "throttle": threading.Lock(),
+            "clusterthrottle": threading.Lock(),
+        }
+
+        store.add_event_handler("Namespace", self._on_namespace)
+        store.add_event_handler("Pod", self._on_pod)
+        store.add_event_handler("Throttle", self._on_throttle)
+        store.add_event_handler("ClusterThrottle", self._on_cluster_throttle)
+
+    # -- event wiring -----------------------------------------------------
+
+    def _on_namespace(self, event: Event) -> None:
+        with self._lock:
+            for ks in (self.throttle, self.clusterthrottle):
+                ks.index.upsert_namespace(event.obj)
+                ks.refresh_mask()
+            # namespace (re)definition can flip many clusterthrottle mask
+            # rows at once — the incremental aggregate cannot follow that
+            self.clusterthrottle.mark_full_rebase()
+
+    def _on_pod(self, event: Event) -> None:
+        pod = event.obj
+        count_in = (
+            pod.spec.scheduler_name == self.target_scheduler_name and pod.is_scheduled()
+        )
+        counted = count_in and pod.is_not_finished()
+        with self._lock:
+            for ks in (self.throttle, self.clusterthrottle):
+                ks.capture_pod_delta_begin(pod.key)
+                if event.type == EventType.DELETED:
+                    ks.remove_pod_row(pod.key)
+                else:
+                    ks.set_pod_row(pod, counted=counted, count_in=count_in)
+                ks.capture_pod_delta_end(pod.key)
+                # no refresh_mask: a pod event only changes its own mask row,
+                # which the incremental row scatter ships
+
+    def _on_any_throttle(self, ks: _KindState, event: Event) -> None:
+        thr = event.obj
+        responsible = thr.spec.throttler_name == self.throttler_name
+        with self._lock:
+            if event.type == EventType.DELETED or not responsible:
+                # also handles a throttlerName edit AWAY from this throttler:
+                # the mirrored row must disappear, or it would keep blocking
+                # pods this throttler no longer governs
+                col = ks.remove_throttle_row(thr.key)
+            else:
+                col = ks.set_throttle_row(thr)
+            ks.mark_col_rebase(col)
+            ks.refresh_mask()
+
+    def _on_throttle(self, event: Event) -> None:
+        self._on_any_throttle(self.throttle, event)
+
+    def _on_cluster_throttle(self, event: Event) -> None:
+        self._on_any_throttle(self.clusterthrottle, event)
+
+    def on_reservation_change(
+        self, kind: str, throttle_key: str, cache: ReservedResourceAmounts
+    ) -> None:
+        amount, _ = cache.reserved_resource_amount(throttle_key)
+        with self._lock:
+            ks = self.throttle if kind == "throttle" else self.clusterthrottle
+            ks.set_reserved_row(throttle_key, amount)
+
+    def _kind(self, kind: str) -> _KindState:
+        return self.throttle if kind == "throttle" else self.clusterthrottle
+
+    # -- index-backed collection queries (replace the O(T)/O(P) store scans
+    # of throttle_controller.go:221-269) ----------------------------------
+
+    def affected_throttle_keys(self, kind: str, pod: Pod) -> List[str]:
+        """affectedThrottles via the incremental mask: O(K) when the queried
+        object is the indexed one, a fresh compiled-row evaluation otherwise
+        (old side of a MODIFIED event, or a pod not yet stored)."""
+        with self._lock:
+            return self._kind(kind).index.affected_throttle_keys_for(pod)
+
+    def matched_pods(self, kind: str, throttle_key: str) -> List[Pod]:
+        """affectedPods' selector part via the mask column (latest objects)."""
+        with self._lock:
+            return self._kind(kind).index.matched_pods(throttle_key)
+
+    def indexed_pod(self, kind: str, pod_key: str) -> Optional[Pod]:
+        with self._lock:
+            return self._kind(kind).index.indexed_pod(pod_key)
+
+    # -- used aggregation (replaces reconcile's per-throttle pod-sum loop,
+    # throttle_controller.go:103-119) -------------------------------------
+
+    def aggregate_used_for(
+        self,
+        kind: str,
+        keys: Sequence[str],
+        reserved: Optional[Dict[str, set]] = None,
+    ) -> Dict[str, Tuple[ResourceAmount, List[Pod]]]:
+        """status.used for the given throttles from the device aggregates,
+        plus — per throttle — the reserved pods eligible for the reconcile
+        unreserve walk (shouldCountIn ∧ selector-match, including terminated
+        pods; throttle_controller.go:135-155).
+
+        One flush (at most three scatter/reduce dispatches for any event
+        burst) plus one gather serves the whole batch — this is the
+        streaming-reconcile data plane: cost is O(events) not
+        O(throttles × pods).
+
+        The unreserve set MUST come from the same snapshot as the aggregate
+        (hence one call, one lock hold): deriving it later would unreserve a
+        pod that got counted AFTER the flush, whose contribution is not in
+        the status about to be written — reopening the double-count window
+        the reserve-until-observed handshake exists to close.
+
+        Locking: the MAIN lock is held only for the host-side snapshot
+        (steal of staged aggregate work + the unreserve walk, one coherent
+        point); the flush dispatches and the blocking device→host gather run
+        under the per-kind AGG lock / no lock, so concurrent check_pod
+        readers never queue behind the reconcile's device work — the moral
+        of the reference's RWMutex split (reserved_resource_amounts.go:154)."""
+        import jax
+
+        from ..quantity import from_milli
+
+        reserved = reserved or {}
+        ks = self._kind(kind)
+        # the agg lock is held steal→apply so two concurrent reconcile
+        # batches cannot apply an older snapshot over a newer one
+        with self._agg_locks[kind]:
+            with self._lock:
+                work = ks.steal_agg_work()
+                out: Dict[str, Tuple[ResourceAmount, List[Pod]]] = {}
+                cols: List[int] = []
+                valid_keys: List[str] = []
+                for key in keys:
+                    unres: List[Pod] = []
+                    col = ks.index.throttle_col(key)
+                    if col is not None:
+                        for pod_key in reserved.get(key, ()):
+                            row = ks.index.pod_row(pod_key)
+                            if row is None:
+                                continue
+                            if ks.count_in[row] and ks.index.mask[row, col]:
+                                pod = ks.index.indexed_pod(pod_key)
+                                if pod is not None:
+                                    unres.append(pod)
+                    if col is None:
+                        # zero counted pods: both fields stay nil (the Go
+                        # accumulator never materializes on an empty sum)
+                        out[key] = (ResourceAmount(), unres)
+                    else:
+                        out[key] = (ResourceAmount(), unres)  # used filled below
+                        cols.append(col)
+                        valid_keys.append(key)
+            try:
+                ks.apply_agg_work(work)
+            except Exception:
+                with self._lock:
+                    ks.mark_full_rebase()  # stolen state was consumed; recover
+                raise
+            if not cols:
+                return out
+            # immutable post-flush handles: a later flush replaces them
+            # functionally, so the gather below still reads this snapshot
+            agg_cnt, agg_req, agg_contrib = ks.agg_cnt, ks.agg_req, ks.agg_contrib
+
+        idx = jnp.asarray(np.asarray(cols, dtype=np.int32))
+        cnt, req, ctb = jax.device_get(
+            (agg_cnt[idx], agg_req[idx], agg_contrib[idx])
+        )
+        names = self.dims.names
+        for i, key in enumerate(valid_keys):
+            if cnt[i] <= 0:
+                continue  # stays the nil ResourceAmount
+            requests = {
+                names[j]: from_milli(int(req[i, j]))
+                for j in range(min(len(names), req.shape[1]))
+                if ctb[i, j] > 0
+            }
+            out[key] = (
+                ResourceAmount(resource_counts=int(cnt[i]), resource_requests=requests),
+                out[key][1],
+            )
+        return out
+
+    # -- queries ----------------------------------------------------------
+
+    def check_pod(self, pod: Pod, kind: str, on_equal: bool = False) -> Dict[str, str]:
+        """Single-pod check → {throttle_key: status_name} over affected
+        throttles. The device kernel sees a 1-row pod batch + its mask row.
+
+        Concurrency: the lock guards only the HOST-side snapshot (request
+        encode, mask row copy, device-handle grab, key decode tables); the
+        kernel dispatch + blocking device read — the dominant cost — run
+        outside it. The device caches are replaced functionally (``.at[]``
+        scatters / wholesale re-uploads build NEW arrays), so a grabbed
+        handle is an immutable point-in-time snapshot and concurrent
+        checkers don't queue behind each other or behind writers — the
+        intent of the reference's RWMutex + keymutex split
+        (reserved_resource_amounts.go:154-170)."""
+        from ..ops.fastcheck import fast_check_pod_packed
+
+        with self.tracer.trace("device_check"):
+            dense = None
+            with self._lock:
+                ks = self.throttle if kind == "throttle" else self.clusterthrottle
+                ks.ensure_capacity()
+                row_req = np.zeros((1, ks.R), dtype=np.int64)
+                row_present = np.zeros((1, ks.R), dtype=bool)
+                row_req, row_present = ks.encode_pod_requests_into(
+                    row_req, row_present, 0, pod
+                )
+                prow = ks.index.pod_row(pod.key)
+                if prow is not None:
+                    mask_row = ks.index.mask[prow : prow + 1, :].copy()
+                else:
+                    # pod not (yet) in the store: compute its mask row on the fly
+                    mask_row = np.zeros((1, ks.tcap), dtype=bool)
+                    for key in ks.index._thr_cols:  # noqa: SLF001 — same-package access
+                        col = ks.index.throttle_col(key)
+                        thr = ks.index._col_thrs[col]
+                        mask_row[0, col] = ks.index._match_one(thr, pod)
+
+                step3 = True if kind == "throttle" else on_equal
+                cols = np.nonzero(mask_row[0])[0]
+                if cols.size <= self.indexed_check_max:
+                    packed = ks.device_packed()
+                    col_keys = [ks.index._col_thrs[int(c)].key for c in cols]
+                else:
+                    dense = (ks.device_state(), dict(ks.index._thr_cols))
+
+            # ---- outside the lock: dispatch + blocking read + decode ----
+            if dense is None:
+                # hot path: classify only the K affected rows against the
+                # cached packed precomp, and extract results from those K
+                # slots alone — O(K·R) device AND host work, independent of
+                # tcap. K buckets (powers of two) bound recompilation.
+                k = 8
+                while k < cols.size:
+                    k *= 2
+                idx = np.zeros(k, dtype=np.int32)
+                idx_valid = np.zeros(k, dtype=bool)
+                idx[: cols.size] = cols
+                idx_valid[: cols.size] = True
+                out_k = np.asarray(
+                    fast_check_pod_packed(
+                        packed, row_req[0], row_present[0],
+                        idx, idx_valid, on_equal, step3,
+                    )
+                )
+                result = {}
+                for slot, key in enumerate(col_keys):
+                    status = int(out_k[slot])
+                    if status != CHECK_NOT_AFFECTED:
+                        result[key] = STATUS_NAMES[status]
+                return result
+            state, thr_cols = dense
+            batch = PodBatch(
+                valid=np.ones(1, dtype=bool), req=row_req, req_present=row_present
+            )
+            out = np.asarray(
+                check_pods(state, batch, mask_row, on_equal=on_equal, step3_on_equal=step3)
+            )[0]
+            result = {}
+            for key, col in thr_cols.items():
+                if out[col] != CHECK_NOT_AFFECTED:
+                    result[key] = STATUS_NAMES[int(out[col])]
+            return result
+
+    def _grab_batch_handles(self, kind: str, on_equal: bool):
+        """Under the caller's lock: one kind's immutable device handles +
+        decode table for a batch check."""
+        ks = self.throttle if kind == "throttle" else self.clusterthrottle
+        state = ks.device_state()
+        pods, mask = ks.device_pods()
+        step3 = True if kind == "throttle" else on_equal
+        return state, pods, mask, step3, dict(ks.index._pod_rows)
+
+    def check_batch(self, kind: str, on_equal: bool = False):
+        """All stored pods vs all stored throttles (bench / bulk admission).
+        Returns (counts int32[P,4], schedulable bool[P], row→pod-key map).
+        Handle grab under the lock; kernel dispatch outside (see check_pod)."""
+        with self._lock:
+            state, pods, mask, step3, row_map = self._grab_batch_handles(kind, on_equal)
+        counts, schedulable = check_pods_compact(
+            state, pods, mask, on_equal=on_equal, step3_on_equal=step3
+        )
+        return counts, schedulable, row_map
+
+    def check_batch_all(self, on_equal: bool = False):
+        """Both kinds' batch checks against ONE coherent device snapshot:
+        a single lock hold grabs both kinds' handles, so the composed
+        verdict corresponds to one point in the event stream (previously
+        pre_filter_batch composed two separately-locked snapshots — a
+        concurrent store event between them could yield a verdict matching
+        no single point in time). Returns {kind: (counts, schedulable,
+        row_map)}."""
+        with self._lock:
+            handles = {
+                kind: self._grab_batch_handles(kind, on_equal)
+                for kind in ("throttle", "clusterthrottle")
+            }
+        out = {}
+        for kind, (state, pods, mask, step3, row_map) in handles.items():
+            counts, schedulable = check_pods_compact(
+                state, pods, mask, on_equal=on_equal, step3_on_equal=step3
+            )
+            out[kind] = (counts, schedulable, row_map)
+        return out

@@ -1,0 +1,87 @@
+"""The scheduler-cycle reservation ledger.
+
+Mirrors reserved_resource_amounts.go:32-156: throttle-key → (pod-key →
+ResourceAmount), guarded by a global RW lock plus hashed per-throttle-key
+locks (keymutex.NewHashed(n)); add is idempotent-overwrite, remove returns
+whether the pod was present, and assignment moves are remove+add over the
+symmetric difference (moveThrottleAssignmentForPods,
+reserved_resource_amounts.go:92-111).
+
+A reservation exists only between the scheduler's Reserve call and the first
+reconcile that observes the pod counted in status.used (or pod deletion /
+Unreserve) — the reserve-until-observed handshake (SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.tracing import vlog
+from typing import Dict, Iterable, Optional, Set, Tuple
+
+from ..api.pod import Pod
+from ..api.types import ResourceAmount, resource_amount_of_pod
+
+
+class ReservedResourceAmounts:
+    def __init__(self, num_key_mutex: int = 128):
+        self._lock = threading.RLock()
+        self._key_locks = [threading.RLock() for _ in range(max(1, num_key_mutex))]
+        # throttle key -> pod key -> amount
+        self._cache: Dict[str, Dict[str, ResourceAmount]] = {}
+
+    def _key_lock(self, key: str) -> threading.RLock:
+        return self._key_locks[hash(key) % len(self._key_locks)]
+
+    def _pod_map(self, throttle_key: str) -> Dict[str, ResourceAmount]:
+        with self._lock:
+            return self._cache.setdefault(throttle_key, {})
+
+    def add_pod(self, throttle_key: str, pod: Pod) -> bool:
+        vlog(5, "reservation add: pod=%s throttle=%s", pod.key, throttle_key)
+        """Overwrite-insert; True if the pod was newly reserved."""
+        with self._key_lock(throttle_key):
+            m = self._pod_map(throttle_key)
+            existed = pod.key in m
+            m[pod.key] = resource_amount_of_pod(pod)
+            return not existed
+
+    def remove_pod(self, throttle_key: str, pod: Pod) -> bool:
+        vlog(5, "reservation remove: pod=%s throttle=%s", pod.key, throttle_key)
+        return self.remove_pod_key(throttle_key, pod.key)
+
+    def remove_pod_key(self, throttle_key: str, pod_key: str) -> bool:
+        with self._key_lock(throttle_key):
+            m = self._pod_map(throttle_key)
+            return m.pop(pod_key, None) is not None
+
+    def move_throttle_assignment(
+        self, pod: Pod, from_keys: Iterable[str], to_keys: Iterable[str]
+    ) -> None:
+        """reserved_resource_amounts.go:92-111."""
+        for key in from_keys:
+            self.remove_pod(key, pod)
+        for key in to_keys:
+            self.add_pod(key, pod)
+
+    def reserved_resource_amount(self, throttle_key: str) -> Tuple[ResourceAmount, Set[str]]:
+        """Sum of reserved amounts + reserved pod keys for one throttle."""
+        with self._key_lock(throttle_key):
+            with self._lock:
+                m = self._cache.get(throttle_key)
+                entries = list(m.items()) if m else []
+        result = ResourceAmount()
+        pod_keys: Set[str] = set()
+        for pod_key, amount in entries:
+            pod_keys.add(pod_key)
+            result = result.add(amount)
+        return result, pod_keys
+
+    def reserved_pod_keys(self, throttle_key: str) -> Set[str]:
+        with self._lock:
+            m = self._cache.get(throttle_key)
+            return set(m.keys()) if m else set()
+
+    def throttle_keys(self) -> Set[str]:
+        with self._lock:
+            return set(self._cache.keys())

@@ -1,0 +1,313 @@
+"""Deterministic in-memory object store speaking the watch protocol.
+
+Plays the role of kube-apiserver + client-go informer caches in one object:
+
+- every mutation bumps a global monotonically-increasing resourceVersion;
+- ``update_*_status`` enforces optimistic concurrency like the status
+  subresource (conflict → ``ConflictError``, caller re-reads and retries,
+  matching UpdateStatus error handling at throttle_controller.go:170-173);
+- event handlers (add/update/delete) fire synchronously on the mutating
+  thread — informer handlers in the reference are required to be fast and
+  only enqueue workqueue keys, which is exactly how the controllers here use
+  them. Reconcile work itself is decoupled through the workqueue, so the
+  observable interleaving (watch event → enqueue → async reconcile → status
+  write → next event) matches the reference's.
+
+Store contents are immutable-by-convention: mutators replace whole objects
+(`dataclasses.replace` style); readers must not mutate returned objects.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Union
+
+from ..api.pod import Namespace, Pod
+from ..api.types import ClusterThrottle, Throttle
+
+KObject = Union[Pod, Namespace, Throttle, ClusterThrottle]
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency conflict on a status update."""
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class EventType(Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: EventType
+    kind: str  # "Pod" | "Namespace" | "Throttle" | "ClusterThrottle"
+    obj: KObject
+    old_obj: Optional[KObject] = None
+
+
+Handler = Callable[[Event], None]
+
+
+def key_of(kind: str, obj: KObject) -> str:
+    """Canonical store/informer cache key for an object of ``kind``."""
+    if kind in ("Pod", "Throttle"):
+        return f"{obj.namespace}/{obj.name}"
+    return obj.name  # Namespace, ClusterThrottle (cluster-scoped)
+
+
+_key_of = key_of
+
+
+class Store:
+    """Thread-safe store for the four kinds the throttler watches."""
+
+    KINDS = ("Pod", "Namespace", "Throttle", "ClusterThrottle")
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._objects: Dict[str, Dict[str, KObject]] = {k: {} for k in self.KINDS}
+        self._versions: Dict[str, Dict[str, int]] = {k: {} for k in self.KINDS}
+        self._handlers: Dict[str, List[Handler]] = {k: [] for k in self.KINDS}
+
+    # -- watch ------------------------------------------------------------
+
+    def add_event_handler(self, kind: str, handler: Handler, replay: bool = True) -> None:
+        """Register a handler; with ``replay`` it receives synthetic ADDED
+        events for existing objects first (informer cache-sync semantics,
+        plugin.go:114-130)."""
+        with self._lock:
+            self._handlers[kind].append(handler)
+            # replay INSIDE the lock (normal dispatch already runs under it):
+            # otherwise a concurrent DELETED could reach the handler before
+            # the stale replay ADDED, resurrecting a deleted object
+            if replay:
+                for obj in self._objects[kind].values():
+                    handler(Event(EventType.ADDED, kind, obj))
+
+    def remove_event_handler(self, kind: str, handler: Handler) -> None:
+        """Unregister a handler (watch-stream stop)."""
+        with self._lock:
+            try:
+                self._handlers[kind].remove(handler)
+            except ValueError:
+                pass
+
+    def _dispatch(self, event: Event) -> None:
+        for handler in list(self._handlers[event.kind]):
+            handler(event)
+
+    # -- generic mutations ------------------------------------------------
+
+    # NOTE: dispatch happens INSIDE the store lock. Releasing before dispatch
+    # would let two concurrent mutations of the same key deliver their
+    # MODIFIED events in reverse resourceVersion order, leaving mirrors (the
+    # device state) stale until the next unrelated event. Handlers are
+    # informer-contract cheap (row updates + enqueues) and must never hold
+    # their own lock while mutating the store from another thread (lock order
+    # is store → handler-internal, established here).
+
+    def _create(self, kind: str, obj: KObject) -> KObject:
+        with self._lock:
+            key = _key_of(kind, obj)
+            if key in self._objects[kind]:
+                raise ValueError(f"{kind} {key!r} already exists")
+            self._rv += 1
+            self._objects[kind][key] = obj
+            self._versions[kind][key] = self._rv
+            self._dispatch(Event(EventType.ADDED, kind, obj))
+        return obj
+
+    def _update(self, kind: str, obj: KObject) -> KObject:
+        with self._lock:
+            key = _key_of(kind, obj)
+            old = self._objects[kind].get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            self._rv += 1
+            self._objects[kind][key] = obj
+            self._versions[kind][key] = self._rv
+            self._dispatch(Event(EventType.MODIFIED, kind, obj, old_obj=old))
+        return obj
+
+    def _delete(self, kind: str, key: str) -> KObject:
+        with self._lock:
+            old = self._objects[kind].pop(key, None)
+            if old is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            self._versions[kind].pop(key, None)
+            self._rv += 1
+            self._dispatch(Event(EventType.DELETED, kind, old))
+        return old
+
+    def _get(self, kind: str, key: str) -> KObject:
+        with self._lock:
+            obj = self._objects[kind].get(key)
+        if obj is None:
+            raise NotFoundError(f"{kind} {key!r} not found")
+        return obj
+
+    def _list(self, kind: str) -> List[KObject]:
+        with self._lock:
+            return list(self._objects[kind].values())
+
+    # -- typed convenience ------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> Pod:
+        return self._create("Pod", pod)
+
+    def update_pod(self, pod: Pod) -> Pod:
+        return self._update("Pod", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> Pod:
+        return self._delete("Pod", f"{namespace}/{name}")
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return self._get("Pod", f"{namespace}/{name}")
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        pods = self._list("Pod")
+        if namespace is None:
+            return pods
+        return [p for p in pods if p.namespace == namespace]
+
+    def create_namespace(self, ns: Namespace) -> Namespace:
+        return self._create("Namespace", ns)
+
+    def update_namespace(self, ns: Namespace) -> Namespace:
+        return self._update("Namespace", ns)
+
+    def delete_namespace(self, name: str) -> Namespace:
+        return self._delete("Namespace", name)
+
+    def get_namespace(self, name: str) -> Optional[Namespace]:
+        try:
+            return self._get("Namespace", name)
+        except NotFoundError:
+            return None
+
+    def list_namespaces(self) -> List[Namespace]:
+        return self._list("Namespace")
+
+    def create_throttle(self, thr: Throttle) -> Throttle:
+        return self._create("Throttle", thr)
+
+    def update_throttle(self, thr: Throttle) -> Throttle:
+        return self._update("Throttle", thr)
+
+    def delete_throttle(self, namespace: str, name: str) -> Throttle:
+        return self._delete("Throttle", f"{namespace}/{name}")
+
+    def get_throttle(self, namespace: str, name: str) -> Throttle:
+        return self._get("Throttle", f"{namespace}/{name}")
+
+    def list_throttles(self, namespace: Optional[str] = None) -> List[Throttle]:
+        thrs = self._list("Throttle")
+        if namespace is None:
+            return thrs
+        return [t for t in thrs if t.namespace == namespace]
+
+    def create_cluster_throttle(self, thr: ClusterThrottle) -> ClusterThrottle:
+        return self._create("ClusterThrottle", thr)
+
+    def update_cluster_throttle(self, thr: ClusterThrottle) -> ClusterThrottle:
+        return self._update("ClusterThrottle", thr)
+
+    def delete_cluster_throttle(self, name: str) -> ClusterThrottle:
+        return self._delete("ClusterThrottle", name)
+
+    def get_cluster_throttle(self, name: str) -> ClusterThrottle:
+        return self._get("ClusterThrottle", name)
+
+    def list_cluster_throttles(self) -> List[ClusterThrottle]:
+        return self._list("ClusterThrottle")
+
+    # -- atomic read-modify-write (Patch verbs) ----------------------------
+
+    def mutate(self, kind: str, key: str, fn: Callable[[KObject], KObject]) -> KObject:
+        """Apply ``fn(current) -> updated`` atomically under the store lock —
+        the server-side-apply analog a JSON merge patch needs: without it,
+        two concurrent get→merge→update round trips silently lose one
+        write. For Throttle/ClusterThrottle the stored status is preserved
+        (status-subresource semantics). ``fn`` must be pure and fast; it
+        runs under the store lock."""
+        with self._lock:
+            current = self._objects[kind].get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {key!r} not found")
+            updated = fn(current)
+            if kind in ("Throttle", "ClusterThrottle"):
+                updated = updated.with_status(current.status)
+            return self._update(kind, updated)
+
+    # -- main-resource update with status-subresource semantics ------------
+
+    def update_throttle_spec(self, thr: Throttle) -> Throttle:
+        """Replace the object but keep the STORED status (the apiserver
+        ignores status changes on main-resource writes when the status
+        subresource is enabled — throttle_types.go:158 marker). Atomic via
+        :meth:`mutate`, so a concurrent ``update_throttle_status`` can never
+        be reverted by a stale read."""
+        return self.mutate("Throttle", thr.key, lambda _cur: thr)
+
+    def update_cluster_throttle_spec(self, thr: ClusterThrottle) -> ClusterThrottle:
+        return self.mutate("ClusterThrottle", thr.name, lambda _cur: thr)
+
+    # -- status subresource (optimistic concurrency) ----------------------
+
+    def update_throttle_status(self, thr: Throttle, expected_version: Optional[int] = None) -> Throttle:
+        """UpdateStatus: replace only the status of the stored object. With
+        ``expected_version``, conflicts raise (the caller re-reads, like a
+        client-go retry-on-conflict loop)."""
+        key = thr.key
+        with self._lock:
+            current = self._objects["Throttle"].get(key)
+            if current is None:
+                raise NotFoundError(f"Throttle {key!r} not found")
+            if expected_version is not None and self._versions["Throttle"][key] != expected_version:
+                raise ConflictError(f"Throttle {key!r} version changed")
+            updated = current.with_status(thr.status)
+            self._rv += 1
+            self._objects["Throttle"][key] = updated
+            self._versions["Throttle"][key] = self._rv
+            self._dispatch(Event(EventType.MODIFIED, "Throttle", updated, old_obj=current))
+        return updated
+
+    def update_cluster_throttle_status(
+        self, thr: ClusterThrottle, expected_version: Optional[int] = None
+    ) -> ClusterThrottle:
+        key = thr.name
+        with self._lock:
+            current = self._objects["ClusterThrottle"].get(key)
+            if current is None:
+                raise NotFoundError(f"ClusterThrottle {key!r} not found")
+            if expected_version is not None and self._versions["ClusterThrottle"][key] != expected_version:
+                raise ConflictError(f"ClusterThrottle {key!r} version changed")
+            updated = current.with_status(thr.status)
+            self._rv += 1
+            self._objects["ClusterThrottle"][key] = updated
+            self._versions["ClusterThrottle"][key] = self._rv
+            self._dispatch(
+                Event(EventType.MODIFIED, "ClusterThrottle", updated, old_obj=current)
+            )
+        return updated
+
+    def resource_version(self, kind: str, key: str) -> int:
+        with self._lock:
+            return self._versions[kind][key]
+
+    @property
+    def latest_resource_version(self) -> int:
+        """The highest resourceVersion assigned so far (the list RV a
+        wire-protocol LIST response reports). Inside an event handler this is
+        exactly the dispatching event's RV — dispatch runs under the store
+        lock right after the bump."""
+        with self._lock:
+            return self._rv

@@ -1,0 +1,178 @@
+"""client-go-style rate-limiting workqueue.
+
+Reproduces the semantics the controllers depend on
+(controller.go:34-122 uses workqueue.NewNamedRateLimitingQueue with the
+DefaultControllerRateLimiter):
+
+- **dedup**: an item Add()ed while queued is not duplicated; an item Add()ed
+  while *processing* is marked dirty and re-queued when Done() is called —
+  so a reconcile never misses the latest state and never runs concurrently
+  for the same key;
+- **AddAfter**: delayed insertion (override-boundary self-wakeups,
+  controller.go:64-72);
+- **AddRateLimited / Forget**: per-item exponential backoff
+  (5ms · 2^fails, capped at 1000s — client-go's ItemExponentialFailureRateLimiter
+  defaults) reset by Forget on success.
+
+The delay waker sleeps on a condition variable until the EARLIEST delayed
+deadline (no unconditional polling — an idle daemon makes zero wakeups);
+``add_after`` re-arms it, and a FakeClock jump notifies it via the clock's
+subscribe hook, keeping FakeClock tests deterministic.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from datetime import timedelta
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..utils.clock import Clock, RealClock
+
+_BASE_DELAY = 0.005  # 5ms
+_MAX_DELAY = 1000.0  # 1000s
+
+
+class ShutDown(Exception):
+    pass
+
+
+class RateLimitingQueue:
+    def __init__(self, name: str = "", clock: Optional[Clock] = None):
+        self.name = name
+        self._clock = clock or RealClock()
+        # consumers (get) and the delay waker wait on separate conditions
+        # over ONE shared lock, so add()/done() can notify exactly one
+        # consumer without waking (or losing the wakeup to) the waker
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._waker_cond = threading.Condition(self._lock)
+        self._queue: List[str] = []  # FIFO of ready items
+        self._dirty: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._delayed: List[Tuple[float, int, str]] = []  # (ready_ts, seq, item)
+        self._seq = 0
+        self._shutdown = False
+        self._clock.subscribe(self._on_clock_jump)
+        self._waker = threading.Thread(target=self._delay_loop, daemon=True)
+        self._waker.start()
+
+    def _on_clock_jump(self) -> None:
+        with self._lock:
+            self._cond.notify_all()
+            self._waker_cond.notify_all()
+
+    # -- core queue semantics (client-go workqueue/queue.go) ---------------
+
+    def add(self, item: str) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # re-queued by done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> str:
+        """Blocks until an item is available. Raises ShutDown."""
+        with self._cond:
+            while not self._queue and not self._shutdown:
+                # untimed callers still wake on every add/done/shutdown
+                # notify; the 1s re-check is only a lost-wakeup safety net
+                if not self._cond.wait(timeout=timeout if timeout is not None else 1.0):
+                    if timeout is not None:
+                        raise TimeoutError
+            if self._shutdown and not self._queue:
+                raise ShutDown
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def try_get(self) -> Optional[str]:
+        """Non-blocking get: an immediately-ready item or None (batch drain)."""
+        with self._cond:
+            if not self._queue:
+                return None
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: str) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    # -- delay / rate limiting --------------------------------------------
+
+    def add_after(self, item: str, delay: timedelta) -> None:
+        secs = delay.total_seconds()
+        if secs <= 0:
+            self.add(item)
+            return
+        ready = self._now_ts() + secs
+        with self._lock:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (ready, self._seq, item))
+            self._waker_cond.notify_all()  # new earliest deadline, re-arm
+
+    def add_rate_limited(self, item: str) -> None:
+        with self._cond:
+            fails = self._failures.get(item, 0)
+            self._failures[item] = fails + 1
+        delay = min(_BASE_DELAY * (2**fails), _MAX_DELAY)
+        self.add_after(item, timedelta(seconds=delay))
+
+    def forget(self, item: str) -> None:
+        with self._cond:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: str) -> int:
+        with self._cond:
+            return self._failures.get(item, 0)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shut_down(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._cond.notify_all()
+            self._waker_cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- internals ---------------------------------------------------------
+
+    def _now_ts(self) -> float:
+        return self._clock.now().timestamp()
+
+    def _delay_loop(self) -> None:
+        """Move due delayed items onto the ready queue, sleeping until the
+        earliest deadline (condition wait, not a poll): zero wakeups while
+        idle. A FakeClock advance notifies via _on_clock_jump; add_after
+        notifies when a new item becomes the earliest."""
+        with self._waker_cond:
+            while True:
+                if self._shutdown:
+                    return
+                now = self._now_ts()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, item = heapq.heappop(self._delayed)
+                    if item not in self._dirty:
+                        self._dirty.add(item)
+                        if item not in self._processing:
+                            self._queue.append(item)
+                            self._cond.notify()
+                timeout = self._delayed[0][0] - now if self._delayed else None
+                self._waker_cond.wait(timeout=timeout)

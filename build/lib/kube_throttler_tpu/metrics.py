@@ -1,0 +1,282 @@
+"""Prometheus metrics — the reference's 16 gauge families, same names,
+labels, and value semantics.
+
+Mirrors pkg/controllers/{metrics_recorder,throttle_metrics,
+clusterthrottle_metrics}.go:
+
+- 8 families per kind: spec threshold / status throttled / status used /
+  status calculatedThreshold, each × resourceCounts + resourceRequests;
+- labels ``{namespace,name,uid,resource}`` for Throttle,
+  ``{name,uid,resource}`` for ClusterThrottle;
+- CPU quantities exported as **milli** (``Quantity.MilliValue()``), all
+  other resources as whole values rounded up (``Quantity.Value()`` ceils) —
+  metrics_recorder.go:38-46;
+- nil resourceCounts records 0 (metrics_recorder.go:29-35); nil throttled
+  request-flag maps record nothing (metrics_recorder.go:56-59).
+
+Implemented with a minimal in-process registry + text exposition (the
+reference registers into kube-scheduler's legacyregistry and serves on its
+metrics endpoint; here ``Registry.exposition()`` backs the daemon's
+``/metrics``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from fractions import Fraction
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+from .api.types import ClusterThrottle, IsResourceAmountThrottled, ResourceAmount, Throttle
+
+
+class GaugeVec:
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, labels: Dict[str, str], value: float) -> None:
+        key = tuple(labels[n] for n in self.label_names)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def get(self, labels: Dict[str, str]) -> Optional[float]:
+        key = tuple(labels[n] for n in self.label_names)
+        with self._lock:
+            return self._values.get(key)
+
+    def collect(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class HistogramVec:
+    """Prometheus histogram family: cumulative buckets + _sum/_count per
+    label set. Backs the per-phase latency tracing (SURVEY §5's TPU-native
+    tracing equivalent — the reference has only klog levels)."""
+
+    # le boundaries tuned for scheduling-phase latencies: 10µs .. 10s
+    DEFAULT_BUCKETS = (
+        1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+        1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        label_names: Sequence[str],
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(buckets) if buckets is not None else self.DEFAULT_BUCKETS
+        self._lock = threading.Lock()
+        # key -> (bucket counts, sum, count)
+        self._series: Dict[Tuple[str, ...], list] = {}
+
+    def observe(self, labels: Dict[str, str], value: float) -> None:
+        key = tuple(labels[n] for n in self.label_names)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * len(self.buckets), 0.0, 0]
+                self._series[key] = s
+            counts, _, _ = s
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    counts[i] += 1
+            s[1] += value
+            s[2] += 1
+
+    def snapshot(self, labels: Dict[str, str]) -> Optional[Tuple[float, int]]:
+        """(sum, count) for one label set, or None."""
+        key = tuple(labels[n] for n in self.label_names)
+        with self._lock:
+            s = self._series.get(key)
+            return (s[1], s[2]) if s else None
+
+    def collect(self) -> Dict[Tuple[str, ...], tuple]:
+        with self._lock:
+            return {k: (list(s[0]), s[1], s[2]) for k, s in self._series.items()}
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, GaugeVec] = {}
+        self._histograms: Dict[str, HistogramVec] = {}
+
+    def gauge_vec(self, name: str, help_text: str, label_names: Sequence[str]) -> GaugeVec:
+        with self._lock:
+            if name in self._gauges:
+                return self._gauges[name]
+            g = GaugeVec(name, help_text, label_names)
+            self._gauges[name] = g
+            return g
+
+    def histogram_vec(
+        self,
+        name: str,
+        help_text: str,
+        label_names: Sequence[str],
+        buckets: Optional[Sequence[float]] = None,
+    ) -> HistogramVec:
+        with self._lock:
+            if name in self._histograms:
+                return self._histograms[name]
+            h = HistogramVec(name, help_text, label_names, buckets)
+            self._histograms[name] = h
+            return h
+
+    def exposition(self) -> str:
+        """Prometheus text format."""
+
+        def esc(v: str) -> str:
+            # label-value escaping per the exposition format: \ " and newline
+            return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+        def fmt(value: float) -> str:
+            return str(int(value)) if value == int(value) else str(value)
+
+        lines = []
+        with self._lock:
+            gauges = list(self._gauges.values())
+            histograms = list(self._histograms.values())
+        for g in gauges:
+            lines.append(f"# HELP {g.name} {g.help}")
+            lines.append(f"# TYPE {g.name} gauge")
+            for key, value in sorted(g.collect().items()):
+                labels = ",".join(
+                    f'{n}="{esc(v)}"' for n, v in zip(g.label_names, key)
+                )
+                lines.append(f"{g.name}{{{labels}}} {fmt(value)}")
+        for h in histograms:
+            lines.append(f"# HELP {h.name} {h.help}")
+            lines.append(f"# TYPE {h.name} histogram")
+            for key, (counts, total, count) in sorted(h.collect().items()):
+                base = [f'{n}="{esc(v)}"' for n, v in zip(h.label_names, key)]
+                for le, c in zip(h.buckets, counts):
+                    labels = ",".join(base + [f'le="{le}"'])
+                    lines.append(f"{h.name}_bucket{{{labels}}} {c}")
+                labels = ",".join(base + ['le="+Inf"'])
+                lines.append(f"{h.name}_bucket{{{labels}}} {count}")
+                sep = ",".join(base)
+                brace = f"{{{sep}}}" if sep else ""
+                lines.append(f"{h.name}_sum{brace} {total}")
+                lines.append(f"{h.name}_count{brace} {count}")
+        return "\n".join(lines) + "\n"
+
+
+def _quantity_metric_value(resource: str, q: Fraction) -> float:
+    if resource == "cpu":
+        # MilliValue: ceil to integer milli (metrics_recorder.go:40-41)
+        return float(math.ceil(q * 1000))
+    # Value(): ceil to integer units
+    return float(math.ceil(q))
+
+
+class _KindRecorder:
+    """One kind's 8 gauge families."""
+
+    def __init__(self, kind_prefix: str, label_names: Sequence[str], registry: Registry):
+        mk = registry.gauge_vec
+        k = kind_prefix
+        self.spec_counts = mk(
+            f"{k}_spec_threshold_resourceCounts",
+            f"threshold on specific resourceCounts of the {k}",
+            label_names,
+        )
+        self.spec_requests = mk(
+            f"{k}_spec_threshold_resourceRequests",
+            f"threshold on specific resourceRequests of the {k}",
+            label_names,
+        )
+        self.throttled_counts = mk(
+            f"{k}_status_throttled_resourceCounts",
+            f"resourceCounts of the {k} is throttled or not on specific resource (1=throttled, 0=not throttled)",
+            label_names,
+        )
+        self.throttled_requests = mk(
+            f"{k}_status_throttled_resourceRequests",
+            f"resourceRequests of the {k} is throttled or not on specific resource (1=throttled, 0=not throttled)",
+            label_names,
+        )
+        self.used_counts = mk(
+            f"{k}_status_used_resourceCounts",
+            f"used resource counts of the {k}",
+            label_names,
+        )
+        self.used_requests = mk(
+            f"{k}_status_used_resourceRequests",
+            f"used amount of resource requests of the {k}",
+            label_names,
+        )
+        self.calculated_counts = mk(
+            f"{k}_status_calculated_threshold_resourceCounts",
+            f"calculated threshold on specific resourceCounts of the {k}",
+            label_names,
+        )
+        self.calculated_requests = mk(
+            f"{k}_status_calculated_threshold_resourceRequests",
+            f"calculated threshold on specific resourceRequests of the {k}",
+            label_names,
+        )
+
+    def _record_counts(self, gauge: GaugeVec, labels: Dict[str, str], counts: Optional[int]) -> None:
+        gauge.set({**labels, "resource": "pod"}, 0.0 if counts is None else float(counts))
+
+    def _record_requests(self, gauge: GaugeVec, labels: Dict[str, str], amount: ResourceAmount) -> None:
+        for resource, q in (amount.resource_requests or {}).items():
+            gauge.set({**labels, "resource": resource}, _quantity_metric_value(resource, q))
+
+    def _record_flags(self, labels: Dict[str, str], flags: IsResourceAmountThrottled) -> None:
+        self.throttled_counts.set(
+            {**labels, "resource": "pod"}, 1.0 if flags.resource_counts_pod else 0.0
+        )
+        for resource, throttled in (flags.resource_requests or {}).items():
+            self.throttled_requests.set(
+                {**labels, "resource": resource}, 1.0 if throttled else 0.0
+            )
+
+    def record(self, labels: Dict[str, str], thr: Union[Throttle, ClusterThrottle]) -> None:
+        self._record_counts(self.spec_counts, labels, thr.spec.threshold.resource_counts)
+        self._record_requests(self.spec_requests, labels, thr.spec.threshold)
+        self._record_flags(labels, thr.status.throttled)
+        self._record_counts(self.used_counts, labels, thr.status.used.resource_counts)
+        self._record_requests(self.used_requests, labels, thr.status.used)
+        calc = thr.status.calculated_threshold.threshold
+        self._record_counts(self.calculated_counts, labels, calc.resource_counts)
+        self._record_requests(self.calculated_requests, labels, calc)
+
+
+class ThrottleMetricsRecorder:
+    """throttle_metrics.go:94-197. The registry is explicit — there is no
+    module-global default, so recorded series are always reachable from
+    whatever serves that registry's /metrics."""
+
+    def __init__(self, registry: Registry):
+        self._rec = _KindRecorder(
+            "throttle", ("namespace", "name", "uid", "resource"), registry
+        )
+
+    def record(self, thr: Throttle) -> None:
+        self._rec.record(
+            {"namespace": thr.namespace, "name": thr.name, "uid": thr.uid}, thr
+        )
+
+
+class ClusterThrottleMetricsRecorder:
+    """clusterthrottle_metrics.go:224-326."""
+
+    def __init__(self, registry: Registry):
+        self._rec = _KindRecorder(
+            "clusterthrottle", ("name", "uid", "resource"), registry
+        )
+
+    def record(self, thr: ClusterThrottle) -> None:
+        self._rec.record({"name": thr.name, "uid": thr.uid}, thr)

@@ -1,0 +1,175 @@
+// Native selector row-match engine for the TPU throttler's host control plane.
+//
+// Role (reference parity): the reference's affectedThrottles is a linear Go
+// scan of every Throttle's selector per pod event (throttle_controller.go:
+// 248-269, clusterthrottle_controller.go:272-298).  The Python index
+// (kube_throttler_tpu/engine/index.py) materializes the [P,T] mask and
+// recomputes one row per pod event; this library moves that row recompute —
+// the only O(#throttles) scalar loop left on the host — into C++.
+//
+// Model: Python keeps authority over interning (label keys/values/namespaces
+// → int32 ids), row/column allocation, and the general (matchExpressions)
+// tier.  Each throttle column is compiled here to its matchLabels-only
+// selector terms (selector.selecterTerms[] OR-ed, each term an AND of
+// (key,value) requirements — throttle_selector.go:30-54; ClusterThrottle
+// terms additionally AND a namespaceSelector, clusterthrottle_selector.go:
+// 112-141).  ktn_match_row evaluates one pod against every column in a
+// single call; columns that need the general tier are flagged back to
+// Python instead of being evaluated here.
+//
+// Semantics mirrored exactly (see SelectorIndex._match_one):
+//   - namespaced Throttle: pod.namespace must equal the throttle's namespace
+//     (applies to general columns too — the gate short-circuits them).
+//   - ClusterThrottle: a pod whose Namespace object is unknown never matches
+//     (clusterthrottle_controller.go:273-276).
+//   - OR of zero terms is false (empty selector matches nothing); a term
+//     with zero requirements matches everything.
+//
+// C ABI only (loaded via ctypes); no exceptions cross the boundary.
+
+#include <cstdint>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct Req {
+  int32_t key;
+  int32_t val;
+};
+
+struct Term {
+  std::vector<Req> pod;  // pod-label requirements
+  std::vector<Req> ns;   // namespace-label requirements (ClusterThrottle only)
+};
+
+struct Col {
+  bool valid = false;
+  bool general = false;  // evaluated by the Python general tier
+  int32_t thr_ns = -1;   // required pod-namespace id (namespaced Throttle); -1 = cluster
+  std::vector<Term> terms;
+};
+
+struct Engine {
+  bool cluster = false;  // kind == clusterthrottle
+  std::vector<Col> cols;
+};
+
+// All requirements satisfied by the (keys,vals) label set?  Label sets are
+// small (a handful of entries), so a linear probe beats hashing.
+bool pairs_match(const std::vector<Req>& reqs, const int32_t* keys,
+                 const int32_t* vals, int32_t n) {
+  for (const Req& r : reqs) {
+    bool ok = false;
+    for (int32_t i = 0; i < n; ++i) {
+      if (keys[i] == r.key) {
+        ok = (vals[i] == r.val);
+        break;
+      }
+    }
+    if (!ok) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ktn_create(int32_t is_cluster) {
+  Engine* e = new Engine();
+  e->cluster = (is_cluster != 0);
+  return e;
+}
+
+void ktn_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+void ktn_reserve(void* h, int32_t tcap) {
+  Engine* e = static_cast<Engine*>(h);
+  if (static_cast<int32_t>(e->cols.size()) < tcap) e->cols.resize(tcap);
+}
+
+// Compile a matchLabels-only column.  Terms arrive flattened CSR-style:
+// term t's pod requirements are (pod_keys,pod_vals)[pod_off[t]..pod_off[t+1])
+// and its namespace requirements the same over ns_off/ns_keys/ns_vals.
+void ktn_set_col(void* h, int32_t col, int32_t thr_ns, int32_t n_terms,
+                 const int32_t* pod_off, const int32_t* pod_keys,
+                 const int32_t* pod_vals, const int32_t* ns_off,
+                 const int32_t* ns_keys, const int32_t* ns_vals) {
+  Engine* e = static_cast<Engine*>(h);
+  if (col >= static_cast<int32_t>(e->cols.size())) e->cols.resize(col + 1);
+  Col& c = e->cols[col];
+  c.valid = true;
+  c.general = false;
+  c.thr_ns = thr_ns;
+  c.terms.clear();
+  c.terms.reserve(n_terms);
+  for (int32_t t = 0; t < n_terms; ++t) {
+    Term term;
+    for (int32_t i = pod_off[t]; i < pod_off[t + 1]; ++i)
+      term.pod.push_back({pod_keys[i], pod_vals[i]});
+    for (int32_t i = ns_off[t]; i < ns_off[t + 1]; ++i)
+      term.ns.push_back({ns_keys[i], ns_vals[i]});
+    c.terms.push_back(std::move(term));
+  }
+}
+
+// Column whose selector needs the Python general tier (matchExpressions /
+// parse errors).  The namespace gate still applies natively.
+void ktn_set_col_general(void* h, int32_t col, int32_t thr_ns) {
+  Engine* e = static_cast<Engine*>(h);
+  if (col >= static_cast<int32_t>(e->cols.size())) e->cols.resize(col + 1);
+  Col& c = e->cols[col];
+  c.valid = true;
+  c.general = true;
+  c.thr_ns = thr_ns;
+  c.terms.clear();
+}
+
+void ktn_clear_col(void* h, int32_t col) {
+  Engine* e = static_cast<Engine*>(h);
+  if (col < static_cast<int32_t>(e->cols.size())) e->cols[col] = Col{};
+}
+
+int32_t ktn_num_cols(void* h) {
+  return static_cast<int32_t>(static_cast<Engine*>(h)->cols.size());
+}
+
+// Evaluate one pod against all compiled columns.
+//   pod_ns     — interned namespace id of the pod
+//   ns_exists  — 1 iff the Namespace object is known (ClusterThrottle gate)
+//   (pk,pv,np) — interned pod-label (key,value) pairs
+//   (nk,nv,nn) — interned namespace-label pairs of the pod's namespace
+//   out[c]         — 1 iff column c matches (0 for general columns)
+//   general_out[c] — 1 iff Python must evaluate column c (gate passed)
+// Both outputs must hold ktn_num_cols entries.
+void ktn_match_row(void* h, int32_t pod_ns, int32_t ns_exists,
+                   const int32_t* pk, const int32_t* pv, int32_t np,
+                   const int32_t* nk, const int32_t* nv, int32_t nn,
+                   uint8_t* out, uint8_t* general_out) {
+  Engine* e = static_cast<Engine*>(h);
+  const int32_t T = static_cast<int32_t>(e->cols.size());
+  for (int32_t c = 0; c < T; ++c) {
+    const Col& col = e->cols[c];
+    out[c] = 0;
+    general_out[c] = 0;
+    if (!col.valid) continue;
+    if (!e->cluster) {
+      if (col.thr_ns != pod_ns) continue;
+    } else if (!ns_exists) {
+      continue;
+    }
+    if (col.general) {
+      general_out[c] = 1;
+      continue;
+    }
+    for (const Term& t : col.terms) {
+      if (!pairs_match(t.pod, pk, pv, np)) continue;
+      if (e->cluster && !pairs_match(t.ns, nk, nv, nn)) continue;
+      out[c] = 1;
+      break;
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,31 @@
+"""Device data plane: the throttler's decision core as XLA tensor programs.
+
+The reference evaluates `used + reserved + pod.requests vs threshold` in a
+per-pod × per-throttle × per-dimension nested Go loop on the scheduler hot
+path (throttle_controller.go:349-397). Here that loop is a single fused
+elementwise/reduction kernel over padded int64 milli-unit arrays:
+
+- ``schema``    — array layout: presence-masked [T,R]/[P,R] state tensors,
+  the resource-dimension registry, and host→device encoding.
+- ``check``     — the batched ordered 4-state admission check.
+- ``aggregate`` — masked used-aggregation (einsum) + streaming scatter-add.
+- ``overrides`` — time-varying threshold resolution (first-active-wins).
+"""
+
+from .schema import (  # noqa: F401
+    DimRegistry,
+    PodBatch,
+    ThrottleState,
+    encode_pods,
+    encode_throttle_state,
+)
+from .check import (  # noqa: F401
+    CHECK_ACTIVE,
+    CHECK_INSUFFICIENT,
+    CHECK_NOT_AFFECTED,
+    CHECK_NOT_THROTTLED,
+    CHECK_POD_EXCEEDS,
+    STATUS_NAMES,
+    check_pods,
+    check_pods_compact,
+)

@@ -1,0 +1,194 @@
+"""The batched ordered 4-state admission check — the framework's hot kernel.
+
+Reproduces ``check_throttled_for`` (reference throttle_types.go:128-153,
+clusterthrottle_types.go:30-55) for every (pod, throttle) pair at once:
+
+    1. pod alone > threshold                  → POD_EXCEEDS (onEqual=False)
+    2. persisted status.throttled flags hit   → ACTIVE
+    3. used + reserved saturates threshold    → ACTIVE
+       (onEqual hardcoded True for Throttle — throttle_types.go:143 —
+        caller's flag for ClusterThrottle — clusterthrottle_types.go:45)
+    4. used + reserved + pod overflows        → INSUFFICIENT (caller's flag)
+    else                                      → NOT_THROTTLED
+
+Presence-mask algebra (absent ≠ zero) follows resource_amount.go:127-159:
+a comparison only fires when the dimension is present in BOTH the threshold
+and the used side; "blocks this pod" additionally requires the pod to
+request that resource non-zero (resource_amount.go:46-65) — except the
+pod-count flag, which always blocks.
+
+Shapes: throttle state [T]/[T,R], pods [P]/[P,R], selector mask [P,T].
+Everything broadcasts to [P,T,R] inside a single XLA fusion and reduces over
+R — no [P,T,R] intermediate is materialized at the default sizes. Two
+output forms:
+
+- ``check_pods``          → int8[P,T] full classification (explain path,
+  oracle diffing, reason-string formatting for blocked pods);
+- ``check_pods_compact``  → int32[P,4] per-pod class counts + bool[P]
+  schedulable (the scheduler hot path: 100k×10k never materializes [P,T]).
+
+The two static booleans (kind asymmetry, caller onEqual) select among 4
+compiled variants; shapes are padded so object churn never recompiles.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .schema import PodBatch, ThrottleState
+
+CHECK_NOT_AFFECTED = -1
+CHECK_NOT_THROTTLED = 0
+CHECK_ACTIVE = 1
+CHECK_INSUFFICIENT = 2
+CHECK_POD_EXCEEDS = 3
+
+STATUS_NAMES = {
+    CHECK_NOT_AFFECTED: "not-affected",
+    CHECK_NOT_THROTTLED: "not-throttled",
+    CHECK_ACTIVE: "active",
+    CHECK_INSUFFICIENT: "insufficient",
+    CHECK_POD_EXCEEDS: "pod-requests-exceeds-threshold",
+}
+
+
+def _cmp(u, t, on_equal: bool):
+    return u >= t if on_equal else u > t
+
+
+def _classify(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
+              on_equal: bool, step3_on_equal: bool) -> jnp.ndarray:
+    """Core classification → int8[P,T]. Static flags pick the variant."""
+    # trace-time guard: DimRegistry capacity may have doubled between the
+    # throttle-state and pod-batch encodes; fail with an actionable message
+    # instead of an opaque XLA broadcast error
+    if state.thr_req.shape[1] != pods.req.shape[1]:
+        raise ValueError(
+            f"resource-dim mismatch: throttle state has R={state.thr_req.shape[1]} "
+            f"but pod batch has R={pods.req.shape[1]}; the dim registry grew — "
+            "re-encode both against the same capacity"
+        )
+    if mask.shape != (pods.req.shape[0], state.thr_req.shape[0]):
+        raise ValueError(
+            f"mask shape {mask.shape} != (P={pods.req.shape[0]}, T={state.thr_req.shape[0]})"
+        )
+    # pod-side broadcast views: [P,1,R] vs throttle [1,T,R]
+    pod_req = pods.req[:, None, :]
+    pod_present = pods.req_present[:, None, :]
+    pod_nonzero = pod_present & (pod_req != 0)
+
+    thr_req = state.thr_req[None, :, :]
+    thr_req_present = state.thr_req_present[None, :, :]
+    thr_cnt = state.thr_cnt[None, :]
+    thr_cnt_present = state.thr_cnt_present[None, :]
+
+    # --- step 1: pod alone vs threshold (onEqual=False) -------------------
+    # pod count is always 1 and always present
+    exceeds_cnt = thr_cnt_present & (1 > thr_cnt)
+    exceeds_req = jnp.any(
+        thr_req_present & pod_present & (pod_req > thr_req) & (pod_req != 0), axis=-1
+    )
+    exceeds = exceeds_cnt | exceeds_req
+
+    # --- step 2: persisted throttled flags --------------------------------
+    st_active = state.st_cnt_throttled[None, :] | jnp.any(
+        state.st_req_flag_present[None, :, :]
+        & state.st_req_throttled[None, :, :]
+        & pod_nonzero,
+        axis=-1,
+    )
+
+    # --- step 3: used + reserved saturation -------------------------------
+    au_cnt = state.used_cnt + state.res_cnt
+    au_cnt_present = state.used_cnt_present | state.res_cnt_present
+    au_req = state.used_req + state.res_req
+    au_req_present = state.used_req_present | state.res_req_present
+
+    sat_cnt = thr_cnt_present & au_cnt_present[None, :] & _cmp(
+        au_cnt[None, :], thr_cnt, step3_on_equal
+    )
+    sat_req = jnp.any(
+        thr_req_present
+        & au_req_present[None, :, :]
+        & _cmp(au_req[None, :, :], thr_req, step3_on_equal)
+        & pod_nonzero,
+        axis=-1,
+    )
+    saturated = sat_cnt | sat_req
+
+    # --- step 4: used + reserved + pod overflow ---------------------------
+    # pod contributes count 1 (always present) and its requests
+    tot_cnt = au_cnt[None, :] + 1
+    tot_req = au_req[None, :, :] + pod_req
+    tot_req_present = au_req_present[None, :, :] | pod_present
+
+    over_cnt = thr_cnt_present & _cmp(tot_cnt, thr_cnt, on_equal)
+    over_req = jnp.any(
+        thr_req_present
+        & tot_req_present
+        & _cmp(tot_req, thr_req, on_equal)
+        & pod_nonzero,
+        axis=-1,
+    )
+    insufficient = over_cnt | over_req
+
+    # --- ordered resolution ----------------------------------------------
+    result = jnp.where(
+        exceeds,
+        jnp.int8(CHECK_POD_EXCEEDS),
+        jnp.where(
+            st_active | saturated,
+            jnp.int8(CHECK_ACTIVE),
+            jnp.where(insufficient, jnp.int8(CHECK_INSUFFICIENT), jnp.int8(CHECK_NOT_THROTTLED)),
+        ),
+    )
+    affected = mask & state.valid[None, :] & pods.valid[:, None]
+    return jnp.where(affected, result, jnp.int8(CHECK_NOT_AFFECTED))
+
+
+@partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
+def check_pods(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
+               on_equal: bool = False, step3_on_equal: bool = True) -> jnp.ndarray:
+    """Full [P,T] classification (int8)."""
+    return _classify(state, pods, mask, on_equal, step3_on_equal)
+
+
+def statuses_to_compact(statuses: jnp.ndarray):
+    """[P,T] statuses → (counts int32[P,4], schedulable bool[P]); the
+    schedulable gate mirrors PreFilter (plugin.go:177-180). Shared by every
+    compact path so the gate can never silently diverge between kernels."""
+    counts = jnp.stack(
+        [jnp.sum(statuses == c, axis=1, dtype=jnp.int32) for c in range(4)], axis=1
+    )
+    schedulable = (
+        counts[:, CHECK_ACTIVE] + counts[:, CHECK_INSUFFICIENT] + counts[:, CHECK_POD_EXCEEDS]
+    ) == 0
+    return counts, schedulable
+
+
+def _compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
+             on_equal: bool, step3_on_equal: bool):
+    return statuses_to_compact(_classify(state, pods, mask, on_equal, step3_on_equal))
+
+
+def check_step(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray):
+    """Un-jitted forward step (PreFilter defaults: onEqual=False, Throttle
+    kind) for embedding under an outer jit — returns (counts, schedulable)."""
+    return _compact(state, pods, mask, False, True)
+
+
+@partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
+def check_pods_compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
+                       on_equal: bool = False, step3_on_equal: bool = True):
+    """Hot-path form: per-pod class counts, no [P,T] materialization.
+
+    Returns ``(counts int32[P,4], schedulable bool[P])`` where counts[p,c]
+    is the number of affected throttles classifying pod p as class c
+    (NOT_THROTTLED/ACTIVE/INSUFFICIENT/POD_EXCEEDS), and schedulable[p]
+    mirrors PreFilter's gate: no active/insufficient/exceeds throttle
+    (plugin.go:177-180).
+    """
+    return _compact(state, pods, mask, on_equal, step3_on_equal)

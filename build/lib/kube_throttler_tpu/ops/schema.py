@@ -1,0 +1,316 @@
+"""Tensor schema: how throttler state becomes padded device arrays.
+
+Encoding rules (all derived from the oracle semantics in ``api/types.py``):
+
+- Quantities are **int64 milli-units** (exact; see ``quantity.to_milli``).
+  Encoding raises on sub-milli precision rather than silently rounding.
+- Every value tensor carries a **presence mask**. Absent (Go-nil / missing
+  map key) is distinct from zero: absent threshold dims are never evaluated,
+  absent used dims never throttle (resource_amount.go:143,151-155). Absent
+  cells hold value 0 so sums stay valid without branching.
+- Arrays are padded to fixed capacities (throttles T, pods P, resource dims
+  R) so jitted kernels never recompile on object churn; validity masks mark
+  live rows. Capacities grow geometrically (re-jit is rare and amortized).
+- The per-throttle *effective* threshold (status.calculatedThreshold if
+  calculatedAt is set, else spec.threshold — throttle_types.go:129-132) is
+  resolved at encode time; the check kernel sees one threshold tensor.
+
+The [P,T] selector mask is produced by the host selector index (engine/),
+not here — matching is string/label work, which stays on host; the device
+sees only its boolean result.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.pod import Pod
+from ..api.types import ClusterThrottle, Throttle
+from ..quantity import to_milli
+from .. import resourcelist as rl
+
+AnyThrottle = Union[Throttle, ClusterThrottle]
+
+
+class DimRegistry:
+    """Stable resource-name → column-index mapping.
+
+    Grows append-only; encoded arrays are padded to ``capacity`` columns so
+    adding the (R+1)-th distinct resource name does not change array shapes
+    until capacity doubles.
+    """
+
+    def __init__(self, capacity: int = 8):
+        self._names: List[str] = []
+        self._index: Dict[str, int] = {}
+        self.capacity = capacity
+
+    def index_of(self, name: str) -> int:
+        idx = self._index.get(name)
+        if idx is None:
+            idx = len(self._names)
+            self._names.append(name)
+            self._index[name] = idx
+            while idx >= self.capacity:
+                self.capacity *= 2
+        return idx
+
+    @property
+    def names(self) -> Sequence[str]:
+        return tuple(self._names)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class ThrottleState:
+    """Padded per-kind device state: [T] / [T,R] arrays + presence masks.
+
+    One instance per kind (Throttle, ClusterThrottle), mirroring the two
+    controllers in the reference.
+    """
+
+    valid: jnp.ndarray  # bool[T] — live throttle rows
+    thr_cnt: jnp.ndarray  # int64[T] — effective threshold pod-count
+    thr_cnt_present: jnp.ndarray  # bool[T]
+    thr_req: jnp.ndarray  # int64[T,R]
+    thr_req_present: jnp.ndarray  # bool[T,R]
+    used_cnt: jnp.ndarray  # int64[T]
+    used_cnt_present: jnp.ndarray  # bool[T]
+    used_req: jnp.ndarray  # int64[T,R]
+    used_req_present: jnp.ndarray  # bool[T,R]
+    res_cnt: jnp.ndarray  # int64[T] — scheduler-cycle reservations
+    res_cnt_present: jnp.ndarray  # bool[T]
+    res_req: jnp.ndarray  # int64[T,R]
+    res_req_present: jnp.ndarray  # bool[T,R]
+    st_cnt_throttled: jnp.ndarray  # bool[T] — status.throttled.resourceCounts.pod
+    st_req_throttled: jnp.ndarray  # bool[T,R] — status.throttled.resourceRequests
+    st_req_flag_present: jnp.ndarray  # bool[T,R] — key present in the flag map
+
+    def tree_flatten(self):
+        return (
+            (
+                self.valid,
+                self.thr_cnt,
+                self.thr_cnt_present,
+                self.thr_req,
+                self.thr_req_present,
+                self.used_cnt,
+                self.used_cnt_present,
+                self.used_req,
+                self.used_req_present,
+                self.res_cnt,
+                self.res_cnt_present,
+                self.res_req,
+                self.res_req_present,
+                self.st_cnt_throttled,
+                self.st_req_throttled,
+                self.st_req_flag_present,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def num_throttles(self) -> int:
+        return self.valid.shape[0]
+
+    @property
+    def num_dims(self) -> int:
+        return self.thr_req.shape[1]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class PodBatch:
+    """Padded pod-side arrays: [P] / [P,R]. Pod count is implicitly 1/pod."""
+
+    valid: jnp.ndarray  # bool[P]
+    req: jnp.ndarray  # int64[P,R]
+    req_present: jnp.ndarray  # bool[P,R]
+
+    def tree_flatten(self):
+        return ((self.valid, self.req, self.req_present), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def num_pods(self) -> int:
+        return self.valid.shape[0]
+
+
+def _amount_into(
+    row_req: np.ndarray,
+    row_present: np.ndarray,
+    requests: Optional[Dict[str, object]],
+    dims: DimRegistry,
+) -> None:
+    for name, q in (requests or {}).items():
+        j = dims.index_of(name)
+        row_req[j] = to_milli(q)
+        row_present[j] = True
+
+
+def encode_throttle_state(
+    throttles: Sequence[AnyThrottle],
+    dims: DimRegistry,
+    reserved: Optional[Sequence[Dict[str, object]]] = None,
+    capacity: Optional[int] = None,
+) -> ThrottleState:
+    """Encode (Cluster)Throttle objects into a padded ThrottleState.
+
+    ``reserved`` optionally supplies per-throttle reserved ResourceAmounts
+    (as ``api.types.ResourceAmount``); defaults to empty.
+    """
+    from ..api.types import effective_threshold
+
+    n = len(throttles)
+    # register every name first so R is final before array allocation
+    for thr in throttles:
+        eff = effective_threshold(thr.spec.threshold, thr.status)
+        for name in (eff.resource_requests or {}):
+            dims.index_of(name)
+        for name in (thr.status.used.resource_requests or {}):
+            dims.index_of(name)
+        for name in (thr.status.throttled.resource_requests or {}):
+            dims.index_of(name)
+    if reserved is not None:
+        for ra in reserved:
+            if ra is not None:
+                for name in (ra.resource_requests or {}):
+                    dims.index_of(name)
+
+    T = capacity if capacity is not None else max(n, 1)
+    R = dims.capacity
+
+    valid = np.zeros(T, dtype=bool)
+    thr_cnt = np.zeros(T, dtype=np.int64)
+    thr_cnt_present = np.zeros(T, dtype=bool)
+    thr_req = np.zeros((T, R), dtype=np.int64)
+    thr_req_present = np.zeros((T, R), dtype=bool)
+    used_cnt = np.zeros(T, dtype=np.int64)
+    used_cnt_present = np.zeros(T, dtype=bool)
+    used_req = np.zeros((T, R), dtype=np.int64)
+    used_req_present = np.zeros((T, R), dtype=bool)
+    res_cnt = np.zeros(T, dtype=np.int64)
+    res_cnt_present = np.zeros(T, dtype=bool)
+    res_req = np.zeros((T, R), dtype=np.int64)
+    res_req_present = np.zeros((T, R), dtype=bool)
+    st_cnt_throttled = np.zeros(T, dtype=bool)
+    st_req_throttled = np.zeros((T, R), dtype=bool)
+    st_req_flag_present = np.zeros((T, R), dtype=bool)
+
+    for i, thr in enumerate(throttles):
+        valid[i] = True
+        eff = effective_threshold(thr.spec.threshold, thr.status)
+        if eff.resource_counts is not None:
+            thr_cnt[i] = eff.resource_counts
+            thr_cnt_present[i] = True
+        _amount_into(thr_req[i], thr_req_present[i], eff.resource_requests, dims)
+
+        used = thr.status.used
+        if used.resource_counts is not None:
+            used_cnt[i] = used.resource_counts
+            used_cnt_present[i] = True
+        _amount_into(used_req[i], used_req_present[i], used.resource_requests, dims)
+
+        if reserved is not None and i < len(reserved) and reserved[i] is not None:
+            ra = reserved[i]
+            if ra.resource_counts is not None:
+                res_cnt[i] = ra.resource_counts
+                res_cnt_present[i] = True
+            _amount_into(res_req[i], res_req_present[i], ra.resource_requests, dims)
+
+        st = thr.status.throttled
+        st_cnt_throttled[i] = st.resource_counts_pod
+        for name, flag in (st.resource_requests or {}).items():
+            j = dims.index_of(name)
+            st_req_flag_present[i, j] = True
+            st_req_throttled[i, j] = flag
+
+    return ThrottleState(
+        valid=jnp.asarray(valid),
+        thr_cnt=jnp.asarray(thr_cnt),
+        thr_cnt_present=jnp.asarray(thr_cnt_present),
+        thr_req=jnp.asarray(thr_req),
+        thr_req_present=jnp.asarray(thr_req_present),
+        used_cnt=jnp.asarray(used_cnt),
+        used_cnt_present=jnp.asarray(used_cnt_present),
+        used_req=jnp.asarray(used_req),
+        used_req_present=jnp.asarray(used_req_present),
+        res_cnt=jnp.asarray(res_cnt),
+        res_cnt_present=jnp.asarray(res_cnt_present),
+        res_req=jnp.asarray(res_req),
+        res_req_present=jnp.asarray(res_req_present),
+        st_cnt_throttled=jnp.asarray(st_cnt_throttled),
+        st_req_throttled=jnp.asarray(st_req_throttled),
+        st_req_flag_present=jnp.asarray(st_req_flag_present),
+    )
+
+
+def encode_pods(
+    pods: Sequence[Pod],
+    dims: DimRegistry,
+    capacity: Optional[int] = None,
+) -> PodBatch:
+    """Encode pods' effective requests into a padded PodBatch."""
+    n = len(pods)
+    requests = [rl.pod_request_resource_list(p) for p in pods]
+    for reqs in requests:
+        for name in reqs:
+            dims.index_of(name)
+
+    P = capacity if capacity is not None else max(n, 1)
+    R = dims.capacity
+    valid = np.zeros(P, dtype=bool)
+    req = np.zeros((P, R), dtype=np.int64)
+    req_present = np.zeros((P, R), dtype=bool)
+    for i, reqs in enumerate(requests):
+        valid[i] = True
+        for name, q in reqs.items():
+            j = dims.index_of(name)
+            req[i, j] = to_milli(q)
+            req_present[i, j] = True
+    return PodBatch(
+        valid=jnp.asarray(valid), req=jnp.asarray(req), req_present=jnp.asarray(req_present)
+    )
+
+
+def selector_mask(
+    pods: Sequence[Pod],
+    throttles: Sequence[AnyThrottle],
+    namespaces: Optional[Dict[str, object]] = None,
+    pod_capacity: Optional[int] = None,
+    throttle_capacity: Optional[int] = None,
+) -> jnp.ndarray:
+    """Reference-semantics [P,T] selector mask (host loop; small scale /
+    tests). Throttles additionally require namespace equality
+    (affectedThrottles lists only the pod's namespace —
+    throttle_controller.go:248-269); ClusterThrottles match via namespace +
+    pod selectors."""
+    P = pod_capacity if pod_capacity is not None else max(len(pods), 1)
+    T = throttle_capacity if throttle_capacity is not None else max(len(throttles), 1)
+    mask = np.zeros((P, T), dtype=bool)
+    for i, pod in enumerate(pods):
+        for j, thr in enumerate(throttles):
+            if isinstance(thr, Throttle):
+                mask[i, j] = thr.namespace == pod.namespace and thr.spec.selector.matches_to_pod(pod)
+            else:
+                ns = (namespaces or {}).get(pod.namespace)
+                if ns is None:
+                    mask[i, j] = False
+                else:
+                    mask[i, j] = thr.spec.selector.matches_to_pod(pod, ns)
+    return jnp.asarray(mask)

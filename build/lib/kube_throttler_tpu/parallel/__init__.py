@@ -1,0 +1,27 @@
+"""Multi-chip scale-out: mesh construction + the sharded full update step.
+
+The workload's parallel axes (SURVEY §2/§5: this system has no sequence/
+pipeline/expert structure — its scaling axes are #pods and #throttles) map
+onto a 2D device mesh:
+
+- ``pods`` axis      — data-parallel over the pod batch (rows of the check
+  matrix and of the selector mask);
+- ``throttles`` axis — model-parallel-style sharding of throttle state
+  (columns of the mask; thresholds/used/reserved rows).
+
+Cross-shard communication is exactly two XLA collectives per step, both
+riding ICI: a ``psum`` over the pods axis to assemble used-aggregation
+partials, and a ``psum`` over the throttles axis to assemble per-pod
+admission verdicts. Resource dims (R ≤ 32) stay replicated.
+
+Alternative decomposition: ``ring.py`` keeps throttle tiles resident and
+rotates pod blocks over ``ppermute`` (the ring-attention/context-parallel
+pattern) for throttle-state-dominated shapes. Multi-host: ``distributed.py``
+brings up jax.distributed and lays the pods axis over DCN with throttles on
+each host's ICI island.
+"""
+
+from .distributed import hybrid_mesh, init_distributed, shard_global_array  # noqa: F401
+from .mesh import make_mesh, mesh_shardings  # noqa: F401
+from .ring import make_ring_mesh, ring_full_update  # noqa: F401
+from .sharded import full_update_step, sharded_full_update  # noqa: F401

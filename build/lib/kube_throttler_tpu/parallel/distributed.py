@@ -1,0 +1,121 @@
+"""Multi-host runtime: process bring-up and DCN×ICI hybrid meshes.
+
+The reference scales out through the Kubernetes API server's watch protocol
+(SURVEY §5 — its only "distributed backend"). The TPU-native equivalent is
+jax.distributed over ICI/DCN: every host runs the same host control plane
+shard and the device data plane spans all chips of the slice/pod.
+
+Axis → link mapping (scaling-book recipe): the **pods** axis is the
+data-parallel axis and is laid over **DCN** (hosts); the **throttles** axis
+stays within a host's ICI island. The step's two collectives
+(`psum` of [T_loc,R] used-partials over pods, `psum` of [P_loc,4] verdict
+counts over throttles — see sharded.py) then put the per-throttle-tile
+reduce on the slow links only once per tick while the throttle-axis reduce
+rides ICI.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring up jax.distributed for multi-host operation.
+
+    Arguments fall back to ``KT_TPU_COORDINATOR`` / ``KT_TPU_NUM_PROCESSES``
+    / ``KT_TPU_PROCESS_ID`` env vars. With no explicit configuration at all,
+    ``KT_TPU_AUTO_DISTRIBUTED=1`` opts into JAX's own cluster auto-detection
+    (argless ``jax.distributed.initialize()``, e.g. TPU pod metadata); the
+    un-opted default is a no-op so single-process callers share the entry
+    point without risking a hang waiting for a nonexistent coordinator.
+    Returns True iff a multi-process runtime was initialized.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("KT_TPU_COORDINATOR")
+    env_np = os.environ.get("KT_TPU_NUM_PROCESSES")
+    env_pid = os.environ.get("KT_TPU_PROCESS_ID")
+    if num_processes is None and env_np is not None:
+        num_processes = int(env_np)
+    if process_id is None and env_pid is not None:
+        process_id = int(env_pid)
+    if coordinator_address is None and num_processes in (None, 1):
+        if os.environ.get("KT_TPU_AUTO_DISTRIBUTED") == "1":
+            jax.distributed.initialize()  # cluster auto-detection
+            _initialized = True
+            return True
+        return False  # single-process; nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def hybrid_mesh(
+    ici_shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """("pods","throttles") mesh spanning all processes.
+
+    Multi-process: pods axis = DCN (one slot per host) × intra-host pods
+    factor; throttles axis stays inside each host's ICI island.
+    ``ici_shape`` fixes the per-host (pods, throttles) factorization;
+    default puts the whole local island on throttles.
+    Single-process: degenerates to ``mesh.make_mesh`` over local devices.
+    """
+    if jax.process_count() == 1:
+        from .mesh import make_mesh
+
+        return make_mesh(shape=ici_shape)
+    from jax.experimental import mesh_utils
+
+    local = jax.local_device_count()
+    if ici_shape is None:
+        ici_shape = (1, local)
+    assert ici_shape[0] * ici_shape[1] == local, (
+        f"ici_shape {ici_shape} must factor the {local} local devices"
+    )
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=ici_shape,
+        dcn_mesh_shape=(jax.process_count(), 1),
+        devices=devices or jax.devices(),
+    )
+    return Mesh(dev_array, axis_names=("pods", "throttles"))
+
+
+def shard_global_array(mesh: Mesh, spec: P, local_data: np.ndarray) -> jax.Array:
+    """Assemble a global device array from this process's local shard.
+
+    Single-process: a plain device_put with the NamedSharding.
+    Multi-process: ``local_data`` is this host's slice of the global array
+    (its pod rows / throttle cols), stitched via
+    ``jax.make_array_from_process_local_data`` — no host ever materializes
+    the global tensor.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(np.asarray(local_data), sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(local_data))

@@ -1,0 +1,46 @@
+"""Mesh + sharding-spec helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, shape: Optional[Tuple[int, int]] = None
+) -> Mesh:
+    """2D ("pods", "throttles") mesh over the first n devices.
+
+    Default factorization puts the larger factor on the pods axis (pod count
+    dominates throttle count at every BASELINE config).
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if shape is None:
+        # largest factor pair with pods-major
+        t = 1
+        for cand in range(int(n**0.5), 0, -1):
+            if n % cand == 0:
+                t = cand
+                break
+        shape = (n // t, t)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=("pods", "throttles"))
+
+
+def mesh_shardings(mesh: Mesh):
+    """Named shardings for the step's operand groups:
+
+    returns (pod_sharding [P,...], throttle_sharding [T,...],
+             mask_sharding [P,T], replicated).
+    """
+    return (
+        NamedSharding(mesh, P("pods")),
+        NamedSharding(mesh, P("throttles")),
+        NamedSharding(mesh, P("pods", "throttles")),
+        NamedSharding(mesh, P()),
+    )

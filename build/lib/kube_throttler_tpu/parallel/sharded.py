@@ -1,0 +1,153 @@
+"""The full update step — single-device and mesh-sharded variants.
+
+One "step" is a complete system tick, the analog of a training step for this
+framework: resolve every throttle's time-varying threshold, re-aggregate
+``used`` from the pod set, recompute throttled flags, and classify every
+pod × throttle admission cell — i.e. a full reconcile pass fused with a full
+PreFilter sweep.
+
+``full_update_step`` is the pure single-shard program. ``sharded_full_update``
+wraps it in ``shard_map`` over a ("pods","throttles") mesh: each device owns
+a [P/dp, T/tp] tile of the mask, a P/dp slice of pods, and a T/tp slice of
+throttle state; the only cross-device traffic is
+
+- ``psum`` over the **pods** axis of the used-aggregation partials
+  (each pod shard contributes its masked sums for the local throttle tile);
+- ``psum`` over the **throttles** axis of per-pod class counts
+  (each throttle tile contributes its verdict counts for the local pods).
+
+Both are single-hop ICI all-reduces of [T_loc,R] / [P_loc,4] tiles — no
+[P,T] global tensor ever exists on any device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.aggregate import aggregate_used, throttled_flags
+from ..ops.check import CHECK_ACTIVE, CHECK_INSUFFICIENT, CHECK_POD_EXCEEDS, _classify
+from ..ops.overrides import OverrideSchedule, calculate_thresholds
+from ..ops.schema import PodBatch, ThrottleState
+
+
+def full_update_step(
+    sched: OverrideSchedule,
+    pods: PodBatch,
+    mask: jnp.ndarray,  # bool[P,T]
+    counted: jnp.ndarray,  # bool[P] — running pods that count into used
+    res_cnt: jnp.ndarray,
+    res_cnt_present: jnp.ndarray,
+    res_req: jnp.ndarray,
+    res_req_present: jnp.ndarray,
+    thr_valid: jnp.ndarray,  # bool[T]
+    now_ns: jnp.ndarray,
+    *,
+    on_equal: bool = False,
+    step3_on_equal: bool = True,
+    pod_axis: str | None = None,
+    thr_axis: str | None = None,
+):
+    """One full tick. With ``pod_axis``/``thr_axis`` set (inside shard_map),
+    partial reductions are psum-ed across the mesh.
+
+    Returns (counts int32[P,4], schedulable bool[P],
+             used_cnt int64[T], used_req int64[T,R],
+             st_cnt bool[T], st_req bool[T,R]).
+    """
+    # 1. time-varying thresholds (local throttle tile)
+    thr_cnt, thr_cnt_present, thr_req, thr_req_present = calculate_thresholds(
+        sched, now_ns
+    )
+
+    # 2. used aggregation: local pod-shard partial, then sum across pod shards
+    used_cnt, used_req, contrib = aggregate_used(pods, mask, counted)
+    if pod_axis is not None:
+        used_cnt = jax.lax.psum(used_cnt, pod_axis)
+        used_req = jax.lax.psum(used_req, pod_axis)
+        contrib = jax.lax.psum(contrib, pod_axis)
+    used_cnt_present = used_cnt > 0
+    used_req_present = contrib > 0
+
+    # 3. status.throttled flags (reconcile's onEqual=True compare)
+    st_cnt, st_req, st_req_flag_present = throttled_flags(
+        thr_cnt, thr_cnt_present, thr_req, thr_req_present,
+        used_cnt, used_cnt_present, used_req, used_req_present,
+    )
+
+    # 4. admission classification against the fresh state
+    state = ThrottleState(
+        valid=thr_valid,
+        thr_cnt=thr_cnt,
+        thr_cnt_present=thr_cnt_present,
+        thr_req=thr_req,
+        thr_req_present=thr_req_present,
+        used_cnt=used_cnt,
+        used_cnt_present=used_cnt_present,
+        used_req=used_req,
+        used_req_present=used_req_present,
+        res_cnt=res_cnt,
+        res_cnt_present=res_cnt_present,
+        res_req=res_req,
+        res_req_present=res_req_present,
+        st_cnt_throttled=st_cnt,
+        st_req_throttled=st_req,
+        st_req_flag_present=st_req_flag_present,
+    )
+    statuses = _classify(state, pods, mask, on_equal, step3_on_equal)  # int8[P,T]
+
+    # 5. per-pod verdicts: count classes over the local throttle tile, then
+    # sum across throttle shards
+    counts = jnp.stack(
+        [jnp.sum(statuses == c, axis=1, dtype=jnp.int32) for c in range(4)], axis=1
+    )
+    if thr_axis is not None:
+        counts = jax.lax.psum(counts, thr_axis)
+    schedulable = (
+        counts[:, CHECK_ACTIVE] + counts[:, CHECK_INSUFFICIENT] + counts[:, CHECK_POD_EXCEEDS]
+    ) == 0
+
+    return counts, schedulable, used_cnt, used_req, st_cnt, st_req
+
+
+def sharded_full_update(mesh: Mesh, *, on_equal: bool = False, step3_on_equal: bool = True):
+    """Compile the full step over a ("pods","throttles") mesh via shard_map.
+
+    Input layout: pod-side arrays sharded on "pods", throttle-side (override
+    schedule, reservations, validity) on "throttles", the mask on both.
+    Outputs: per-pod arrays sharded on "pods"; per-throttle on "throttles".
+    """
+    pod_spec = P("pods")
+    thr_spec = P("throttles")
+
+    sched_specs = OverrideSchedule(
+        ov_valid=thr_spec, ov_begin=thr_spec, ov_end=thr_spec,
+        ov_cnt=thr_spec, ov_cnt_present=thr_spec,
+        ov_req=thr_spec, ov_req_present=thr_spec,
+        spec_cnt=thr_spec, spec_cnt_present=thr_spec,
+        spec_req=thr_spec, spec_req_present=thr_spec,
+    )
+    pods_specs = PodBatch(valid=pod_spec, req=pod_spec, req_present=pod_spec)
+
+    def _step(sched, pods, mask, counted, res_cnt, res_cnt_p, res_req, res_req_p, thr_valid, now_ns):
+        return full_update_step(
+            sched, pods, mask, counted,
+            res_cnt, res_cnt_p, res_req, res_req_p, thr_valid, now_ns,
+            on_equal=on_equal, step3_on_equal=step3_on_equal,
+            pod_axis="pods", thr_axis="throttles",
+        )
+
+    mapped = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(
+            sched_specs, pods_specs, P("pods", "throttles"), pod_spec,
+            thr_spec, thr_spec, thr_spec, thr_spec, thr_spec, P(),
+        ),
+        out_specs=(pod_spec, pod_spec, thr_spec, thr_spec, thr_spec, thr_spec),
+    )
+    return jax.jit(mapped)

@@ -1,0 +1,314 @@
+"""HTTP daemon surface: the standalone throttler service.
+
+The reference ships as a plugin living inside kube-scheduler's process (its
+API surface is the scheduler framework + the CRDs on the apiserver). The
+standalone TPU framework exposes the same operations over HTTP so any
+scheduler (or test driver) can use it without embedding Python:
+
+- ``GET  /healthz``                  liveness
+- ``GET  /metrics``                  Prometheus exposition (the 16 families)
+- ``POST /v1/objects``               create-or-update a manifest
+                                     (Pod / Namespace / Throttle / ClusterThrottle)
+- ``DELETE /v1/objects/{kind}/{key}``
+- ``GET  /v1/throttles`` ``/v1/clusterthrottles`` ``/v1/pods``  list + status
+- ``POST /v1/prefilter``             {pod manifest | {"podKey": ...}} → status/reasons
+- ``POST /v1/reserve`` ``/v1/unreserve``
+- ``POST /v1/bind``                  {"podKey", "nodeName"} — scheduler-sim
+                                     convenience: marks the pod scheduled+Running
+
+Handlers are thin wrappers over the plugin's typed clientset + listers
+(the client layer the reference reads/writes through, plugin.go:76-88);
+concurrency is whatever the plugin already guarantees.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import replace
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .api.pod import Namespace
+from .api.serialization import object_from_dict
+from .api.types import ClusterThrottle, Throttle
+from .utils import tracing
+from .engine.store import NotFoundError, Store
+from .plugin import KubeThrottler
+
+
+def _throttle_to_dict(thr) -> dict:
+    out = {
+        "metadata": {"name": thr.name},
+        "status": {
+            "used": thr.status.used.to_dict(),
+            "throttled": thr.status.throttled.to_dict(),
+            "calculatedThreshold": {
+                "threshold": thr.status.calculated_threshold.threshold.to_dict(),
+                "calculatedAt": (
+                    thr.status.calculated_threshold.calculated_at.isoformat()
+                    if thr.status.calculated_threshold.calculated_at
+                    else None
+                ),
+                "messages": list(thr.status.calculated_threshold.messages),
+            },
+        },
+        "spec": {"threshold": thr.spec.threshold.to_dict()},
+    }
+    if isinstance(thr, Throttle):
+        out["metadata"]["namespace"] = thr.namespace
+        out["kind"] = "Throttle"
+    else:
+        out["kind"] = "ClusterThrottle"
+    return out
+
+
+class ThrottlerHTTPServer:
+    def __init__(
+        self,
+        plugin: KubeThrottler,
+        host: str = "127.0.0.1",
+        port: int = 10259,
+        remote: bool = False,
+    ):
+        """``remote=True`` (daemon synced from a real apiserver via
+        reflectors) disables the local object-mutation endpoints: a local
+        write to a reflector-owned kind would be silently reverted by the
+        next watch event — mutate the real cluster instead. Admission
+        endpoints (/v1/prefilter, reserve, unreserve) stay available."""
+        self.plugin = plugin
+        self.remote = remote
+        self.store = plugin.store
+        self.clientset = plugin.clientset
+        self.listers = plugin.listers
+        # serializes get-then-update pod mutations (re-apply, bind): the
+        # handler pool is threaded and a lost update here silently unbinds
+        # a running pod
+        self._pod_write_lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body, content_type="application/json"):
+                data = (
+                    body.encode() if isinstance(body, str) else json.dumps(body).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", "0"))
+                if length == 0:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as e:  # pragma: no cover
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except NotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+            def do_DELETE(self):
+                try:
+                    outer._delete(self)
+                except NotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+            def do_PUT(self):
+                try:
+                    outer._put(self)
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- handlers
+
+    def _put(self, h) -> None:
+        # dynamic verbosity — the scheduler's PUT /debug/flags/v analog
+        # (reference Makefile:94-95: log-level / log-level-debug targets)
+        if h.path == "/debug/flags/v":
+            length = int(h.headers.get("Content-Length", "0"))
+            raw = h.rfile.read(length).decode().strip() if length else ""
+            level = int(raw)
+            prev = tracing.set_verbosity(level)
+            h._send(200, f"successfully set klog.logging.verbosity to {level} (was {prev})",
+                    content_type="text/plain")
+        else:
+            h._send(404, {"error": f"unknown path {h.path}"})
+
+    def _get(self, h) -> None:
+        if h.path == "/healthz":
+            h._send(200, "ok", content_type="text/plain")
+        elif h.path == "/metrics":
+            h._send(
+                200,
+                self.plugin.metrics_registry.exposition(),
+                content_type="text/plain; version=0.0.4",
+            )
+        elif h.path == "/v1/throttles":
+            h._send(200, [_throttle_to_dict(t) for t in self.listers.throttles.list()])
+        elif h.path == "/v1/clusterthrottles":
+            h._send(
+                200, [_throttle_to_dict(t) for t in self.listers.cluster_throttles.list()]
+            )
+        elif h.path == "/v1/pods":
+            h._send(
+                200,
+                [
+                    {
+                        "key": p.key,
+                        "nodeName": p.spec.node_name,
+                        "phase": p.status.phase,
+                        "labels": p.labels,
+                    }
+                    for p in self.listers.pods.list()
+                ],
+            )
+        else:
+            h._send(404, {"error": f"unknown path {h.path}"})
+
+    def _resolve_pod(self, body: dict):
+        if "podKey" in body:
+            namespace, _, name = body["podKey"].partition("/")
+            return self.store.get_pod(namespace, name)
+        pod = object_from_dict(body)
+        return pod
+
+    _REMOTE_REFUSAL = (
+        "this daemon mirrors a remote apiserver (kubeconfig mode); local "
+        "object writes would be reverted by the watch stream — mutate the "
+        "objects on the cluster instead"
+    )
+
+    def _post(self, h) -> None:
+        body = h._body()
+        if self.remote and h.path in ("/v1/objects", "/v1/bind"):
+            h._send(409, {"error": self._REMOTE_REFUSAL})
+            return
+        if h.path == "/v1/objects":
+            kind = body.get("kind", "")
+            core = self.clientset.core_v1()
+            schedule = self.clientset.schedule_v1alpha1()
+            if kind == "Namespace":
+                ns = Namespace(
+                    name=body["metadata"]["name"],
+                    labels=dict(body["metadata"].get("labels") or {}),
+                )
+                try:
+                    core.namespaces().create(ns)
+                except ValueError:
+                    core.namespaces().update(ns)
+                h._send(200, {"applied": f"namespace/{ns.name}"})
+                return
+            obj = object_from_dict(body)
+            try:
+                if kind == "Pod":
+                    core.pods(obj.namespace).create(obj)
+                elif kind == "Throttle":
+                    schedule.throttles(obj.namespace).create(obj)
+                else:
+                    schedule.cluster_throttles().create(obj)
+            except ValueError:
+                if kind == "Pod":
+                    # a manifest re-apply must not clobber server-owned state:
+                    # nodeName (set by bind) and phase live on the stored pod
+                    with self._pod_write_lock:
+                        current = core.pods(obj.namespace).get(obj.name)
+                        if not obj.spec.node_name:
+                            obj = replace(obj, spec=replace(obj.spec, node_name=current.spec.node_name))
+                        if "status" not in body:
+                            obj = replace(obj, status=replace(current.status))
+                        core.pods(obj.namespace).update(obj)
+                elif kind == "Throttle":
+                    # the clientset's update has main-resource semantics: the
+                    # stored status is preserved (status subresource)
+                    schedule.throttles(obj.namespace).update(obj)
+                else:
+                    schedule.cluster_throttles().update(obj)
+            h._send(200, {"applied": getattr(obj, "key", obj.name)})
+        elif h.path == "/v1/prefilter":
+            pod = self._resolve_pod(body)
+            status = self.plugin.pre_filter(pod)
+            h._send(
+                200,
+                {"code": status.code.value, "reasons": list(status.reasons)},
+            )
+        elif h.path == "/v1/prefilter-batch":
+            h._send(200, self.plugin.pre_filter_batch())
+        elif h.path == "/v1/reserve":
+            pod = self._resolve_pod(body)
+            status = self.plugin.reserve(pod)
+            h._send(200, {"code": status.code.value, "reasons": list(status.reasons)})
+        elif h.path == "/v1/unreserve":
+            pod = self._resolve_pod(body)
+            self.plugin.unreserve(pod)
+            h._send(200, {"code": "Success"})
+        elif h.path == "/v1/bind":
+            namespace, _, name = body["podKey"].partition("/")
+            with self._pod_write_lock:
+                pod = self.store.get_pod(namespace, name)
+                # replace status as a fresh object: dataclasses.replace is
+                # shallow and mutating pod.status in place would alias the
+                # store's live object outside its lock
+                bound = replace(
+                    pod,
+                    spec=replace(pod.spec, node_name=body.get("nodeName", "node-1")),
+                    status=replace(pod.status, phase="Running"),
+                )
+                self.store.update_pod(bound)
+            h._send(200, {"bound": pod.key})
+        else:
+            h._send(404, {"error": f"unknown path {h.path}"})
+
+    def _delete(self, h) -> None:
+        if self.remote:
+            h._send(409, {"error": self._REMOTE_REFUSAL})
+            return
+        parts = h.path.strip("/").split("/")
+        if len(parts) < 3 or parts[0] != "v1" or parts[1] != "objects":
+            h._send(404, {"error": f"unknown path {h.path}"})
+            return
+        kind = parts[2]
+        key = "/".join(parts[3:])
+        if kind == "pods":
+            namespace, _, name = key.partition("/")
+            self.clientset.core_v1().pods(namespace).delete(name)
+        elif kind == "throttles":
+            namespace, _, name = key.partition("/")
+            self.clientset.schedule_v1alpha1().throttles(namespace).delete(name)
+        elif kind == "clusterthrottles":
+            self.clientset.schedule_v1alpha1().cluster_throttles().delete(key)
+        else:
+            h._send(404, {"error": f"unknown kind {kind}"})
+            return
+        h._send(200, {"deleted": f"{kind}/{key}"})
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()  # release the listening socket fd
+        if self._thread:
+            self._thread.join(timeout=2)

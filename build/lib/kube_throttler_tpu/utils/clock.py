@@ -1,0 +1,61 @@
+"""Injectable clock (the reference threads k8s.io/utils/clock through its
+controllers for exactly this reason — deterministic override-boundary tests,
+plugin.go:97/109)."""
+
+from __future__ import annotations
+
+import threading
+from datetime import datetime, timedelta, timezone
+
+
+class Clock:
+    def now(self) -> datetime:  # pragma: no cover — interface
+        raise NotImplementedError
+
+    def subscribe(self, callback) -> None:
+        """Register a zero-arg callback fired when the clock jumps (FakeClock
+        advance/set). Real time never jumps, so the default is a no-op —
+        deadline waiters compute exact timeouts instead of polling."""
+
+
+class RealClock(Clock):
+    def now(self) -> datetime:
+        return datetime.now(timezone.utc)
+
+
+class FakeClock(Clock):
+    """Settable clock for tests; ``advance`` wakes subscribed waiters."""
+
+    def __init__(self, start: datetime):
+        self._now = start
+        self._cond = threading.Condition()
+        self._listeners = []
+
+    def now(self) -> datetime:
+        with self._cond:
+            return self._now
+
+    def subscribe(self, callback) -> None:
+        with self._cond:
+            self._listeners.append(callback)
+
+    def _notify(self) -> None:
+        # listeners run OUTSIDE the clock lock: a listener typically takes
+        # its own lock (e.g. the workqueue condition) whose holders call
+        # back into now() — calling under the clock lock would be an
+        # ABBA deadlock
+        with self._cond:
+            self._cond.notify_all()
+            listeners = list(self._listeners)
+        for cb in listeners:
+            cb()
+
+    def advance(self, delta: timedelta) -> None:
+        with self._cond:
+            self._now += delta
+        self._notify()
+
+    def set(self, t: datetime) -> None:
+        with self._cond:
+            self._now = t
+        self._notify()

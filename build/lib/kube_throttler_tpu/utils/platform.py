@@ -1,0 +1,22 @@
+"""JAX platform-selection helper.
+
+This environment's sitecustomize registers the tunnel TPU backend and sets
+``jax_platforms`` programmatically at interpreter start, which OVERRIDES the
+``JAX_PLATFORMS`` env var. Any entrypoint that wants an operator's explicit
+``JAX_PLATFORMS=cpu`` (e.g. when the tunnel is down) to actually take effect
+must re-assert it through the config API before the first backend init.
+"""
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Re-assert the JAX_PLATFORMS env var through ``jax.config``.
+
+    No-op when the env var is unset (the ambient platform selection stands)
+    or when a backend is already initialized (too late to change).
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

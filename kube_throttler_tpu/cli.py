@@ -82,7 +82,9 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument(
         "--lock-file",
         default="",
-        help="leadership lease path (default /tmp/kube-throttler-tpu-<name>.lock)",
+        help="flock leadership lease path (default: a 0700 per-user runtime "
+        "dir; with --kubeconfig leader election uses a Lease object on the "
+        "apiserver instead — multi-host capable)",
     )
     serve.add_argument(
         "--nodes",
@@ -155,11 +157,39 @@ def main(argv: Optional[list] = None) -> int:
 
     elector = None
     if leader_elect:
-        from .utils.leaderelect import FileLeaseElector
+        if plugin_args.kubeconfig and not args.lock_file:
+            # multi-host: a coordination.k8s.io Lease on the shared
+            # apiserver — replicas on different hosts compete for it, like
+            # the reference's embedded kube-scheduler leader election
+            import os as _os
+            import socket
 
-        lock_path = args.lock_file or f"/tmp/kube-throttler-tpu-{plugin_args.name}.lock"
-        elector = FileLeaseElector(lock_path)
-        print(f"leader election on {lock_path}: waiting for lease...", flush=True)
+            from .client.transport import ApiClient, parse_kubeconfig
+            from .utils.leaderelect import HttpLeaseElector
+
+            def _leadership_lost():
+                # fail fast like the embedded kube-scheduler: a demoted
+                # leader must stop serving (a standby has taken over)
+                print("leadership lost; shutting down", file=sys.stderr, flush=True)
+                stop.set()
+
+            elector = HttpLeaseElector(
+                ApiClient(parse_kubeconfig(plugin_args.kubeconfig)),
+                name=f"kube-throttler-tpu-{plugin_args.name}",
+                identity=f"{socket.gethostname()}-{_os.getpid()}",
+                on_lost=_leadership_lost,
+            )
+            print(
+                f"leader election on Lease kube-throttler-tpu-{plugin_args.name}: "
+                "waiting...",
+                flush=True,
+            )
+        else:
+            from .utils.leaderelect import FileLeaseElector, default_lease_path
+
+            lock_path = args.lock_file or default_lease_path(plugin_args.name)
+            elector = FileLeaseElector(lock_path)
+            print(f"leader election on {lock_path}: waiting for lease...", flush=True)
         try:
             if not elector.acquire(stop):
                 return 0  # interrupted while standing by

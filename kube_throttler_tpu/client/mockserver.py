@@ -55,6 +55,13 @@ _STATUS_RE = re.compile(
     rf"/(?P<name>[^/]+)/status$"
 )
 
+_LEASE_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/(?P<ns>[^/]+)/leases/(?P<name>[^/]+)$"
+)
+_LEASE_COLLECTION_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/(?P<ns>[^/]+)/leases$"
+)
+
 
 class MockApiServer:
     """In-process apiserver double. ``start()`` binds an ephemeral port;
@@ -86,6 +93,10 @@ class MockApiServer:
         self._shutdown = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # coordination.k8s.io Lease objects (leader election): (ns, name) →
+        # (doc, rv); versioned off their own counter under self._lock
+        self._leases: Dict[Tuple[str, str], Tuple[Dict[str, Any], int]] = {}
+        self._lease_rv = 0
         for kind in COLLECTION_PATHS:
             self.store.add_event_handler(kind, self._make_recorder(kind), replay=False)
 
@@ -139,11 +150,22 @@ class MockApiServer:
                 self._send_json(401, {"message": "unauthorized"})
                 return False
 
+            def _json_body(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    return json.loads(self.rfile.read(length)) if length else {}
+                except json.JSONDecodeError:
+                    self._send_json(400, {"message": "invalid JSON"})
+                    return None
+
             def do_GET(self):
                 if not self._authorized():
                     return
                 split = urlsplit(self.path)
                 query = parse_qs(split.query)
+                if _LEASE_RE.match(split.path):
+                    server._serve_lease(self, "GET", split.path, None)
+                    return
                 kind = next(
                     (k for k, p in COLLECTION_PATHS.items() if p == split.path), None
                 )
@@ -155,14 +177,33 @@ class MockApiServer:
                 else:
                     server._serve_list(self, kind)
 
+            def do_POST(self):
+                if not self._authorized():
+                    return
+                body = self._json_body()
+                if body is None:
+                    return
+                path = urlsplit(self.path).path
+                if _LEASE_COLLECTION_RE.match(path):
+                    # create is POST to the collection, like the real
+                    # apiserver; the object name comes from the body
+                    server._serve_lease(self, "POST", path, body)
+                elif _LEASE_RE.match(path):
+                    self._send_json(
+                        405, {"message": "POST to a named resource; use the collection"}
+                    )
+                else:
+                    self._send_json(404, {"message": f"no route {path}"})
+
             def do_PUT(self):
                 if not self._authorized():
                     return
-                length = int(self.headers.get("Content-Length", 0))
-                try:
-                    body = json.loads(self.rfile.read(length)) if length else {}
-                except json.JSONDecodeError:
-                    self._send_json(400, {"message": "invalid JSON"})
+                body = self._json_body()
+                if body is None:
+                    return
+                path = urlsplit(self.path).path
+                if _LEASE_RE.match(path):
+                    server._serve_lease(self, "PUT", path, body)
                     return
                 server._serve_status_put(self, self.path, body)
 
@@ -315,6 +356,68 @@ class MockApiServer:
                     self._watchers[kind].remove(q)
                 except ValueError:
                     pass
+
+    def _serve_lease(
+        self, handler, verb: str, path: str, body: Optional[Dict[str, Any]]
+    ) -> None:
+        """coordination.k8s.io Lease object: GET / POST(create) /
+        PUT(update, optimistic via metadata.resourceVersion) — the three
+        verbs client-go leader election needs. POST takes the collection
+        path (name from body.metadata); GET/PUT take the named path."""
+        if verb == "POST":
+            m = _LEASE_COLLECTION_RE.match(path)
+            name = str(((body or {}).get("metadata") or {}).get("name", ""))
+            if not name:
+                handler._send_json(400, {"message": "lease body missing metadata.name"})
+                return
+            key = (m.group("ns"), name)
+        else:
+            m = _LEASE_RE.match(path)
+            key = (m.group("ns"), m.group("name"))
+        with self._lock:
+            existing = self._leases.get(key)
+            if verb == "GET":
+                if existing is None:
+                    handler._send_json(404, {"message": f"lease {key} not found"})
+                    return
+                doc, rv = existing
+                out = dict(doc)
+                out["metadata"] = {**(doc.get("metadata") or {}), "resourceVersion": str(rv)}
+                handler._send_json(200, out)
+                return
+            if verb == "POST":
+                if existing is not None:
+                    handler._send_json(409, {"message": f"lease {key} exists"})
+                    return
+                self._lease_rv += 1
+                self._leases[key] = (body, self._lease_rv)
+                out = dict(body)
+                out["metadata"] = {
+                    **(body.get("metadata") or {}),
+                    "resourceVersion": str(self._lease_rv),
+                }
+                handler._send_json(201, out)
+                return
+            # PUT
+            if existing is None:
+                handler._send_json(404, {"message": f"lease {key} not found"})
+                return
+            _, current_rv = existing
+            rv_raw = str((body.get("metadata") or {}).get("resourceVersion", "") or "")
+            if rv_raw and rv_raw != str(current_rv):
+                handler._send_json(
+                    409,
+                    {"message": f"lease {key}: resourceVersion conflict"},
+                )
+                return
+            self._lease_rv += 1
+            self._leases[key] = (body, self._lease_rv)
+            out = dict(body)
+            out["metadata"] = {
+                **(body.get("metadata") or {}),
+                "resourceVersion": str(self._lease_rv),
+            }
+            handler._send_json(200, out)
 
     def _serve_status_put(self, handler, path: str, body: Dict[str, Any]) -> None:
         m = _STATUS_RE.match(urlsplit(path).path)

@@ -43,14 +43,16 @@ from http.client import HTTPConnection, HTTPSConnection
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
 
-from ..api.serialization import object_from_dict, object_to_dict
+from ..api.serialization import (
+    API_GROUP as GROUP,
+    VERSION,
+    object_from_dict,
+    object_to_dict,
+)
 from ..api.types import ClusterThrottle, Throttle
 from ..engine.store import ConflictError, NotFoundError, Store, key_of
 
 logger = logging.getLogger(__name__)
-
-GROUP = "schedule.k8s.everpeace.github.com"
-VERSION = "v1alpha1"
 
 # collection paths per kind (cluster-wide list+watch, like the reference's
 # cluster-scoped informer factories)
@@ -261,10 +263,18 @@ class ApiClient:
         finally:
             conn.close()
 
+    def get(self, path: str) -> Dict[str, Any]:
+        """GET a JSON document; 404 raises NotFoundError."""
+        return self._request("GET", path)
+
+    def post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST (create) a JSON document; 409 raises ConflictError."""
+        return self._request("POST", path, body=body)
+
     def put(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
-        """PUT a JSON document (status-subresource writes). The body must
-        carry ``metadata.resourceVersion`` for optimistic concurrency; 409
-        raises ConflictError."""
+        """PUT a JSON document (status-subresource / lease writes). The body
+        must carry ``metadata.resourceVersion`` for optimistic concurrency;
+        409 raises ConflictError."""
         return self._request("PUT", path, body=body)
 
 

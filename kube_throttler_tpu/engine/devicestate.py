@@ -474,56 +474,80 @@ class _KindState:
             self._counted_dirty = False
         return self._counted_device
 
-    def flush_agg(self) -> None:
-        """Land all pending aggregate maintenance on device: col rebases and
-        the pod-delta burst each cost ONE dispatch (apply_pod_deltas_batched /
-        rebase_cols); a full rebase is one masked aggregate_used reduction."""
-        from ..ops.aggregate import aggregate_used, apply_pod_deltas_batched, rebase_cols
-
+    def steal_agg_work(self) -> dict:
+        """Under the MAIN lock: capture everything the aggregate flush needs
+        (immutable device handles + the staged delta/rebase markers) and
+        reset the staging, so the dispatch itself can run outside the main
+        lock (under the per-kind agg lock) without blocking check readers."""
         self.ensure_capacity()
         pods, mask = self.device_pods()
         counted = self._device_counted()
+        work = {
+            "pods": pods,
+            "mask": mask,
+            "counted": counted,
+            "full": self._agg_full_rebase,
+            "rebase_cols": self._agg_rebase_cols,
+            "pending": self._agg_pending,
+            "tcap": self.tcap,
+            "R": self.R,
+        }
+        self._agg_full_rebase = False
+        self._agg_rebase_cols = set()
+        self._agg_pending = []
+        return work
+
+    def apply_agg_work(self, work: dict) -> None:
+        """Land stolen aggregate maintenance on device: col rebases and the
+        pod-delta burst each cost ONE dispatch (apply_pod_deltas_batched /
+        rebase_cols); a full rebase is one masked aggregate_used reduction.
+
+        Caller holds the per-kind agg lock (NOT the main lock): ``agg_*``
+        are only ever touched under it, and consecutive flushes are
+        serialized steal-to-apply so an older snapshot can never overwrite
+        a newer one."""
+        from ..ops.aggregate import aggregate_used, apply_pod_deltas_batched, rebase_cols
+
+        pods, mask, counted = work["pods"], work["mask"], work["counted"]
+        tcap, R = work["tcap"], work["R"]
         shapes_ok = (
             self.agg_cnt is not None
-            and self.agg_cnt.shape == (self.tcap,)
-            and self.agg_req.shape == (self.tcap, self.R)
+            and self.agg_cnt.shape == (tcap,)
+            and self.agg_req.shape == (tcap, R)
         )
-        if self._agg_full_rebase or not shapes_ok:
+        if work["full"] or not shapes_ok:
             self.agg_cnt, self.agg_req, self.agg_contrib = aggregate_used(
                 pods, mask, counted
             )
-            self._agg_full_rebase = False
-            self._agg_pending.clear()
-            self._agg_rebase_cols.clear()
             return
-        if self._agg_rebase_cols:
+        pending = work["pending"]
+        if work["rebase_cols"]:
             # deltas targeting a rebased column are subsumed by the rebase
             # (it reads current state) — drop them or they double-count
-            rb = self._agg_rebase_cols
+            rb = work["rebase_cols"]
             kept = []
-            for cols, sign, req, present in self._agg_pending:
+            for cols, sign, req, present in pending:
                 cols_kept = cols[~np.isin(cols, list(rb))]
                 if cols_kept.size:
                     kept.append((cols_kept, sign, req, present))
-            self._agg_pending = kept
+            pending = kept
             arr = np.fromiter(rb, dtype=np.int32, count=len(rb))
             k = self._bucket(arr.size)
-            cols_pad = np.full(k, self.tcap, dtype=np.int32)
+            cols_pad = np.full(k, tcap, dtype=np.int32)
             cols_pad[: arr.size] = arr
             self.agg_cnt, self.agg_req, self.agg_contrib = rebase_cols(
                 self.agg_cnt, self.agg_req, self.agg_contrib,
                 pods, mask, counted, cols_pad,
             )
-            self._agg_rebase_cols.clear()
-        if self._agg_pending:
-            n = len(self._agg_pending)
-            kmax = self._bucket(max(c.size for c, _, _, _ in self._agg_pending), lo=4)
+        if pending:
+            n = len(pending)
+            kmax = self._bucket(max(c.size for c, _, _, _ in pending), lo=4)
             nb = self._bucket(n)
-            ids = np.full((nb, kmax), self.tcap, dtype=np.int32)
+            ids = np.full((nb, kmax), tcap, dtype=np.int32)
             signs = np.zeros((nb, kmax), dtype=np.int64)
-            reqs = np.zeros((nb, self.R), dtype=np.int64)
-            presents = np.zeros((nb, self.R), dtype=bool)
-            for i, (cols, sign, req, present) in enumerate(self._agg_pending):
+            reqs = np.zeros((nb, R), dtype=np.int64)
+            presents = np.zeros((nb, R), dtype=bool)
+            for i, (cols, sign, req, present) in enumerate(pending):
                 ids[i, : cols.size] = cols
                 signs[i, : cols.size] = sign
                 reqs[i, : req.shape[0]] = req  # pad if R grew since capture
@@ -531,7 +555,12 @@ class _KindState:
             self.agg_cnt, self.agg_req, self.agg_contrib = apply_pod_deltas_batched(
                 self.agg_cnt, self.agg_req, self.agg_contrib, ids, signs, reqs, presents
             )
-            self._agg_pending.clear()
+
+    def flush_agg(self) -> None:
+        """Single-threaded convenience (tests): steal + apply in one go.
+        Production goes through DeviceStateManager.aggregate_used_for, which
+        splits the phases across the two locks."""
+        self.apply_agg_work(self.steal_agg_work())
 
 
 class DeviceStateManager:
@@ -555,6 +584,13 @@ class DeviceStateManager:
         self.indexed_check_max = 1024
         self.throttle = _KindState("throttle", self.dims)
         self.clusterthrottle = _KindState("clusterthrottle", self.dims)
+        # per-kind aggregate-flush locks: agg_* arrays are touched only
+        # under these, so the reconcile's device dispatches never hold the
+        # main lock (lock order: agg → main; nothing takes main → agg)
+        self._agg_locks = {
+            "throttle": threading.Lock(),
+            "clusterthrottle": threading.Lock(),
+        }
 
         store.add_event_handler("Namespace", self._on_namespace)
         store.add_event_handler("Pod", self._on_pod)
@@ -662,90 +698,132 @@ class DeviceStateManager:
         (hence one call, one lock hold): deriving it later would unreserve a
         pod that got counted AFTER the flush, whose contribution is not in
         the status about to be written — reopening the double-count window
-        the reserve-until-observed handshake exists to close."""
+        the reserve-until-observed handshake exists to close.
+
+        Locking: the MAIN lock is held only for the host-side snapshot
+        (steal of staged aggregate work + the unreserve walk, one coherent
+        point); the flush dispatches and the blocking device→host gather run
+        under the per-kind AGG lock / no lock, so concurrent check_pod
+        readers never queue behind the reconcile's device work — the moral
+        of the reference's RWMutex split (reserved_resource_amounts.go:154)."""
         import jax
 
         from ..quantity import from_milli
 
         reserved = reserved or {}
-        with self._lock:
-            ks = self._kind(kind)
-            ks.flush_agg()
-            out: Dict[str, Tuple[ResourceAmount, List[Pod]]] = {}
-            cols: List[int] = []
-            valid_keys: List[str] = []
-            for key in keys:
-                unres: List[Pod] = []
-                col = ks.index.throttle_col(key)
-                if col is not None:
-                    for pod_key in reserved.get(key, ()):
-                        row = ks.index.pod_row(pod_key)
-                        if row is None:
-                            continue
-                        if ks.count_in[row] and ks.index.mask[row, col]:
-                            pod = ks.index.indexed_pod(pod_key)
-                            if pod is not None:
-                                unres.append(pod)
-                if col is None:
-                    # zero counted pods: both fields stay nil (the Go
-                    # accumulator never materializes on an empty sum)
-                    out[key] = (ResourceAmount(), unres)
-                else:
-                    out[key] = (ResourceAmount(), unres)  # used filled below
-                    cols.append(col)
-                    valid_keys.append(key)
+        ks = self._kind(kind)
+        # the agg lock is held steal→apply so two concurrent reconcile
+        # batches cannot apply an older snapshot over a newer one
+        with self._agg_locks[kind]:
+            with self._lock:
+                work = ks.steal_agg_work()
+                out: Dict[str, Tuple[ResourceAmount, List[Pod]]] = {}
+                cols: List[int] = []
+                valid_keys: List[str] = []
+                for key in keys:
+                    unres: List[Pod] = []
+                    col = ks.index.throttle_col(key)
+                    if col is not None:
+                        for pod_key in reserved.get(key, ()):
+                            row = ks.index.pod_row(pod_key)
+                            if row is None:
+                                continue
+                            if ks.count_in[row] and ks.index.mask[row, col]:
+                                pod = ks.index.indexed_pod(pod_key)
+                                if pod is not None:
+                                    unres.append(pod)
+                    if col is None:
+                        # zero counted pods: both fields stay nil (the Go
+                        # accumulator never materializes on an empty sum)
+                        out[key] = (ResourceAmount(), unres)
+                    else:
+                        out[key] = (ResourceAmount(), unres)  # used filled below
+                        cols.append(col)
+                        valid_keys.append(key)
+            try:
+                ks.apply_agg_work(work)
+            except Exception:
+                with self._lock:
+                    ks.mark_full_rebase()  # stolen state was consumed; recover
+                raise
             if not cols:
                 return out
-            idx = jnp.asarray(np.asarray(cols, dtype=np.int32))
-            cnt, req, ctb = jax.device_get(
-                (ks.agg_cnt[idx], ks.agg_req[idx], ks.agg_contrib[idx])
+            # immutable post-flush handles: a later flush replaces them
+            # functionally, so the gather below still reads this snapshot
+            agg_cnt, agg_req, agg_contrib = ks.agg_cnt, ks.agg_req, ks.agg_contrib
+
+        idx = jnp.asarray(np.asarray(cols, dtype=np.int32))
+        cnt, req, ctb = jax.device_get(
+            (agg_cnt[idx], agg_req[idx], agg_contrib[idx])
+        )
+        names = self.dims.names
+        for i, key in enumerate(valid_keys):
+            if cnt[i] <= 0:
+                continue  # stays the nil ResourceAmount
+            requests = {
+                names[j]: from_milli(int(req[i, j]))
+                for j in range(min(len(names), req.shape[1]))
+                if ctb[i, j] > 0
+            }
+            out[key] = (
+                ResourceAmount(resource_counts=int(cnt[i]), resource_requests=requests),
+                out[key][1],
             )
-            names = self.dims.names
-            for i, key in enumerate(valid_keys):
-                if cnt[i] <= 0:
-                    continue  # stays the nil ResourceAmount
-                requests = {
-                    names[j]: from_milli(int(req[i, j]))
-                    for j in range(min(len(names), req.shape[1]))
-                    if ctb[i, j] > 0
-                }
-                out[key] = (
-                    ResourceAmount(resource_counts=int(cnt[i]), resource_requests=requests),
-                    out[key][1],
-                )
-            return out
+        return out
 
     # -- queries ----------------------------------------------------------
 
     def check_pod(self, pod: Pod, kind: str, on_equal: bool = False) -> Dict[str, str]:
         """Single-pod check → {throttle_key: status_name} over affected
-        throttles. The device kernel sees a 1-row pod batch + its mask row."""
-        with self.tracer.trace("device_check"), self._lock:
-            ks = self.throttle if kind == "throttle" else self.clusterthrottle
-            ks.ensure_capacity()
-            row_req = np.zeros((1, ks.R), dtype=np.int64)
-            row_present = np.zeros((1, ks.R), dtype=bool)
-            row_req, row_present = ks.encode_pod_requests_into(row_req, row_present, 0, pod)
-            prow = ks.index.pod_row(pod.key)
-            if prow is not None:
-                mask_row = ks.index.mask[prow : prow + 1, :].copy()
-            else:
-                # pod not (yet) in the store: compute its mask row on the fly
-                mask_row = np.zeros((1, ks.tcap), dtype=bool)
-                for key in ks.index._thr_cols:  # noqa: SLF001 — same-package access
-                    col = ks.index.throttle_col(key)
-                    thr = ks.index._col_thrs[col]
-                    mask_row[0, col] = ks.index._match_one(thr, pod)
+        throttles. The device kernel sees a 1-row pod batch + its mask row.
 
-            step3 = True if kind == "throttle" else on_equal
-            cols = np.nonzero(mask_row[0])[0]
-            if cols.size <= self.indexed_check_max:
+        Concurrency: the lock guards only the HOST-side snapshot (request
+        encode, mask row copy, device-handle grab, key decode tables); the
+        kernel dispatch + blocking device read — the dominant cost — run
+        outside it. The device caches are replaced functionally (``.at[]``
+        scatters / wholesale re-uploads build NEW arrays), so a grabbed
+        handle is an immutable point-in-time snapshot and concurrent
+        checkers don't queue behind each other or behind writers — the
+        intent of the reference's RWMutex + keymutex split
+        (reserved_resource_amounts.go:154-170)."""
+        from ..ops.fastcheck import fast_check_pod_packed
+
+        with self.tracer.trace("device_check"):
+            dense = None
+            with self._lock:
+                ks = self.throttle if kind == "throttle" else self.clusterthrottle
+                ks.ensure_capacity()
+                row_req = np.zeros((1, ks.R), dtype=np.int64)
+                row_present = np.zeros((1, ks.R), dtype=bool)
+                row_req, row_present = ks.encode_pod_requests_into(
+                    row_req, row_present, 0, pod
+                )
+                prow = ks.index.pod_row(pod.key)
+                if prow is not None:
+                    mask_row = ks.index.mask[prow : prow + 1, :].copy()
+                else:
+                    # pod not (yet) in the store — the PreFilter common case:
+                    # evaluate its row via the index's compiled columns
+                    # (native C++ row-match; NOT a Python loop over T)
+                    with ks.index._lock:  # noqa: SLF001 — same-package access
+                        row = ks.index._match_row_arbitrary(pod) & ks.index._thr_valid
+                    mask_row = np.zeros((1, ks.tcap), dtype=bool)
+                    mask_row[0, : row.shape[0]] = row[: ks.tcap]
+
+                step3 = True if kind == "throttle" else on_equal
+                cols = np.nonzero(mask_row[0])[0]
+                if cols.size <= self.indexed_check_max:
+                    packed = ks.device_packed()
+                    col_keys = [ks.index._col_thrs[int(c)].key for c in cols]
+                else:
+                    dense = (ks.device_state(), dict(ks.index._thr_cols))
+
+            # ---- outside the lock: dispatch + blocking read + decode ----
+            if dense is None:
                 # hot path: classify only the K affected rows against the
                 # cached packed precomp, and extract results from those K
                 # slots alone — O(K·R) device AND host work, independent of
                 # tcap. K buckets (powers of two) bound recompilation.
-                from ..ops.fastcheck import fast_check_pod_packed
-
                 k = 8
                 while k < cols.size:
                     k *= 2
@@ -755,39 +833,66 @@ class DeviceStateManager:
                 idx_valid[: cols.size] = True
                 out_k = np.asarray(
                     fast_check_pod_packed(
-                        ks.device_packed(), row_req[0], row_present[0],
+                        packed, row_req[0], row_present[0],
                         idx, idx_valid, on_equal, step3,
                     )
                 )
                 result = {}
-                for slot, col in enumerate(cols):
+                for slot, key in enumerate(col_keys):
                     status = int(out_k[slot])
                     if status != CHECK_NOT_AFFECTED:
-                        result[ks.index._col_thrs[int(col)].key] = STATUS_NAMES[status]
+                        result[key] = STATUS_NAMES[status]
                 return result
+            state, thr_cols = dense
             batch = PodBatch(
                 valid=np.ones(1, dtype=bool), req=row_req, req_present=row_present
             )
-            state = ks.device_state()
             out = np.asarray(
                 check_pods(state, batch, mask_row, on_equal=on_equal, step3_on_equal=step3)
             )[0]
             result = {}
-            for key, col in ks.index._thr_cols.items():
+            for key, col in thr_cols.items():
                 if out[col] != CHECK_NOT_AFFECTED:
                     result[key] = STATUS_NAMES[int(out[col])]
             return result
 
+    def _grab_batch_handles(self, kind: str, on_equal: bool):
+        """Under the caller's lock: one kind's immutable device handles +
+        decode table for a batch check."""
+        ks = self.throttle if kind == "throttle" else self.clusterthrottle
+        state = ks.device_state()
+        pods, mask = ks.device_pods()
+        step3 = True if kind == "throttle" else on_equal
+        return state, pods, mask, step3, dict(ks.index._pod_rows)
+
     def check_batch(self, kind: str, on_equal: bool = False):
         """All stored pods vs all stored throttles (bench / bulk admission).
-        Returns (counts int32[P,4], schedulable bool[P], row→pod-key map)."""
+        Returns (counts int32[P,4], schedulable bool[P], row→pod-key map).
+        Handle grab under the lock; kernel dispatch outside (see check_pod)."""
         with self._lock:
-            ks = self.throttle if kind == "throttle" else self.clusterthrottle
-            state = ks.device_state()
-            pods, mask = ks.device_pods()
-            step3 = True if kind == "throttle" else on_equal
+            state, pods, mask, step3, row_map = self._grab_batch_handles(kind, on_equal)
+        counts, schedulable = check_pods_compact(
+            state, pods, mask, on_equal=on_equal, step3_on_equal=step3
+        )
+        return counts, schedulable, row_map
+
+    def check_batch_all(self, on_equal: bool = False):
+        """Both kinds' batch checks against ONE coherent device snapshot:
+        a single lock hold grabs both kinds' handles, so the composed
+        verdict corresponds to one point in the event stream (previously
+        pre_filter_batch composed two separately-locked snapshots — a
+        concurrent store event between them could yield a verdict matching
+        no single point in time). Returns {kind: (counts, schedulable,
+        row_map)}."""
+        with self._lock:
+            handles = {
+                kind: self._grab_batch_handles(kind, on_equal)
+                for kind in ("throttle", "clusterthrottle")
+            }
+        out = {}
+        for kind, (state, pods, mask, step3, row_map) in handles.items():
             counts, schedulable = check_pods_compact(
                 state, pods, mask, on_equal=on_equal, step3_on_equal=step3
             )
-            row_map = dict(ks.index._pod_rows)
-            return counts, schedulable, row_map
+            out[kind] = (counts, schedulable, row_map)
+        return out

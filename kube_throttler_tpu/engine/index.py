@@ -102,6 +102,12 @@ class SelectorIndex:
         # pods
         self._pod_rows: Dict[str, int] = {}
         self._row_pods: Dict[int, Pod] = {}
+        # previous (object, mask-row) per row: lets the MODIFIED handler's
+        # old-side affected query reuse the row the index JUST replaced
+        # instead of re-evaluating T columns; invalidated wholesale on any
+        # column/namespace change (the cache must never outlive compiled
+        # columns it was computed against)
+        self._row_prev: Dict[int, Tuple[Pod, np.ndarray]] = {}
         self._free_rows: List[int] = []
         self._pcap = pod_capacity
         self._pod_valid = np.zeros(self._pcap, dtype=bool)
@@ -166,6 +172,9 @@ class SelectorIndex:
                     while row >= self._pcap:
                         self._grow_pods()
                 self._pod_rows[pod.key] = row
+            prev = self._row_pods.get(row)
+            if prev is not None and prev is not pod:
+                self._row_prev[row] = (prev, self.mask[row, : self._tcap].copy())
             self._row_pods[row] = pod
             self._pod_valid[row] = True
             self._pod_ns[row] = self._ns_ids.id_of(pod.namespace)
@@ -198,6 +207,7 @@ class SelectorIndex:
             if row is None:
                 return
             self._row_pods.pop(row, None)
+            self._row_prev.pop(row, None)
             self._pod_valid[row] = False
             self.mask[row, :] = False
             self._free_rows.append(row)
@@ -218,6 +228,7 @@ class SelectorIndex:
                 self._thr_cols[key] = col
             self._col_thrs[col] = thr
             self._thr_valid[col] = True
+            self._row_prev.clear()  # compiled columns changed
             if self._native is not None:
                 self._native_sync_col(col, thr)
             self._recompute_col(col)
@@ -242,6 +253,7 @@ class SelectorIndex:
                 return
             self._col_thrs.pop(col, None)
             self._thr_valid[col] = False
+            self._row_prev.clear()  # compiled columns changed
             self.mask[:, col] = False
             self._free_cols.append(col)
             if self._native is not None:
@@ -255,6 +267,7 @@ class SelectorIndex:
         with self._lock:
             self._namespaces[ns.name] = ns
             self._ns_label_ids.pop(ns.name, None)
+            self._row_prev.clear()  # ns labels feed clusterthrottle matches
             if self.kind != "clusterthrottle":
                 return
             ns_id = self._ns_ids.id_of(ns.name)
@@ -418,7 +431,13 @@ class SelectorIndex:
             if row is not None and self._row_pods.get(row) is pod:
                 cols = np.nonzero(self.mask[row, : self._tcap])[0]
             else:
-                cols = np.nonzero(self._match_row_arbitrary(pod) & self._thr_valid)[0]
+                prev = self._row_prev.get(row) if row is not None else None
+                if prev is not None and prev[0] is pod:
+                    # the old side of the MODIFIED event the index just
+                    # processed: its row was saved before the overwrite
+                    cols = np.nonzero(prev[1] & self._thr_valid[: prev[1].shape[0]])[0]
+                else:
+                    cols = np.nonzero(self._match_row_arbitrary(pod) & self._thr_valid)[0]
             return [self._col_thrs[int(c)].key for c in cols if int(c) in self._col_thrs]
 
     def matched_pod_keys(self, throttle_key: str) -> List[str]:

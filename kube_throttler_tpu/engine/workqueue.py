@@ -14,8 +14,10 @@ DefaultControllerRateLimiter):
   (5ms · 2^fails, capped at 1000s — client-go's ItemExponentialFailureRateLimiter
   defaults) reset by Forget on success.
 
-Delays poll the injectable clock at millisecond granularity so FakeClock
-tests can drive override wakeups deterministically.
+The delay waker sleeps on a condition variable until the EARLIEST delayed
+deadline (no unconditional polling — an idle daemon makes zero wakeups);
+``add_after`` re-arms it, and a FakeClock jump notifies it via the clock's
+subscribe hook, keeping FakeClock tests deterministic.
 """
 
 from __future__ import annotations
@@ -39,7 +41,12 @@ class RateLimitingQueue:
     def __init__(self, name: str = "", clock: Optional[Clock] = None):
         self.name = name
         self._clock = clock or RealClock()
-        self._cond = threading.Condition()
+        # consumers (get) and the delay waker wait on separate conditions
+        # over ONE shared lock, so add()/done() can notify exactly one
+        # consumer without waking (or losing the wakeup to) the waker
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._waker_cond = threading.Condition(self._lock)
         self._queue: List[str] = []  # FIFO of ready items
         self._dirty: Set[str] = set()
         self._processing: Set[str] = set()
@@ -47,8 +54,14 @@ class RateLimitingQueue:
         self._delayed: List[Tuple[float, int, str]] = []  # (ready_ts, seq, item)
         self._seq = 0
         self._shutdown = False
+        self._clock.subscribe(self._on_clock_jump)
         self._waker = threading.Thread(target=self._delay_loop, daemon=True)
         self._waker.start()
+
+    def _on_clock_jump(self) -> None:
+        with self._lock:
+            self._cond.notify_all()
+            self._waker_cond.notify_all()
 
     # -- core queue semantics (client-go workqueue/queue.go) ---------------
 
@@ -68,7 +81,9 @@ class RateLimitingQueue:
         """Blocks until an item is available. Raises ShutDown."""
         with self._cond:
             while not self._queue and not self._shutdown:
-                if not self._cond.wait(timeout=timeout if timeout else 0.05):
+                # untimed callers still wake on every add/done/shutdown
+                # notify; the 1s re-check is only a lost-wakeup safety net
+                if not self._cond.wait(timeout=timeout if timeout is not None else 1.0):
                     if timeout is not None:
                         raise TimeoutError
             if self._shutdown and not self._queue:
@@ -103,12 +118,12 @@ class RateLimitingQueue:
             self.add(item)
             return
         ready = self._now_ts() + secs
-        with self._cond:
+        with self._lock:
             if self._shutdown:
                 return
             self._seq += 1
             heapq.heappush(self._delayed, (ready, self._seq, item))
-            self._cond.notify_all()
+            self._waker_cond.notify_all()  # new earliest deadline, re-arm
 
     def add_rate_limited(self, item: str) -> None:
         with self._cond:
@@ -128,9 +143,12 @@ class RateLimitingQueue:
     # -- lifecycle ---------------------------------------------------------
 
     def shut_down(self) -> None:
-        with self._cond:
+        with self._lock:
             self._shutdown = True
             self._cond.notify_all()
+            self._waker_cond.notify_all()
+        # a shut-down queue must not stay referenced by a long-lived clock
+        self._clock.unsubscribe(self._on_clock_jump)
 
     def __len__(self) -> int:
         with self._cond:
@@ -142,12 +160,12 @@ class RateLimitingQueue:
         return self._clock.now().timestamp()
 
     def _delay_loop(self) -> None:
-        """Move due delayed items onto the ready queue. Polls the clock so a
-        FakeClock advance is observed within one tick."""
-        import time as _time
-
-        while True:
-            with self._cond:
+        """Move due delayed items onto the ready queue, sleeping until the
+        earliest deadline (condition wait, not a poll): zero wakeups while
+        idle. A FakeClock advance notifies via _on_clock_jump; add_after
+        notifies when a new item becomes the earliest."""
+        with self._waker_cond:
+            while True:
                 if self._shutdown:
                     return
                 now = self._now_ts()
@@ -158,4 +176,5 @@ class RateLimitingQueue:
                         if item not in self._processing:
                             self._queue.append(item)
                             self._cond.notify()
-            _time.sleep(0.002)
+                timeout = self._delayed[0][0] - now if self._delayed else None
+                self._waker_cond.wait(timeout=timeout)

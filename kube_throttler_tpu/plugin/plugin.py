@@ -27,8 +27,8 @@ logger = logging.getLogger(__name__)
 
 PLUGIN_NAME = "kube-throttler"
 
-SCHEME_GROUP = "schedule.k8s.everpeace.github.com"
-SCHEME_VERSION = "v1alpha1"
+from ..api.serialization import API_GROUP as SCHEME_GROUP  # noqa: E402
+from ..api.serialization import VERSION as SCHEME_VERSION  # noqa: E402
 
 
 class KubeThrottler:
@@ -219,8 +219,10 @@ class KubeThrottler:
                     schedulable[pod.key] = not (ta or ti or te or ca or ci or ce)
                 return {"schedulable": schedulable, "errors": errors}
 
-            for kind in ("throttle", "clusterthrottle"):
-                _, ok, rows = self.device_manager.check_batch(kind, False)
+            # one coherent device snapshot for BOTH kinds (a single lock
+            # hold inside check_batch_all) — the composed verdict matches
+            # one point in the event stream
+            for kind, (_, ok, rows) in self.device_manager.check_batch_all(False).items():
                 ok = np.asarray(ok)
                 for key, row in rows.items():
                     schedulable[key] = schedulable.get(key, True) and bool(ok[row])

@@ -12,6 +12,15 @@ class Clock:
     def now(self) -> datetime:  # pragma: no cover — interface
         raise NotImplementedError
 
+    def subscribe(self, callback) -> None:
+        """Register a zero-arg callback fired when the clock jumps (FakeClock
+        advance/set). Real time never jumps, so the default is a no-op —
+        deadline waiters compute exact timeouts instead of polling."""
+
+    def unsubscribe(self, callback) -> None:
+        """Remove a subscribed callback (no-op when absent) so a shut-down
+        waiter doesn't stay referenced by a long-lived clock."""
+
 
 class RealClock(Clock):
     def now(self) -> datetime:
@@ -19,22 +28,45 @@ class RealClock(Clock):
 
 
 class FakeClock(Clock):
-    """Settable clock for tests; ``advance`` wakes pollers via condition."""
+    """Settable clock for tests; ``advance`` wakes subscribed waiters."""
 
     def __init__(self, start: datetime):
         self._now = start
         self._cond = threading.Condition()
+        self._listeners = []
 
     def now(self) -> datetime:
         with self._cond:
             return self._now
 
+    def subscribe(self, callback) -> None:
+        with self._cond:
+            self._listeners.append(callback)
+
+    def unsubscribe(self, callback) -> None:
+        with self._cond:
+            try:
+                self._listeners.remove(callback)
+            except ValueError:
+                pass
+
+    def _notify(self) -> None:
+        # listeners run OUTSIDE the clock lock: a listener typically takes
+        # its own lock (e.g. the workqueue condition) whose holders call
+        # back into now() — calling under the clock lock would be an
+        # ABBA deadlock
+        with self._cond:
+            self._cond.notify_all()
+            listeners = list(self._listeners)
+        for cb in listeners:
+            cb()
+
     def advance(self, delta: timedelta) -> None:
         with self._cond:
             self._now += delta
-            self._cond.notify_all()
+        self._notify()
 
     def set(self, t: datetime) -> None:
         with self._cond:
             self._now = t
-            self._cond.notify_all()
+        self._notify()

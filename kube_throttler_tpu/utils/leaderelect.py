@@ -2,13 +2,18 @@
 
 The reference inherits leader election from the embedded kube-scheduler
 (the ``leaderElection`` block of KubeSchedulerConfiguration —
-deploy/config.yaml in both repos); a standby replica blocks until the
-lease is free. This module provides the standalone analog: an exclusive
-``flock`` lease on a file, acquired with the same block-until-leader
-behavior. Single-host/shared-filesystem scope — for multi-host HA the
-daemon would sit behind a real Lease object on the control-plane store,
-which the in-memory apiserver doesn't persist by design (crash-only,
-SURVEY §5).
+deploy/config.yaml in both repos; client-go leaderelection over a
+coordination.k8s.io Lease); a standby replica blocks until the lease is
+free. Two backends here:
+
+- :class:`FileLeaseElector` — exclusive ``flock`` on a file in a private
+  runtime directory; single-host scope, crash-safe (the OS drops the lock
+  on process death).
+- :class:`HttpLeaseElector` — a Lease object on the control-plane
+  apiserver (`/apis/coordination.k8s.io/v1/.../leases/`), renewed on a
+  heartbeat and taken over when ``renewTime`` goes stale — client-go's
+  LeaderElector loop. Multi-host capable: replicas coordinate through the
+  shared apiserver exactly like the reference.
 """
 
 from __future__ import annotations
@@ -18,9 +23,22 @@ import logging
 import os
 import threading
 import time
+from datetime import datetime, timedelta, timezone
+from pathlib import Path
 from typing import Optional
 
 logger = logging.getLogger(__name__)
+
+
+def default_lease_path(name: str) -> str:
+    """Default flock lease location: a per-user 0700 runtime dir —
+    NOT world-writable /tmp, where a predictable filename invites a
+    pre-create / symlink squat (ADVICE r2 item 1)."""
+    base = os.environ.get("XDG_RUNTIME_DIR") or os.environ.get("XDG_CACHE_HOME")
+    root = Path(base) if base else Path.home() / ".cache"
+    d = root / "kube-throttler-tpu"
+    d.mkdir(mode=0o700, parents=True, exist_ok=True)
+    return str(d / f"{name}.lock")
 
 
 class FileLeaseElector:
@@ -41,7 +59,10 @@ class FileLeaseElector:
         if self._fd is not None:
             return True
         try:
-            fd = os.open(self.lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+            # O_NOFOLLOW: refuse a symlink planted at the lease path
+            fd = os.open(
+                self.lock_path, os.O_CREAT | os.O_RDWR | os.O_NOFOLLOW, 0o600
+            )
         except OSError as e:
             # unusable path (missing dir, permission-denied) is a config
             # error, not a held lease — fail loudly instead of retrying
@@ -89,3 +110,232 @@ class FileLeaseElector:
             os.close(self._fd)
             self._fd = None
         logger.info("released leadership lease %s", self.lock_path)
+
+
+def _rfc3339(dt: datetime) -> str:
+    return dt.astimezone(timezone.utc).isoformat().replace("+00:00", "Z")
+
+
+def _parse_rfc3339(s: str) -> Optional[datetime]:
+    try:
+        return datetime.fromisoformat(s.replace("Z", "+00:00"))
+    except (ValueError, AttributeError):
+        return None
+
+
+class HttpLeaseElector:
+    """client-go-style leader election over a coordination.k8s.io Lease on
+    the apiserver (the backend the reference's embedded kube-scheduler
+    uses). Multi-host: any number of replicas, on any hosts, coordinate
+    through the shared control plane.
+
+    Protocol (leaderelection.go semantics):
+    - create the Lease if absent (win by creation);
+    - if held by someone else, take over only when ``renewTime`` is older
+      than ``lease_duration`` (the holder died or lost connectivity);
+    - while leading, renew every ``renew_period`` by PUT with the last
+      resourceVersion — a 409 means another replica wrote the Lease, so
+      re-read and possibly demote (leadership loss is observable via
+      ``is_leader``).
+    """
+
+    def __init__(
+        self,
+        client,  # client.transport.ApiClient
+        name: str,
+        identity: str,
+        namespace: str = "kube-system",
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+        retry_period: float = 2.0,
+        on_lost=None,
+    ):
+        """``on_lost``: zero-arg callback fired when held leadership is LOST
+        (renew conflict won by another replica, or the renew deadline
+        passing without a successful write). The reference's embedded
+        kube-scheduler exits the process here — wire ``on_lost`` to the
+        daemon's stop event for the same fail-fast behavior."""
+        self.client = client
+        self.name = name
+        self.identity = identity
+        # create is POST to the COLLECTION, read/update to the named
+        # resource — the real apiserver 405s a POST to a named path
+        self.collection_path = (
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        )
+        self.path = f"{self.collection_path}/{name}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.on_lost = on_lost
+        self._leader = False
+        self._rv = ""
+        self._stop = threading.Event()
+        self._renewer: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    # -- lease document ----------------------------------------------------
+
+    def _spec(self, acquire_time: Optional[str] = None) -> dict:
+        now = _rfc3339(datetime.now(timezone.utc))
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "acquireTime": acquire_time or now,
+            "renewTime": now,
+        }
+
+    def _doc(self, spec: dict, rv: str = "") -> dict:
+        meta = {"name": self.name}
+        if rv:
+            meta["resourceVersion"] = rv
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": meta,
+            "spec": spec,
+        }
+
+    def try_acquire(self) -> bool:
+        """One acquisition attempt (non-blocking). Races and held leases
+        return False quietly; unexpected errors are LOGGED (an auth or URL
+        misconfiguration must not masquerade as 'lease held')."""
+        from ..client.transport import ApiError
+        from ..engine.store import ConflictError, NotFoundError
+
+        try:
+            current = self.client.get(self.path)
+        except NotFoundError:
+            try:
+                created = self.client.post(
+                    self.collection_path, self._doc(self._spec())
+                )
+                self._rv = str((created.get("metadata") or {}).get("resourceVersion", ""))
+                self._won()
+                return True
+            except ConflictError:
+                return False  # another replica created it first
+            except (ApiError, OSError) as e:
+                logger.warning("lease create on %s failed: %s", self.collection_path, e)
+                return False
+        except (ApiError, OSError) as e:
+            logger.warning("lease read on %s failed: %s", self.path, e)
+            return False  # apiserver unreachable: not leader
+
+        spec = current.get("spec") or {}
+        rv = str((current.get("metadata") or {}).get("resourceVersion", ""))
+        holder = spec.get("holderIdentity") or ""
+        renew = _parse_rfc3339(spec.get("renewTime") or "")
+        duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
+        now = datetime.now(timezone.utc)
+        expired = renew is None or (now - renew) > timedelta(seconds=duration)
+        if holder == self.identity or expired or not holder:
+            acquire = (
+                spec.get("acquireTime") if holder == self.identity else None
+            )
+            try:
+                updated = self.client.put(self.path, self._doc(self._spec(acquire), rv))
+            except ConflictError:
+                return False  # raced another replica; retry later
+            except (ApiError, OSError) as e:
+                logger.warning("lease takeover on %s failed: %s", self.path, e)
+                return False
+            self._rv = str((updated.get("metadata") or {}).get("resourceVersion", ""))
+            self._won()
+            return True
+        return False
+
+    def _won(self) -> None:
+        if not self._leader:
+            logger.info(
+                "acquired leadership lease %s as %s", self.path, self.identity
+            )
+        self._leader = True
+
+    def _lost(self, why: str) -> None:
+        self._leader = False
+        logger.warning("lost leadership lease %s (%s)", self.path, why)
+        if self.on_lost is not None:
+            try:
+                self.on_lost()
+            except Exception:
+                logger.exception("on_lost callback failed")
+
+    def _renew_loop(self) -> None:
+        from ..engine.store import ConflictError
+
+        last_renew = time.monotonic()
+        while not self._stop.wait(self.renew_period):
+            try:
+                updated = self.client.put(
+                    self.path, self._doc(self._spec(), self._rv)
+                )
+                self._rv = str(
+                    (updated.get("metadata") or {}).get("resourceVersion", "")
+                )
+                last_renew = time.monotonic()
+            except ConflictError:
+                # someone else wrote the Lease — re-read; demote unless it
+                # was our own write racing (then try_acquire re-renews)
+                self._leader = False
+                if self.try_acquire():
+                    last_renew = time.monotonic()
+                else:
+                    self._lost("conflict — another replica holds the lease")
+                    return
+            except Exception:
+                # transient apiserver failure: keep trying until the lease
+                # would have expired unrenewed, then DEMOTE — a standby has
+                # taken over by then and two replicas must not both lead
+                # (client-go renewDeadline semantics)
+                logger.exception("lease renew failed; retrying")
+                if time.monotonic() - last_renew > self.lease_duration:
+                    self._lost(
+                        f"renew deadline passed ({self.lease_duration:.0f}s "
+                        "without a successful write)"
+                    )
+                    return
+
+    def acquire(self, stop: Optional[threading.Event] = None) -> bool:
+        """Block until leadership is acquired (True) or ``stop`` fires
+        (False); starts the background renewer on success."""
+        waiting_logged = False
+        while True:
+            if self.try_acquire():
+                self._stop.clear()
+                self._renewer = threading.Thread(
+                    target=self._renew_loop, name="lease-renew", daemon=True
+                )
+                self._renewer.start()
+                return True
+            if not waiting_logged:
+                logger.info(
+                    "lease %s held by another replica; standing by", self.path
+                )
+                waiting_logged = True
+            if stop is not None:
+                if stop.wait(self.retry_period):
+                    return False
+            else:
+                time.sleep(self.retry_period)
+
+    def release(self) -> None:
+        """Stop renewing and relinquish by zeroing the holder (a clean
+        hand-off; a crashed leader is simply taken over on expiry)."""
+        self._stop.set()
+        if self._renewer is not None:
+            self._renewer.join(timeout=2)
+            self._renewer = None
+        if not self._leader:
+            return
+        self._leader = False
+        try:
+            spec = self._spec()
+            spec["holderIdentity"] = ""
+            self.client.put(self.path, self._doc(spec, self._rv))
+        except Exception:
+            pass  # expiry will free it
+        logger.info("released leadership lease %s", self.path)

@@ -1,8 +1,10 @@
-"""Leader-election lease: exclusion, handoff, crash release.
+"""Leader-election lease: exclusion, handoff, crash release — flock backend
+(single host) and the coordination.k8s.io Lease backend over the apiserver
+(multi-host, client-go leaderelection semantics).
 
 The reference gets leader election from the embedded kube-scheduler's
-``leaderElection`` config; the daemon's standalone analog is an exclusive
-flock lease (utils/leaderelect.py)."""
+``leaderElection`` config (a Lease on the apiserver); the standalone
+analogs live in utils/leaderelect.py."""
 
 import os
 import subprocess
@@ -10,7 +12,13 @@ import sys
 import threading
 import time
 
-from kube_throttler_tpu.utils.leaderelect import FileLeaseElector
+import pytest
+
+from kube_throttler_tpu.utils.leaderelect import (
+    FileLeaseElector,
+    HttpLeaseElector,
+    default_lease_path,
+)
 
 
 def test_exclusion_and_handoff(tmp_path):
@@ -73,6 +81,91 @@ def test_crashed_leader_frees_lease(tmp_path):
         time.sleep(0.05)
     assert standby.is_leader
     standby.release()
+
+
+def test_default_lease_path_is_private(tmp_path, monkeypatch):
+    """No world-writable /tmp: the default lease lives in a 0700 per-user
+    runtime dir (ADVICE r2 item 1), and the open refuses symlinks."""
+    monkeypatch.setenv("XDG_RUNTIME_DIR", str(tmp_path))
+    path = default_lease_path("kt")
+    assert path.startswith(str(tmp_path))
+    d = os.path.dirname(path)
+    assert (os.stat(d).st_mode & 0o777) == 0o700
+
+    # symlink squatting is refused (O_NOFOLLOW)
+    target = tmp_path / "evil-target"
+    target.write_text("")
+    os.symlink(target, path)
+    with pytest.raises(RuntimeError):
+        FileLeaseElector(path).try_acquire()
+
+
+class TestHttpLeaseElector:
+    @pytest.fixture()
+    def apiserver(self):
+        from kube_throttler_tpu.client.mockserver import MockApiServer
+
+        server = MockApiServer()
+        server.start()
+        yield server
+        server.stop()
+
+    def _elector(self, apiserver, identity, **kw):
+        from kube_throttler_tpu.client.transport import ApiClient, RestConfig
+
+        kw.setdefault("lease_duration", 0.6)
+        kw.setdefault("renew_period", 0.15)
+        kw.setdefault("retry_period", 0.05)
+        return HttpLeaseElector(
+            ApiClient(RestConfig(server=apiserver.url)),
+            name="kt",
+            identity=identity,
+            **kw,
+        )
+
+    def test_exclusion_and_clean_handoff(self, apiserver):
+        a = self._elector(apiserver, "replica-a")
+        b = self._elector(apiserver, "replica-b")
+        assert a.try_acquire() and a.is_leader
+        assert not b.try_acquire() and not b.is_leader
+
+        acquired = threading.Event()
+        t = threading.Thread(
+            target=lambda: (b.acquire(), acquired.set()), daemon=True
+        )
+        t.start()
+        time.sleep(0.15)
+        assert not acquired.is_set()
+        a.release()  # clean handoff: holder zeroed, standby takes over fast
+        assert acquired.wait(5.0) and b.is_leader
+        b.release()
+
+    def test_failover_on_expired_lease(self, apiserver):
+        """A crashed leader (renewer stopped, no release) is taken over once
+        renewTime goes stale — two 'daemons', shared control plane, no
+        shared filesystem: the multi-host scenario."""
+        a = self._elector(apiserver, "replica-a")
+        assert a.acquire()
+        a._stop.set()  # simulate crash: stop renewing WITHOUT releasing
+        a._renewer.join(timeout=2)
+
+        b = self._elector(apiserver, "replica-b")
+        assert not b.try_acquire()  # lease still fresh
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.try_acquire():
+            time.sleep(0.05)
+        assert b.is_leader
+        b.release()
+
+    def test_renewal_keeps_standby_out(self, apiserver):
+        a = self._elector(apiserver, "replica-a")
+        assert a.acquire()
+        b = self._elector(apiserver, "replica-b")
+        # well past lease_duration: the renewer must have kept it fresh
+        time.sleep(1.0)
+        assert not b.try_acquire()
+        assert a.is_leader
+        a.release()
 
 
 def test_cli_wires_leader_election(tmp_path, monkeypatch):

@@ -1,0 +1,22 @@
+#!/usr/bin/env sh
+# Build the daemon container image (reference release.yaml's docker step).
+# Usage: tools/build_image.sh [tag] [extra docker build args...]
+#   tools/build_image.sh                      # kube-throttler-tpu:latest
+#   tools/build_image.sh v0.1.0
+#   tools/build_image.sh latest --build-arg JAX_EXTRA="jax[tpu]"
+set -eu
+
+TAG="${1:-latest}"
+[ "$#" -gt 0 ] && shift
+
+if command -v docker >/dev/null 2>&1; then
+    ENGINE=docker
+elif command -v podman >/dev/null 2>&1; then
+    ENGINE=podman
+else
+    echo "error: neither docker nor podman found on PATH" >&2
+    exit 1
+fi
+
+cd "$(dirname "$0")/.."
+exec "$ENGINE" build -t "kube-throttler-tpu:${TAG}" "$@" .
