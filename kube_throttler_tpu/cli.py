@@ -72,6 +72,14 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument("--num-key-mutex", type=int, default=0)
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--port", type=int, default=10259)
+    serve.add_argument(
+        "--data-dir",
+        default="",
+        help="standalone durability: journal every watch event to "
+        "<dir>/store.journal and replay it on startup, so specs AND written "
+        "statuses survive a restart (ignored with --kubeconfig, where the "
+        "apiserver is the state of record and reflectors rebuild the cache)",
+    )
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
     serve.add_argument(
         "--leader-elect",
@@ -199,6 +207,7 @@ def main(argv: Optional[list] = None) -> int:
 
     store = Store()
     session = None
+    journal = None
     if plugin_args.kubeconfig:
         from .client.transport import RemoteSession
 
@@ -210,7 +219,21 @@ def main(argv: Optional[list] = None) -> int:
         )
         session.start()  # blocks until every reflector listed once
     else:
-        store.create_namespace(Namespace("default"))
+        if args.data_dir:
+            import os as _os
+
+            from .engine.journal import attach as attach_journal
+
+            _os.makedirs(args.data_dir, exist_ok=True)
+            journal_path = _os.path.join(args.data_dir, "store.journal")
+            # attach BEFORE the plugin registers handlers: replay fills the
+            # store silently; the plugin's cache-sync replay then delivers
+            # the recovered objects to the device mirror and controllers
+            journal = attach_journal(store, journal_path)
+            print(f"journal: {journal_path} ({len(store.list_pods())} pods, "
+                  f"{len(store.list_throttles())} throttles recovered)", flush=True)
+        if store.get_namespace("default") is None:
+            store.create_namespace(Namespace("default"))
     plugin = KubeThrottler(
         plugin_args,
         store,
@@ -249,6 +272,8 @@ def main(argv: Optional[list] = None) -> int:
     if session is not None:
         session.stop()
     plugin.stop()
+    if journal is not None:
+        journal.close()
     if elector is not None:
         elector.release()
     return 0

@@ -220,10 +220,17 @@ class _KindState:
         else:
             self.dirty_pods = True
 
-    def set_throttle_row(self, thr: AnyThrottle) -> int:
+    def set_throttle_row(self, thr: AnyThrottle, selector_changed: bool = True) -> int:
         from ..api.types import effective_threshold
 
-        col = self.index.upsert_throttle(thr)
+        if selector_changed:
+            col = self.index.upsert_throttle(thr)
+        else:
+            # status/threshold-only update: the mask column is untouched, so
+            # skip the O(P) column re-match and just refresh the object
+            col = self.index.refresh_throttle_object(thr)
+            if col is None:  # not indexed yet (shouldn't happen) — full path
+                col = self.index.upsert_throttle(thr)
         before = (self.tcap, self.R)
         self.ensure_capacity()
         eff = effective_threshold(thr.spec.threshold, thr.status)
@@ -634,10 +641,29 @@ class DeviceStateManager:
                 # the mirrored row must disappear, or it would keep blocking
                 # pods this throttler no longer governs
                 col = ks.remove_throttle_row(thr.key)
-            else:
-                col = ks.set_throttle_row(thr)
-            ks.mark_col_rebase(col)
-            ks.refresh_mask()
+                ks.mark_col_rebase(col)
+                ks.refresh_mask()
+                return
+            # a MODIFIED whose selector is unchanged — overwhelmingly the
+            # status write-back echo of our own reconcile — cannot flip any
+            # mask cell: skip the O(P) column re-match, the full-mask device
+            # re-upload, and the aggregate column rebase. Without this,
+            # every reconcile's own status write invalidates the [P,T] mask
+            # (at 100k×10k that is a ~1GB upload per reconcile batch).
+            # The throttle must ALREADY be indexed: a throttlerName handover
+            # TO this throttler arrives as MODIFIED with an unchanged
+            # selector, but its column has yet to be built — treating it as
+            # unchanged would leave the throttle silently unenforced.
+            selector_changed = not (
+                event.type == EventType.MODIFIED
+                and event.old_obj is not None
+                and event.old_obj.spec.selector == thr.spec.selector
+                and ks.index.throttle_col(thr.key) is not None
+            )
+            col = ks.set_throttle_row(thr, selector_changed=selector_changed)
+            if selector_changed:
+                ks.mark_col_rebase(col)
+                ks.refresh_mask()
 
     def _on_throttle(self, event: Event) -> None:
         self._on_any_throttle(self.throttle, event)

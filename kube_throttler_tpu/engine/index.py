@@ -102,12 +102,15 @@ class SelectorIndex:
         # pods
         self._pod_rows: Dict[str, int] = {}
         self._row_pods: Dict[int, Pod] = {}
-        # previous (object, mask-row) per row: lets the MODIFIED handler's
-        # old-side affected query reuse the row the index JUST replaced
-        # instead of re-evaluating T columns; invalidated wholesale on any
-        # column/namespace change (the cache must never outlive compiled
-        # columns it was computed against)
-        self._row_prev: Dict[int, Tuple[Pod, np.ndarray]] = {}
+        # single-slot previous (row, object, mask-row) cache: lets the
+        # MODIFIED handler's old-side affected query reuse the row the index
+        # JUST replaced instead of re-evaluating T columns. One slot is
+        # enough — the consumer runs inside the SAME store dispatch (store
+        # lock held), before the next pod event can overwrite it — and keeps
+        # the cache O(tcap) bytes instead of growing per churned row. It is
+        # dropped on any column/namespace change (it must never outlive the
+        # compiled columns it was computed against).
+        self._row_prev: Optional[Tuple[int, Pod, np.ndarray]] = None
         self._free_rows: List[int] = []
         self._pcap = pod_capacity
         self._pod_valid = np.zeros(self._pcap, dtype=bool)
@@ -174,7 +177,7 @@ class SelectorIndex:
                 self._pod_rows[pod.key] = row
             prev = self._row_pods.get(row)
             if prev is not None and prev is not pod:
-                self._row_prev[row] = (prev, self.mask[row, : self._tcap].copy())
+                self._row_prev = (row, prev, self.mask[row, : self._tcap].copy())
             self._row_pods[row] = pod
             self._pod_valid[row] = True
             self._pod_ns[row] = self._ns_ids.id_of(pod.namespace)
@@ -207,7 +210,8 @@ class SelectorIndex:
             if row is None:
                 return
             self._row_pods.pop(row, None)
-            self._row_prev.pop(row, None)
+            if self._row_prev is not None and self._row_prev[0] == row:
+                self._row_prev = None
             self._pod_valid[row] = False
             self.mask[row, :] = False
             self._free_rows.append(row)
@@ -228,10 +232,22 @@ class SelectorIndex:
                 self._thr_cols[key] = col
             self._col_thrs[col] = thr
             self._thr_valid[col] = True
-            self._row_prev.clear()  # compiled columns changed
+            self._row_prev = None  # compiled columns changed
             if self._native is not None:
                 self._native_sync_col(col, thr)
             self._recompute_col(col)
+            return col
+
+    def refresh_throttle_object(self, thr: AnyThrottle) -> Optional[int]:
+        """Swap the stored object for an update that did NOT change the
+        selector (e.g. a status write-back): no column recompute, no mask
+        change — a [P]-wide re-match per status echo would make every
+        reconcile O(pods). Returns the column, or None if not indexed."""
+        with self._lock:
+            col = self._thr_cols.get(thr.key)
+            if col is None:
+                return None
+            self._col_thrs[col] = thr
             return col
 
     def _grow_throttles(self) -> None:
@@ -253,7 +269,7 @@ class SelectorIndex:
                 return
             self._col_thrs.pop(col, None)
             self._thr_valid[col] = False
-            self._row_prev.clear()  # compiled columns changed
+            self._row_prev = None  # compiled columns changed
             self.mask[:, col] = False
             self._free_cols.append(col)
             if self._native is not None:
@@ -267,7 +283,7 @@ class SelectorIndex:
         with self._lock:
             self._namespaces[ns.name] = ns
             self._ns_label_ids.pop(ns.name, None)
-            self._row_prev.clear()  # ns labels feed clusterthrottle matches
+            self._row_prev = None  # ns labels feed clusterthrottle matches
             if self.kind != "clusterthrottle":
                 return
             ns_id = self._ns_ids.id_of(ns.name)
@@ -431,11 +447,11 @@ class SelectorIndex:
             if row is not None and self._row_pods.get(row) is pod:
                 cols = np.nonzero(self.mask[row, : self._tcap])[0]
             else:
-                prev = self._row_prev.get(row) if row is not None else None
-                if prev is not None and prev[0] is pod:
+                prev = self._row_prev
+                if prev is not None and prev[0] == row and prev[1] is pod:
                     # the old side of the MODIFIED event the index just
                     # processed: its row was saved before the overwrite
-                    cols = np.nonzero(prev[1] & self._thr_valid[: prev[1].shape[0]])[0]
+                    cols = np.nonzero(prev[2] & self._thr_valid[: prev[2].shape[0]])[0]
                 else:
                     cols = np.nonzero(self._match_row_arbitrary(pod) & self._thr_valid)[0]
             return [self._col_thrs[int(c)].key for c in cols if int(c) in self._col_thrs]

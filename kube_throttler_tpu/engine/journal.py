@@ -1,0 +1,211 @@
+"""Durable event journal for the standalone Store.
+
+The reference is crash-only because its state of record lives on the
+kube-apiserver — informer caches resync on restart (plugin.go:114-130) and
+reservations are scheduler-cycle-transient (SURVEY §5). This build has the
+same stance in remote (``--kubeconfig``) mode: reflectors rebuild the cache
+from the real apiserver. In STANDALONE mode, however, the in-memory Store
+IS the apiserver, so crash-only needs a durable log: the journal appends
+every watch event as a JSON line and replays it on startup, making
+``status``/spec state survive a daemon restart.
+
+Format: one ``{"type": ..., "kind": ..., "object": {...}}`` per line —
+deliberately the watch wire-event shape (client/transport.py), so the
+journal doubles as a replayable watch stream. A truncated trailing line
+(crash mid-write) is tolerated and dropped. When the live log exceeds
+``compact_after`` lines it is compacted to a snapshot of ADDED events
+(written to a temp file, atomically renamed).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+from ..api.serialization import object_from_dict, object_to_dict
+from .store import Event, EventType, Store
+
+logger = logging.getLogger(__name__)
+
+# replay creation order: namespaced objects need their namespaces first
+_KIND_ORDER = {"Namespace": 0, "Throttle": 1, "ClusterThrottle": 1, "Pod": 2}
+
+
+class StoreJournal:
+    """Attach with :func:`attach`; detach via :meth:`close`."""
+
+    def __init__(self, store: Store, path: str, compact_after: int = 100_000):
+        self.store = store
+        self.path = path
+        self.compact_after = compact_after
+        self._lock = threading.Lock()
+        self._lines = 0
+        self._file = None
+
+    # -- replay -------------------------------------------------------------
+
+    def _replay(self) -> Tuple[int, Optional[int]]:
+        """Apply journaled events to the (empty) store. Returns
+        ``(applied, truncate_at)``: the event count, and — when a corrupt
+        line stopped replay — the byte offset of the end of the last GOOD
+        line. The caller MUST truncate there before appending: appending
+        past a corrupt line would strand every later event behind the gap
+        on all future replays (silent loss of post-crash history)."""
+        if not os.path.exists(self.path):
+            return 0, None
+        applied = 0
+        good_end = 0
+        with open(self.path, "rb") as f:
+            for lineno, raw in enumerate(f, 1):
+                line = raw.strip()
+                if not line:
+                    good_end += len(raw)
+                    continue
+                try:
+                    event = json.loads(line.decode("utf-8"))
+                    self._apply(event)
+                    applied += 1
+                    good_end += len(raw)
+                except (
+                    json.JSONDecodeError,
+                    KeyError,
+                    ValueError,
+                    UnicodeDecodeError,
+                ) as e:
+                    # only acceptable at the tail (crash mid-write); report
+                    # either way and stop — replaying past a gap would
+                    # reorder history
+                    logger.warning(
+                        "journal %s: stopping replay at line %d (%s); "
+                        "truncating the corrupt tail",
+                        self.path, lineno, e,
+                    )
+                    return applied, good_end
+        return applied, None
+
+    def _apply(self, event: dict) -> None:
+        kind = event["kind"]
+        etype = event["type"]
+        obj = object_from_dict({**event["object"], "kind": kind})
+        store = self.store
+        if etype == "DELETED":
+            try:
+                if kind == "Pod":
+                    store.delete_pod(obj.namespace, obj.name)
+                elif kind == "Namespace":
+                    store.delete_namespace(obj.name)
+                elif kind == "Throttle":
+                    store.delete_throttle(obj.namespace, obj.name)
+                else:
+                    store.delete_cluster_throttle(obj.name)
+            except KeyError:
+                pass
+            return
+        # ADDED/MODIFIED → upsert (replay must be idempotent-ish: a
+        # compacted snapshot starts from ADDED lines)
+        try:
+            if kind == "Pod":
+                store.create_pod(obj)
+            elif kind == "Namespace":
+                store.create_namespace(obj)
+            elif kind == "Throttle":
+                store.create_throttle(obj)
+            else:
+                store.create_cluster_throttle(obj)
+        except ValueError:
+            if kind == "Pod":
+                store.update_pod(obj)
+            elif kind == "Namespace":
+                store.update_namespace(obj)
+            elif kind == "Throttle":
+                store.update_throttle(obj)
+            else:
+                store.update_cluster_throttle(obj)
+
+    # -- live append ----------------------------------------------------------
+
+    def _on_event(self, event: Event) -> None:
+        line = json.dumps(
+            {
+                "type": event.type.value,
+                "kind": event.kind,
+                "object": object_to_dict(event.obj),
+            }
+        )
+        with self._lock:
+            if self._file is None:
+                return
+            self._file.write(line + "\n")
+            self._file.flush()
+            self._lines += 1
+            if self._lines >= self.compact_after:
+                self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal as a snapshot of the CURRENT store contents
+        (ADDED lines, namespaces first), atomically."""
+        objs = []
+        for ns in self.store.list_namespaces():
+            objs.append(("Namespace", ns))
+        for thr in self.store.list_throttles():
+            objs.append(("Throttle", thr))
+        for thr in self.store.list_cluster_throttles():
+            objs.append(("ClusterThrottle", thr))
+        for pod in self.store.list_pods():
+            objs.append(("Pod", pod))
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(self.path) or ".", suffix=".journal"
+        )
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                for kind, obj in objs:
+                    f.write(
+                        json.dumps(
+                            {"type": "ADDED", "kind": kind, "object": object_to_dict(obj)}
+                        )
+                        + "\n"
+                    )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._file.close()
+        self._file = open(self.path, "a", encoding="utf-8")
+        self._lines = len(objs)
+        logger.info("journal %s compacted to %d objects", self.path, len(objs))
+
+    def close(self) -> None:
+        for kind in Store.KINDS:
+            self.store.remove_event_handler(kind, self._on_event)
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                self._file.close()
+                self._file = None
+
+
+def attach(store: Store, path: str, compact_after: int = 100_000) -> StoreJournal:
+    """Replay ``path`` into the (freshly constructed, empty) store, then
+    journal every subsequent event to it. Must run BEFORE other handlers
+    are registered so replayed events don't double-dispatch."""
+    journal = StoreJournal(store, path, compact_after=compact_after)
+    n, truncate_at = journal._replay()
+    if n:
+        logger.info("journal %s: replayed %d events", path, n)
+    if truncate_at is not None:
+        with open(path, "r+b") as f:
+            f.truncate(truncate_at)
+    journal._file = open(path, "a", encoding="utf-8")
+    journal._lines = n
+    for kind in Store.KINDS:
+        store.add_event_handler(kind, journal._on_event, replay=False)
+    return journal

@@ -148,13 +148,19 @@ class HttpLeaseElector:
         lease_duration: float = 15.0,
         renew_period: float = 5.0,
         retry_period: float = 2.0,
+        renew_deadline: Optional[float] = None,
         on_lost=None,
     ):
         """``on_lost``: zero-arg callback fired when held leadership is LOST
         (renew conflict won by another replica, or the renew deadline
         passing without a successful write). The reference's embedded
         kube-scheduler exits the process here — wire ``on_lost`` to the
-        daemon's stop event for the same fail-fast behavior."""
+        daemon's stop event for the same fail-fast behavior.
+
+        ``renew_deadline`` must be STRICTLY less than ``lease_duration``
+        (client-go defaults 10s vs 15s): the demoting side gives up before
+        a standby's takeover clock expires, so there is never a window with
+        two leaders. Defaults to 2/3 of ``lease_duration``."""
         self.client = client
         self.name = name
         self.identity = identity
@@ -167,6 +173,11 @@ class HttpLeaseElector:
         self.lease_duration = lease_duration
         self.renew_period = renew_period
         self.retry_period = retry_period
+        self.renew_deadline = (
+            renew_deadline if renew_deadline is not None else lease_duration * 2 / 3
+        )
+        if self.renew_deadline >= lease_duration:
+            raise ValueError("renewDeadline must be < leaseDuration")
         self.on_lost = on_lost
         self._leader = False
         self._rv = ""
@@ -268,7 +279,9 @@ class HttpLeaseElector:
         from ..engine.store import ConflictError
 
         last_renew = time.monotonic()
-        while not self._stop.wait(self.renew_period):
+        wait = self.renew_period
+        while not self._stop.wait(wait):
+            wait = self.renew_period
             try:
                 updated = self.client.put(
                     self.path, self._doc(self._spec(), self._rv)
@@ -287,17 +300,19 @@ class HttpLeaseElector:
                     self._lost("conflict — another replica holds the lease")
                     return
             except Exception:
-                # transient apiserver failure: keep trying until the lease
-                # would have expired unrenewed, then DEMOTE — a standby has
-                # taken over by then and two replicas must not both lead
-                # (client-go renewDeadline semantics)
+                # transient apiserver failure: retry FAST (retry_period, not
+                # renew_period) and DEMOTE once renew_deadline passes with
+                # no successful write — strictly before a standby's
+                # lease_duration takeover clock can expire, so two replicas
+                # never both lead (client-go renewDeadline semantics)
                 logger.exception("lease renew failed; retrying")
-                if time.monotonic() - last_renew > self.lease_duration:
+                if time.monotonic() - last_renew > self.renew_deadline:
                     self._lost(
-                        f"renew deadline passed ({self.lease_duration:.0f}s "
+                        f"renew deadline passed ({self.renew_deadline:.0f}s "
                         "without a successful write)"
                     )
                     return
+                wait = self.retry_period
 
     def acquire(self, stop: Optional[threading.Event] = None) -> bool:
         """Block until leadership is acquired (True) or ``stop`` fires

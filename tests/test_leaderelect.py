@@ -157,6 +157,28 @@ class TestHttpLeaseElector:
         assert b.is_leader
         b.release()
 
+    def test_renew_failure_demotes_before_standby_takeover(self, apiserver):
+        """A leader that cannot reach the apiserver must demote (on_lost)
+        within renew_deadline — strictly BEFORE a standby's lease_duration
+        takeover clock expires, so two replicas never both lead."""
+        lost = threading.Event()
+        a = self._elector(apiserver, "replica-a")
+        a.on_lost = lost.set
+        assert a.acquire()
+        # sever connectivity: point the client at a dead port
+        from kube_throttler_tpu.client.transport import ApiClient, RestConfig
+
+        a.client = ApiClient(RestConfig(server="http://127.0.0.1:1"), timeout=0.2)
+        assert lost.wait(5.0)
+        assert not a.is_leader
+        # the standby takes over after lease_duration
+        b = self._elector(apiserver, "replica-b")
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.try_acquire():
+            time.sleep(0.05)
+        assert b.is_leader
+        b.release()
+
     def test_renewal_keeps_standby_out(self, apiserver):
         a = self._elector(apiserver, "replica-a")
         assert a.acquire()

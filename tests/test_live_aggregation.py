@@ -353,6 +353,51 @@ class TestFullRebasePaths:
         assert used.resource_requests["example.com/widgets"] == 3
 
 
+class TestThrottlerNameHandover:
+    def test_handover_to_this_throttler_builds_the_column(self):
+        """A MODIFIED that flips throttlerName TO this throttler without
+        touching the selector must still build the mask column and the
+        aggregate — the selector-unchanged fast path (a status-echo
+        optimization) must not swallow it, or the throttle is silently
+        unenforced."""
+        store, plugin, _ = _stack()
+        foreign = Throttle(
+            name="t1",
+            spec=ThrottleSpec(
+                throttler_name="someone-else",
+                threshold=ResourceAmount.of(pod=0),  # throttles immediately
+                selector=ThrottleSelector(
+                    selector_terms=(
+                        ThrottleSelectorTerm(LabelSelector(match_labels={"grp": "a"})),
+                    )
+                ),
+            ),
+        )
+        store.create_throttle(foreign)
+        store.create_pod(
+            _bound(make_pod("p1", labels={"grp": "a"}, requests={"cpu": "100m"}))
+        )
+        plugin.run_pending_once()
+        # not ours: no status management (the OTHER throttler owns it, so
+        # the used stays nil here), and pods are not throttled by it
+        assert store.get_throttle("default", "t1").status.used == ResourceAmount()
+        assert plugin.pre_filter(
+            make_pod("p2", labels={"grp": "a"}, requests={"cpu": "1m"})
+        ).is_success()
+
+        # handover: same selector, new owner
+        store.update_throttle_spec(
+            replace(foreign, spec=replace(foreign.spec, throttler_name="kube-throttler"))
+        )
+        _assert_status_matches_oracle(store, plugin)
+        thr = store.get_throttle("default", "t1")
+        assert thr.status.used.resource_counts == 1
+        verdict = plugin.pre_filter(
+            make_pod("p2", labels={"grp": "a"}, requests={"cpu": "1m"})
+        )
+        assert not verdict.is_success()
+
+
 class TestIndexBackedCollections:
     def test_affected_keys_for_stale_pod_version(self):
         store, plugin, _ = _stack()
