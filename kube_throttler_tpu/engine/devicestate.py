@@ -42,6 +42,28 @@ from ..ops.schema import DimRegistry, PodBatch, ThrottleState
 AnyThrottle = Union[Throttle, ClusterThrottle]
 
 
+def _next_pow2(n: int, lo: int = 8) -> int:
+    """Smallest power of two ≥ n (≥ lo) — THE shape-bucketing policy:
+    every dynamically-sized device index/batch pads to one of these so the
+    set of compiled XLA shapes stays logarithmic, not one per count."""
+    k = lo
+    while k < n:
+        k *= 2
+    return k
+
+
+def _pad_pow2(idx: np.ndarray, lo: int = 8) -> np.ndarray:
+    """Pad a 1-D index array to the next power of two by repeating its
+    first element (a duplicate scatter index writing the same value is a
+    no-op; a duplicate gather index is simply read twice)."""
+    k = _next_pow2(idx.size, lo)
+    if k == idx.size:
+        return idx
+    out = np.full(k, idx[0] if idx.size else 0, dtype=idx.dtype)
+    out[: idx.size] = idx
+    return out
+
+
 class _KindState:
     """Staging arrays + index for one kind."""
 
@@ -339,8 +361,11 @@ class _KindState:
             and len(self._dirty_thr_cols) <= self.row_scatter_max
         ):
             # single-throttle events: scatter only the touched rows of the
-            # 16 [T]/[T,R] tensors instead of re-uploading them all
-            cols = np.fromiter(self._dirty_thr_cols, dtype=np.int64)
+            # 16 [T]/[T,R] tensors instead of re-uploading them all.
+            # Power-of-two padded (duplicating the first index — writing the
+            # same value twice is a no-op): an unbucketed shape would make
+            # every distinct dirty-count a fresh XLA compile.
+            cols = _pad_pow2(np.fromiter(self._dirty_thr_cols, dtype=np.int64))
             s = self._device_state
             self._device_state = ThrottleState(
                 **{
@@ -399,8 +424,9 @@ class _KindState:
 
         if self._dirty_pod_rows:
             # single-pod events: ship only the touched rows (device-side
-            # scatter instead of a full [P,R]/[P,T] host→device transfer)
-            rows = np.fromiter(self._dirty_pod_rows, dtype=np.int64)
+            # scatter instead of a full [P,R]/[P,T] host→device transfer);
+            # pow2-padded like the throttle-col scatter (compile stability)
+            rows = _pad_pow2(np.fromiter(self._dirty_pod_rows, dtype=np.int64))
             self._device_pods = PodBatch(
                 valid=self._device_pods.valid.at[rows].set(self.pod_valid[rows]),
                 req=self._device_pods.req.at[rows].set(self.pod_req[rows]),
@@ -466,10 +492,7 @@ class _KindState:
 
     @staticmethod
     def _bucket(n: int, lo: int = 8) -> int:
-        k = lo
-        while k < n:
-            k *= 2
-        return k
+        return _next_pow2(n, lo)
 
     def _device_counted(self):
         if (
@@ -778,7 +801,11 @@ class DeviceStateManager:
             # functionally, so the gather below still reads this snapshot
             agg_cnt, agg_req, agg_contrib = ks.agg_cnt, ks.agg_req, ks.agg_contrib
 
-        idx = jnp.asarray(np.asarray(cols, dtype=np.int32))
+        # bucket the gather index to powers of two: an unbucketed shape
+        # makes every distinct reconcile-batch size a fresh XLA compile
+        # (~30s each on a cold TPU backend); padded slots are gathered but
+        # never read back
+        idx = jnp.asarray(_pad_pow2(np.asarray(cols, dtype=np.int32)))
         cnt, req, ctb = jax.device_get(
             (agg_cnt[idx], agg_req[idx], agg_contrib[idx])
         )
@@ -838,6 +865,11 @@ class DeviceStateManager:
 
                 step3 = True if kind == "throttle" else on_equal
                 cols = np.nonzero(mask_row[0])[0]
+                if cols.size == 0:
+                    # no affected throttles — nothing to classify; skip the
+                    # kernel dispatch entirely (with an empty clusterthrottle
+                    # set this halves every pre_filter's device round trips)
+                    return {}
                 if cols.size <= self.indexed_check_max:
                     packed = ks.device_packed()
                     col_keys = [ks.index._col_thrs[int(c)].key for c in cols]
@@ -850,9 +882,7 @@ class DeviceStateManager:
                 # cached packed precomp, and extract results from those K
                 # slots alone — O(K·R) device AND host work, independent of
                 # tcap. K buckets (powers of two) bound recompilation.
-                k = 8
-                while k < cols.size:
-                    k *= 2
+                k = _next_pow2(cols.size)
                 idx = np.zeros(k, dtype=np.int32)
                 idx_valid = np.zeros(k, dtype=bool)
                 idx[: cols.size] = cols
