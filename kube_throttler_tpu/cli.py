@@ -73,6 +73,16 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--port", type=int, default=10259)
     serve.add_argument(
+        "--apiserver-port",
+        type=int,
+        default=-1,
+        help="ALSO serve the Kubernetes list+watch wire protocol from this "
+        "daemon's store on the given port (0 = ephemeral): standby replicas "
+        "or sidecars can then point their --kubeconfig at this daemon, "
+        "making the standalone store a real control plane (ignored with "
+        "--kubeconfig — there is already a real apiserver)",
+    )
+    serve.add_argument(
         "--data-dir",
         default="",
         help="standalone durability: journal every watch event to "
@@ -253,6 +263,14 @@ def main(argv: Optional[list] = None) -> int:
         )
         scheduler.start()
 
+    wire = None
+    if session is None and args.apiserver_port >= 0:
+        from .client.mockserver import MockApiServer
+
+        wire = MockApiServer(store=store, host=args.host, port=args.apiserver_port)
+        wire.start()
+        print(f"wire-protocol apiserver on {args.host}:{wire.port}", flush=True)
+
     server = ThrottlerHTTPServer(
         plugin, host=args.host, port=args.port, remote=session is not None
     )
@@ -267,6 +285,8 @@ def main(argv: Optional[list] = None) -> int:
 
     stop.wait()
     server.stop()
+    if wire is not None:
+        wire.stop()
     if scheduler is not None:
         scheduler.stop()
     if session is not None:

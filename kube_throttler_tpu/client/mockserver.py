@@ -71,12 +71,14 @@ class MockApiServer:
         self,
         store: Optional[Store] = None,
         host: str = "127.0.0.1",
+        port: int = 0,
         log_size: int = 4096,
         bookmark_interval: float = 0.2,
         token: str = "",
     ):
         self.store = store or Store()
         self.host = host
+        self._port = port
         self.token = token
         self.bookmark_interval = bookmark_interval
         self._lock = threading.Lock()
@@ -207,7 +209,7 @@ class MockApiServer:
                     return
                 server._serve_status_put(self, self.path, body)
 
-        self._httpd = ThreadingHTTPServer((self.host, 0), Handler)
+        self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="mock-apiserver", daemon=True
