@@ -247,7 +247,11 @@ def main(argv: Optional[list] = None) -> int:
     plugin = KubeThrottler(
         plugin_args,
         store,
-        event_recorder=RecordingEventRecorder(),
+        # remote mode posts Warning events to the real apiserver (the
+        # reference emits through the framework recorder, plugin.go:190-201)
+        event_recorder=(
+            session.event_recorder if session is not None else RecordingEventRecorder()
+        ),
         use_device=not args.no_device,
         start_workers=True,
         status_writer=session.status_writer if session is not None else None,

@@ -61,6 +61,9 @@ _LEASE_RE = re.compile(
 _LEASE_COLLECTION_RE = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/(?P<ns>[^/]+)/leases$"
 )
+_EVENTS_RE = re.compile(
+    r"^/api/v1/namespaces/(?P<ns>[^/]+)/events(?:/(?P<name>[^/]+))?$"
+)
 
 
 class MockApiServer:
@@ -99,6 +102,8 @@ class MockApiServer:
         # (doc, rv); versioned off their own counter under self._lock
         self._leases: Dict[Tuple[str, str], Tuple[Dict[str, Any], int]] = {}
         self._lease_rv = 0
+        # v1 Events (Warning emission from remote daemons): (ns, name) → doc
+        self._events: Dict[Tuple[str, str], Dict[str, Any]] = {}
         for kind in COLLECTION_PATHS:
             self.store.add_event_handler(kind, self._make_recorder(kind), replay=False)
 
@@ -194,6 +199,8 @@ class MockApiServer:
                     self._send_json(
                         405, {"message": "POST to a named resource; use the collection"}
                     )
+                elif _EVENTS_RE.match(path):
+                    server._serve_event(self, "POST", path, body)
                 else:
                     self._send_json(404, {"message": f"no route {path}"})
 
@@ -206,6 +213,9 @@ class MockApiServer:
                 path = urlsplit(self.path).path
                 if _LEASE_RE.match(path):
                     server._serve_lease(self, "PUT", path, body)
+                    return
+                if _EVENTS_RE.match(path):
+                    server._serve_event(self, "PUT", path, body)
                     return
                 server._serve_status_put(self, self.path, body)
 
@@ -420,6 +430,41 @@ class MockApiServer:
                 "resourceVersion": str(self._lease_rv),
             }
             handler._send_json(200, out)
+
+    def _serve_event(
+        self, handler, verb: str, path: str, body: Dict[str, Any]
+    ) -> None:
+        """v1 Events: POST to the collection creates (409 if the name
+        exists, like the real apiserver); PUT to the named path replaces
+        (the recorder's count-bump). Tests read via the in-process
+        ``events_in`` accessor — there is no GET route."""
+        m = _EVENTS_RE.match(path)
+        ns = m.group("ns")
+        if verb == "POST":
+            name = str(((body or {}).get("metadata") or {}).get("name", ""))
+            if not name:
+                handler._send_json(400, {"message": "event missing metadata.name"})
+                return
+            with self._lock:
+                if (ns, name) in self._events:
+                    handler._send_json(409, {"message": f"event {ns}/{name} exists"})
+                    return
+                self._events[(ns, name)] = body
+            handler._send_json(201, body)
+            return
+        # PUT named
+        name = m.group("name") or ""
+        with self._lock:
+            if (ns, name) not in self._events:
+                handler._send_json(404, {"message": f"event {ns}/{name} not found"})
+                return
+            self._events[(ns, name)] = body
+        handler._send_json(200, body)
+
+    def events_in(self, namespace: str):
+        """Test accessor: the Event docs posted for a namespace."""
+        with self._lock:
+            return [doc for (ns, _), doc in self._events.items() if ns == namespace]
 
     def _serve_status_put(self, handler, path: str, body: Dict[str, Any]) -> None:
         m = _STATUS_RE.match(urlsplit(path).path)

@@ -38,6 +38,7 @@ import socket
 import ssl
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from http.client import HTTPConnection, HTTPSConnection
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
@@ -530,6 +531,134 @@ class RemoteStatusWriter:
         return thr
 
 
+class RemoteEventRecorder:
+    """Event recorder that emits v1 Events to the apiserver — the
+    reference's Warning events reach the cluster through the framework
+    handle's recorder (plugin.go:190-201); in remote mode ours go through
+    the same wire.
+
+    Emission is ASYNCHRONOUS (the real kube recorder buffers too): eventf
+    enqueues and returns — the scheduling hot path never blocks on an
+    apiserver round trip, and a full queue drops the event (best-effort
+    semantics, logged at debug). Identical events aggregate client-side
+    into a count (the event-correlator behavior); the aggregation map is
+    bounded with oldest-first eviction like RecordingEventRecorder. Event
+    object names use a DETERMINISTIC content hash so count bumps keep
+    landing on the same object across restarts and replicas, and created
+    names are remembered so steady-state repeats cost one RPC (PUT), not a
+    doomed POST + PUT."""
+
+    def __init__(
+        self,
+        client: ApiClient,
+        component: str = "kube-throttler",
+        max_entries: int = 10_000,
+        queue_size: int = 1024,
+    ):
+        import queue as _queue
+
+        self.client = client
+        self.component = component
+        self._lock = threading.Lock()
+        self._max_entries = max_entries
+        self._counts: "OrderedDict[Tuple[str, str, str], int]" = OrderedDict()
+        self._created: set = set()
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._drain, name="event-recorder", daemon=True
+        )
+        self._worker.start()
+
+    @staticmethod
+    def _object_name(pod_name: str, reason: str, note: str) -> str:
+        import hashlib
+
+        digest = hashlib.sha1(f"{reason}\x00{note}".encode()).hexdigest()[:10]
+        return f"{pod_name}.{digest}"
+
+    def eventf(
+        self, pod_key: str, event_type: str, reason: str, action: str, note: str
+    ) -> None:
+        import queue as _queue
+
+        key = (pod_key, reason, note)
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            count = self._counts[key]
+            self._counts.move_to_end(key)
+            while len(self._counts) > self._max_entries:
+                self._counts.popitem(last=False)
+        try:
+            self._queue.put_nowait((pod_key, event_type, reason, action, note, count))
+        except _queue.Full:
+            logger.debug("event queue full; dropping %s %s", pod_key, reason)
+
+    def _drain(self) -> None:
+        import queue as _queue
+
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            try:
+                self._emit(*item)
+            except Exception:
+                logger.debug("event emission failed", exc_info=True)
+
+    def _emit(
+        self,
+        pod_key: str,
+        event_type: str,
+        reason: str,
+        action: str,
+        note: str,
+        count: int,
+    ) -> None:
+        namespace, _, name = pod_key.partition("/")
+        obj_name = self._object_name(name, reason, note)
+        body = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"namespace": namespace, "name": obj_name},
+            "involvedObject": {"kind": "Pod", "namespace": namespace, "name": name},
+            "type": event_type,
+            "reason": reason,
+            "action": action,
+            "message": note,
+            "count": count,
+            "source": {"component": self.component},
+        }
+        named = f"/api/v1/namespaces/{namespace}/events/{obj_name}"
+        known = (namespace, obj_name) in self._created
+        try:
+            if known:
+                self.client.put(named, body)
+                return
+            self.client.post(f"/api/v1/namespaces/{namespace}/events", body)
+            self._created.add((namespace, obj_name))
+        except ConflictError:
+            # created by a previous incarnation/replica: bump in place
+            self._created.add((namespace, obj_name))
+            try:
+                self.client.put(named, body)
+            except Exception:
+                logger.debug("event update failed", exc_info=True)
+        except Exception:
+            logger.debug("event post failed", exc_info=True)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Best-effort wait for queued events to emit (tests/shutdown)."""
+        deadline = time.monotonic() + timeout
+        while not self._queue.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def close(self) -> None:
+        self.flush(timeout=1.0)
+        self._stop.set()
+
+
 class RemoteSession:
     """Everything the daemon needs to run against a real apiserver: four
     reflectors feeding the local Store + the remote status writer. The
@@ -548,6 +677,7 @@ class RemoteSession:
             for kind in self.KINDS
         }
         self.status_writer = RemoteStatusWriter(self.client, self.versions)
+        self.event_recorder = RemoteEventRecorder(self.client)
 
     @classmethod
     def from_kubeconfig(cls, path: str, store: Store) -> "RemoteSession":
@@ -563,5 +693,6 @@ class RemoteSession:
                 raise TimeoutError(f"reflector {kind} did not sync")
 
     def stop(self) -> None:
+        self.event_recorder.close()
         for refl in self.reflectors.values():
             refl.stop()

@@ -310,6 +310,51 @@ class TestRemoteModeGuards:
             session.stop()
 
 
+class TestRemoteEvents:
+    def test_warning_events_reach_the_apiserver(self, apiserver):
+        """pod-requests-exceeds-threshold emits a Warning event; in remote
+        mode it lands as a v1 Event on the cluster (plugin.go:190-201),
+        with repeats aggregated into a count."""
+        remote = apiserver.store
+        remote.create_throttle(_throttle("t1", {"grp": "a"}, requests={"cpu": "100m"}))
+
+        local = Store()
+        session = RemoteSession(RestConfig(server=apiserver.url), local)
+        session.start(sync_timeout=10)
+        plugin = KubeThrottler(
+            decode_plugin_args(
+                {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+            ),
+            local,
+            use_device=True,
+            event_recorder=session.event_recorder,
+            status_writer=session.status_writer,
+        )
+        try:
+            probe = make_pod("big", labels={"grp": "a"}, requests={"cpu": "5"})
+            for _ in range(3):  # repeats aggregate, not multiply
+                verdict = plugin.pre_filter(probe)
+                assert not verdict.is_success()
+            # emission is async (the hot path must never block on the
+            # apiserver) — drain the recorder queue before asserting
+            session.event_recorder.flush()
+            # flush drains the queue; the last PUT may still be in flight —
+            # wait on the observable count
+            assert _wait(
+                lambda: apiserver.events_in("default")
+                and apiserver.events_in("default")[0].get("count") == 3
+            )
+            events = apiserver.events_in("default")
+            assert len(events) == 1
+            ev = events[0]
+            assert ev["type"] == "Warning"
+            assert ev["reason"] == "ResourceRequestsExceedsThrottleThreshold"
+            assert ev["involvedObject"]["name"] == "big"
+        finally:
+            plugin.stop()
+            session.stop()
+
+
 class TestStandaloneWireServer:
     def test_daemon_serves_wire_protocol(self):
         """`serve --apiserver-port`: the standalone daemon's store doubles
