@@ -246,8 +246,9 @@ class NativeRowEngine:
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (match, needs_general) as uint8 arrays of length num_cols."""
         n_cols = self._lib.ktn_num_cols(self._h)
-        out = np.zeros(n_cols, dtype=np.uint8)
-        general = np.zeros(n_cols, dtype=np.uint8)
+        # np.empty, not zeros: ktn_match_row memsets both buffers itself
+        out = np.empty(n_cols, dtype=np.uint8)
+        general = np.empty(n_cols, dtype=np.uint8)
         pk = _as_i32(list(pod_labels.keys()))
         pv = _as_i32(list(pod_labels.values()))
         nk = _as_i32(list(ns_labels.keys()))

@@ -25,9 +25,22 @@
 //   - OR of zero terms is false (empty selector matches nothing); a term
 //     with zero requirements matches everything.
 //
+// Candidate pruning: a matchLabels term with at least one pod requirement
+// can only match a pod that carries the term's FIRST (key,value) pair
+// exactly, so columns are inverted-indexed by that pair.  ktn_match_row
+// then evaluates only the columns reachable from the pod's own label pairs
+// (plus an "always" list: general columns and terms with no pod
+// requirements) instead of scanning all T columns.  Term EVALUATION drops
+// from O(T) to O(candidates); the output buffers are still zeroed in O(T)
+// per call (byte memsets — cheap constants that the [T]-sized output ABI
+// requires).
+//
 // C ABI only (loaded via ctypes); no exceptions cross the boundary.
 
+#include <algorithm>
 #include <cstdint>
+#include <cstring>
+#include <unordered_map>
 #include <utility>
 #include <vector>
 
@@ -46,14 +59,64 @@ struct Term {
 struct Col {
   bool valid = false;
   bool general = false;  // evaluated by the Python general tier
+  bool in_always = false;
   int32_t thr_ns = -1;   // required pod-namespace id (namespaced Throttle); -1 = cluster
   std::vector<Term> terms;
+  std::vector<uint64_t> bucket_keys;  // inverted-index keys this col occupies
 };
 
 struct Engine {
   bool cluster = false;  // kind == clusterthrottle
   std::vector<Col> cols;
+  // (key,value) pair of a term's first pod requirement → candidate columns
+  std::unordered_map<uint64_t, std::vector<int32_t>> buckets;
+  std::vector<int32_t> always;  // general cols + terms with no pod reqs
+  std::vector<int64_t> stamp;   // per-col visited epoch (query-time dedup)
+  int64_t epoch = 0;
 };
+
+uint64_t bucket_key(int32_t k, int32_t v) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(k)) << 32) |
+         static_cast<uint32_t>(v);
+}
+
+void unindex_col(Engine* e, int32_t c) {
+  Col& col = e->cols[c];
+  for (uint64_t k : col.bucket_keys) {
+    auto it = e->buckets.find(k);
+    if (it == e->buckets.end()) continue;
+    auto& v = it->second;
+    v.erase(std::remove(v.begin(), v.end(), c), v.end());
+    if (v.empty()) e->buckets.erase(it);
+  }
+  col.bucket_keys.clear();
+  if (col.in_always) {
+    e->always.erase(std::remove(e->always.begin(), e->always.end(), c),
+                    e->always.end());
+    col.in_always = false;
+  }
+}
+
+void index_col(Engine* e, int32_t c) {
+  Col& col = e->cols[c];
+  if (!col.valid) return;
+  bool always = col.general;
+  for (const Term& t : col.terms) {
+    if (t.pod.empty()) always = true;
+  }
+  if (always) {
+    e->always.push_back(c);
+    col.in_always = true;
+    return;  // evaluated unconditionally — bucket entries would be dead
+  }
+  for (const Term& t : col.terms) {
+    if (t.pod.empty()) continue;
+    uint64_t k = bucket_key(t.pod[0].key, t.pod[0].val);
+    auto& v = e->buckets[k];
+    if (std::find(v.begin(), v.end(), c) == v.end()) v.push_back(c);
+    col.bucket_keys.push_back(k);
+  }
+}
 
 // All requirements satisfied by the (keys,vals) label set?  Label sets are
 // small (a handful of entries), so a linear probe beats hashing.
@@ -98,6 +161,7 @@ void ktn_set_col(void* h, int32_t col, int32_t thr_ns, int32_t n_terms,
                  const int32_t* ns_keys, const int32_t* ns_vals) {
   Engine* e = static_cast<Engine*>(h);
   if (col >= static_cast<int32_t>(e->cols.size())) e->cols.resize(col + 1);
+  unindex_col(e, col);
   Col& c = e->cols[col];
   c.valid = true;
   c.general = false;
@@ -112,6 +176,7 @@ void ktn_set_col(void* h, int32_t col, int32_t thr_ns, int32_t n_terms,
       term.ns.push_back({ns_keys[i], ns_vals[i]});
     c.terms.push_back(std::move(term));
   }
+  index_col(e, col);
 }
 
 // Column whose selector needs the Python general tier (matchExpressions /
@@ -119,16 +184,21 @@ void ktn_set_col(void* h, int32_t col, int32_t thr_ns, int32_t n_terms,
 void ktn_set_col_general(void* h, int32_t col, int32_t thr_ns) {
   Engine* e = static_cast<Engine*>(h);
   if (col >= static_cast<int32_t>(e->cols.size())) e->cols.resize(col + 1);
+  unindex_col(e, col);
   Col& c = e->cols[col];
   c.valid = true;
   c.general = true;
   c.thr_ns = thr_ns;
   c.terms.clear();
+  index_col(e, col);
 }
 
 void ktn_clear_col(void* h, int32_t col) {
   Engine* e = static_cast<Engine*>(h);
-  if (col < static_cast<int32_t>(e->cols.size())) e->cols[col] = Col{};
+  if (col < static_cast<int32_t>(e->cols.size())) {
+    unindex_col(e, col);
+    e->cols[col] = Col{};
+  }
 }
 
 int32_t ktn_num_cols(void* h) {
@@ -149,19 +219,24 @@ void ktn_match_row(void* h, int32_t pod_ns, int32_t ns_exists,
                    uint8_t* out, uint8_t* general_out) {
   Engine* e = static_cast<Engine*>(h);
   const int32_t T = static_cast<int32_t>(e->cols.size());
-  for (int32_t c = 0; c < T; ++c) {
+  std::memset(out, 0, T);
+  std::memset(general_out, 0, T);
+  if (static_cast<int32_t>(e->stamp.size()) < T) e->stamp.resize(T, 0);
+  const int64_t epoch = ++e->epoch;
+
+  auto eval = [&](int32_t c) {
+    if (e->stamp[c] == epoch) return;  // already evaluated this call
+    e->stamp[c] = epoch;
     const Col& col = e->cols[c];
-    out[c] = 0;
-    general_out[c] = 0;
-    if (!col.valid) continue;
+    if (!col.valid) return;
     if (!e->cluster) {
-      if (col.thr_ns != pod_ns) continue;
+      if (col.thr_ns != pod_ns) return;
     } else if (!ns_exists) {
-      continue;
+      return;
     }
     if (col.general) {
       general_out[c] = 1;
-      continue;
+      return;
     }
     for (const Term& t : col.terms) {
       if (!pairs_match(t.pod, pk, pv, np)) continue;
@@ -169,6 +244,17 @@ void ktn_match_row(void* h, int32_t pod_ns, int32_t ns_exists,
       out[c] = 1;
       break;
     }
+  };
+
+  // candidates: columns whose bucketing pair the pod actually carries,
+  // plus the always list (general columns / no-pod-requirement terms) —
+  // a term's first requirement unmatched ⇒ the term cannot match, so
+  // non-candidates are provably non-matching
+  for (int32_t c : e->always) eval(c);
+  for (int32_t i = 0; i < np; ++i) {
+    auto it = e->buckets.find(bucket_key(pk[i], pv[i]));
+    if (it == e->buckets.end()) continue;
+    for (int32_t c : it->second) eval(c);
   }
 }
 
