@@ -6,13 +6,15 @@ O(#throttles) Python-equivalent scan). At the 100k-pod × 10k-throttle target
 that is 10⁹ selector evaluations per full pass, so the new framework keeps
 the match matrix *materialized* and maintains it incrementally:
 
-- **fast tier**: selector terms that are pure ``matchLabels`` conjunctions
-  (the overwhelmingly common shape; every reference example uses it) are
-  compiled to interned (label-key → value-id) requirements over columnar
-  int32 label arrays. A pod event recomputes one mask row with O(K·terms)
-  vectorized numpy ops; a throttle event recomputes one column in O(P).
-- **general tier**: terms with matchExpressions (or selector errors) fall
-  back to per-object oracle evaluation, confined to the affected row/column.
+- **fast tier**: every VALID selector — matchLabels conjunctions AND
+  matchExpressions (In/NotIn/Exists/DoesNotExist) — compiles to interned
+  (label-key → value-id) requirements over columnar int32 label arrays.
+  A pod event recomputes one mask row via the native C++ engine's
+  inverted-index candidate pruning; a throttle event recomputes one
+  column with O(P) vectorized numpy ops.
+- **general tier**: selectors that fail validation fall back to per-object
+  oracle evaluation, confined to the affected row/column — the exact
+  error-confinement semantics of the reference.
 
 Namespacing: a Throttle only ever matches pods in its own namespace
 (affectedThrottles lists the pod's namespace); ClusterThrottle terms AND a
@@ -313,19 +315,54 @@ class SelectorIndex:
             out &= arr == self._values.id_of(value)
         return out
 
+    def _selector_col_match(self, selector, store: Dict[str, np.ndarray]) -> np.ndarray:
+        """Vectorized column evaluation of one LabelSelector over interned
+        label arrays — matchLabels AND matchExpressions, mirroring
+        LabelSelector.matches (api/types.py:303-322). The caller validates
+        the selector first (invalid → general tier)."""
+        out = self._term_col_match(selector.match_labels, store)
+        for req in selector.match_expressions:
+            arr = store.get(req.key)
+            present = (
+                (arr != _MISSING) if arr is not None
+                else np.zeros(self._pcap, dtype=bool)
+            )
+            if req.operator == "In":
+                if arr is None:
+                    out[:] = False
+                    return out
+                ids = [self._values.id_of(v) for v in req.values]
+                out &= present & np.isin(arr, ids)
+            elif req.operator == "NotIn":
+                if arr is not None:
+                    ids = [self._values.id_of(v) for v in req.values]
+                    out &= ~(present & np.isin(arr, ids))
+            elif req.operator == "Exists":
+                out &= present
+            else:  # DoesNotExist
+                out &= ~present
+        return out
+
     def _recompute_col(self, col: int) -> None:
         thr = self._col_thrs[col]
-        simple = _simple_terms(thr)
-        if simple is not None:
-            match = np.zeros(self._pcap, dtype=bool)
-            for pod_pairs, ns_pairs in simple:
-                term = self._term_col_match(pod_pairs, self._pod_label)
+        try:
+            # vectorized tier covers the full valid selector surface
+            # (matchLabels + matchExpressions); validation errors fall to
+            # the per-pod general tier for exact error confinement
+            for term in thr.spec.selector.selector_terms:
+                term.pod_selector.validate()
                 if self.kind == "clusterthrottle":
-                    term &= self._pod_ns_exists  # unknown namespace → no match
-                    if ns_pairs:
-                        term &= self._term_col_match(ns_pairs, self._ns_label)
-                match |= term
-        else:
+                    term.namespace_selector.validate()
+            match = np.zeros(self._pcap, dtype=bool)
+            for term in thr.spec.selector.selector_terms:
+                m = self._selector_col_match(term.pod_selector, self._pod_label)
+                if self.kind == "clusterthrottle":
+                    m &= self._pod_ns_exists  # unknown namespace → no match
+                    m &= self._selector_col_match(
+                        term.namespace_selector, self._ns_label
+                    )
+                match |= m
+        except SelectorError:
             match = np.zeros(self._pcap, dtype=bool)
             for key, row in self._pod_rows.items():
                 match[row] = self._eval_general(thr, self._row_pods[row])
@@ -333,19 +370,57 @@ class SelectorIndex:
             match &= self._pod_ns == self._ns_ids.id_of(thr.namespace)
         self.mask[:, col] = match
 
+    _NATIVE_OPS = {
+        "In": NativeRowEngine.OP_IN,
+        "NotIn": NativeRowEngine.OP_NOT_IN,
+        "Exists": NativeRowEngine.OP_EXISTS,
+        "DoesNotExist": NativeRowEngine.OP_DOES_NOT_EXIST,
+    }
+
+    def _native_reqs(self, selector) -> List[Tuple[int, int, Tuple[int, ...]]]:
+        """Compile one LabelSelector to native requirements; raises
+        SelectorError for invalid selectors (the caller routes those to the
+        general tier, which preserves the exact error-confinement
+        semantics of _eval_general)."""
+        selector.validate()
+        reqs = [
+            (
+                self._key_ids.id_of(k),
+                NativeRowEngine.OP_EQ,
+                (self._values.id_of(v),),
+            )
+            for k, v in selector.match_labels.items()
+        ]
+        for expr in selector.match_expressions:
+            reqs.append(
+                (
+                    self._key_ids.id_of(expr.key),
+                    self._NATIVE_OPS[expr.operator],
+                    tuple(self._values.id_of(v) for v in expr.values),
+                )
+            )
+        return reqs
+
     def _native_sync_col(self, col: int, thr: AnyThrottle) -> None:
-        """Compile a throttle's selector into the native engine's column."""
+        """Compile a throttle's selector into the native engine's column —
+        matchLabels AND matchExpressions (In/NotIn/Exists/DoesNotExist);
+        only selectors that fail validation stay on the Python general
+        tier."""
         assert self._native is not None
         thr_ns = self._ns_ids.id_of(thr.namespace) if isinstance(thr, Throttle) else -1
-        simple = _simple_terms(thr)
-        if simple is None:
+        try:
+            terms = []
+            for term in thr.spec.selector.selector_terms:
+                pr = self._native_reqs(term.pod_selector)
+                nr = (
+                    self._native_reqs(term.namespace_selector)
+                    if isinstance(thr, ClusterThrottle)
+                    else []
+                )
+                terms.append((pr, nr))
+        except SelectorError:
             self._native.set_col_general(col, thr_ns)
             return
-        terms = []
-        for pod_pairs, ns_pairs in simple:
-            pr = [(self._key_ids.id_of(k), self._values.id_of(v)) for k, v in pod_pairs.items()]
-            nr = [(self._key_ids.id_of(k), self._values.id_of(v)) for k, v in ns_pairs.items()]
-            terms.append((pr, nr))
         self._native.set_col(col, thr_ns, terms)
 
     def _match_row_arbitrary(self, pod: Pod) -> np.ndarray:

@@ -107,7 +107,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ktn_reserve.restype = None
     lib.ktn_set_col.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-        _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
+        _i32p, _i32p, _i32p, _i32p, _i32p,  # pod side (nested CSR)
+        _i32p, _i32p, _i32p, _i32p, _i32p,  # ns side
     ]
     lib.ktn_set_col.restype = None
     lib.ktn_set_col_general.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
@@ -203,32 +204,50 @@ class NativeRowEngine:
     def reserve(self, tcap: int) -> None:
         self._lib.ktn_reserve(self._h, tcap)
 
+    # operator codes — shared contract with the Op enum in ktnative.cpp
+    OP_EQ = 0
+    OP_IN = 1
+    OP_NOT_IN = 2
+    OP_EXISTS = 3
+    OP_DOES_NOT_EXIST = 4
+
+    @staticmethod
+    def _flatten_side(terms_side) -> Tuple[np.ndarray, ...]:
+        """Nested CSR for one selector side: terms_side is a list (per
+        term) of requirement lists [(key_id, op, (value_ids...))]."""
+        term_off = [0]
+        keys: List[int] = []
+        ops: List[int] = []
+        voff = [0]
+        vals: List[int] = []
+        for reqs in terms_side:
+            for key, op, values in reqs:
+                keys.append(key)
+                ops.append(op)
+                vals.extend(values)
+                voff.append(len(vals))
+            term_off.append(len(keys))
+        return (
+            _as_i32(term_off), _as_i32(keys), _as_i32(ops),
+            _as_i32(voff), _as_i32(vals),
+        )
+
     def set_col(
         self,
         col: int,
         thr_ns: int,
-        terms: Sequence[Tuple[Sequence[Tuple[int, int]], Sequence[Tuple[int, int]]]],
+        terms: Sequence[Tuple[Sequence[Tuple[int, int, Sequence[int]]],
+                              Sequence[Tuple[int, int, Sequence[int]]]]],
     ) -> None:
-        """terms: [(pod_reqs, ns_reqs)] with reqs as (key_id, value_id)."""
-        pod_off = [0]
-        ns_off = [0]
-        pod_keys: List[int] = []
-        pod_vals: List[int] = []
-        ns_keys: List[int] = []
-        ns_vals: List[int] = []
-        for pod_reqs, ns_reqs in terms:
-            for k, v in pod_reqs:
-                pod_keys.append(k)
-                pod_vals.append(v)
-            for k, v in ns_reqs:
-                ns_keys.append(k)
-                ns_vals.append(v)
-            pod_off.append(len(pod_keys))
-            ns_off.append(len(ns_keys))
+        """terms: [(pod_reqs, ns_reqs)] with reqs as
+        (key_id, op, value_ids) — op per the OP_* codes (matchLabels
+        entries are OP_EQ with one value)."""
+        pod_arrays = self._flatten_side([t[0] for t in terms])
+        ns_arrays = self._flatten_side([t[1] for t in terms])
         self._lib.ktn_set_col(
             self._h, col, thr_ns, len(terms),
-            _ptr(_as_i32(pod_off)), _ptr(_as_i32(pod_keys)), _ptr(_as_i32(pod_vals)),
-            _ptr(_as_i32(ns_off)), _ptr(_as_i32(ns_keys)), _ptr(_as_i32(ns_vals)),
+            *(_ptr(a) for a in pod_arrays),
+            *(_ptr(a) for a in ns_arrays),
         )
 
     def set_col_general(self, col: int, thr_ns: int) -> None:

@@ -8,14 +8,16 @@
 // the only O(#throttles) scalar loop left on the host — into C++.
 //
 // Model: Python keeps authority over interning (label keys/values/namespaces
-// → int32 ids), row/column allocation, and the general (matchExpressions)
-// tier.  Each throttle column is compiled here to its matchLabels-only
-// selector terms (selector.selecterTerms[] OR-ed, each term an AND of
-// (key,value) requirements — throttle_selector.go:30-54; ClusterThrottle
-// terms additionally AND a namespaceSelector, clusterthrottle_selector.go:
-// 112-141).  ktn_match_row evaluates one pod against every column in a
-// single call; columns that need the general tier are flagged back to
-// Python instead of being evaluated here.
+// → int32 ids), row/column allocation, and the general tier (selectors
+// whose validation fails — exact error-confinement semantics stay in
+// Python).  Each throttle column is compiled here to its selector terms
+// (selector.selecterTerms[] OR-ed, each term an AND of requirements —
+// throttle_selector.go:30-54; ClusterThrottle terms additionally AND a
+// namespaceSelector, clusterthrottle_selector.go:112-141).  Requirements
+// carry an operator: Eq (matchLabels) plus the full matchExpressions set
+// In / NotIn / Exists / DoesNotExist (metav1.LabelSelectorRequirement).
+// ktn_match_row evaluates one pod in a single call; columns flagged
+// general are evaluated back in Python.
 //
 // Semantics mirrored exactly (see SelectorIndex._match_one):
 //   - namespaced Throttle: pod.namespace must equal the throttle's namespace
@@ -46,9 +48,19 @@
 
 namespace {
 
+// operator codes (shared contract with native/__init__.py)
+enum Op : int32_t {
+  OP_EQ = 0,             // matchLabels entry: label == vals[0]
+  OP_IN = 1,             // label present and ∈ vals
+  OP_NOT_IN = 2,         // label absent, or ∉ vals
+  OP_EXISTS = 3,         // key present
+  OP_DOES_NOT_EXIST = 4, // key absent
+};
+
 struct Req {
   int32_t key;
-  int32_t val;
+  int32_t op;
+  std::vector<int32_t> vals;  // Eq: 1 entry; In/NotIn: ≥1; Exists/DNE: empty
 };
 
 struct Term {
@@ -97,12 +109,21 @@ void unindex_col(Engine* e, int32_t c) {
   }
 }
 
+bool has_exact_req(const Term& t) {
+  for (const Req& r : t.pod)
+    if (r.op == OP_EQ || (r.op == OP_IN && r.vals.size() == 1)) return true;
+  return false;
+}
+
 void index_col(Engine* e, int32_t c) {
   Col& col = e->cols[c];
   if (!col.valid) return;
+  // a term with no EXACT pod requirement (Eq / single-value In) cannot be
+  // bucketed by value — multi-In/NotIn/Exists/DoesNotExist/ns-only terms
+  // must be evaluated for every pod
   bool always = col.general;
   for (const Term& t : col.terms) {
-    if (t.pod.empty()) always = true;
+    if (!has_exact_req(t)) always = true;
   }
   if (always) {
     e->always.push_back(c);
@@ -110,27 +131,65 @@ void index_col(Engine* e, int32_t c) {
     return;  // evaluated unconditionally — bucket entries would be dead
   }
   for (const Term& t : col.terms) {
-    if (t.pod.empty()) continue;
-    uint64_t k = bucket_key(t.pod[0].key, t.pod[0].val);
-    auto& v = e->buckets[k];
-    if (std::find(v.begin(), v.end(), c) == v.end()) v.push_back(c);
-    col.bucket_keys.push_back(k);
+    // bucket by the term's first EXACT pod requirement (Eq, or In with one
+    // value): a pod lacking that (key,value) provably fails the term.
+    // Every term has one here — termless/inexact terms joined the always
+    // list above and returned.
+    for (const Req& r : t.pod) {
+      bool exact = (r.op == OP_EQ) || (r.op == OP_IN && r.vals.size() == 1);
+      if (!exact) continue;
+      uint64_t k = bucket_key(r.key, r.vals[0]);
+      auto& v = e->buckets[k];
+      if (std::find(v.begin(), v.end(), c) == v.end()) v.push_back(c);
+      col.bucket_keys.push_back(k);
+      break;
+    }
   }
 }
 
 // All requirements satisfied by the (keys,vals) label set?  Label sets are
 // small (a handful of entries), so a linear probe beats hashing.
+// Semantics mirror LabelSelector.matches (api/types.py:303-322).
 bool pairs_match(const std::vector<Req>& reqs, const int32_t* keys,
                  const int32_t* vals, int32_t n) {
   for (const Req& r : reqs) {
-    bool ok = false;
+    int32_t label_val = 0;
+    bool present = false;
     for (int32_t i = 0; i < n; ++i) {
       if (keys[i] == r.key) {
-        ok = (vals[i] == r.val);
+        present = true;
+        label_val = vals[i];
         break;
       }
     }
-    if (!ok) return false;
+    switch (r.op) {
+      case OP_EQ:
+        if (!present || label_val != r.vals[0]) return false;
+        break;
+      case OP_IN: {
+        if (!present) return false;
+        bool in = false;
+        for (int32_t v : r.vals)
+          if (v == label_val) { in = true; break; }
+        if (!in) return false;
+        break;
+      }
+      case OP_NOT_IN: {
+        if (present) {
+          for (int32_t v : r.vals)
+            if (v == label_val) return false;
+        }
+        break;
+      }
+      case OP_EXISTS:
+        if (!present) return false;
+        break;
+      case OP_DOES_NOT_EXIST:
+        if (present) return false;
+        break;
+      default:
+        return false;  // unknown op never compiles; defensive
+    }
   }
   return true;
 }
@@ -152,13 +211,32 @@ void ktn_reserve(void* h, int32_t tcap) {
   if (static_cast<int32_t>(e->cols.size()) < tcap) e->cols.resize(tcap);
 }
 
-// Compile a matchLabels-only column.  Terms arrive flattened CSR-style:
-// term t's pod requirements are (pod_keys,pod_vals)[pod_off[t]..pod_off[t+1])
-// and its namespace requirements the same over ns_off/ns_keys/ns_vals.
+namespace {
+// Decode one side's nested CSR: term t's requirements are indices
+// [term_off[t], term_off[t+1]) into (req_key, req_op, req_voff); each
+// requirement r's values are req_vals[req_voff[r]..req_voff[r+1]).
+void decode_reqs(std::vector<Req>* out, int32_t t, const int32_t* term_off,
+                 const int32_t* req_key, const int32_t* req_op,
+                 const int32_t* req_voff, const int32_t* req_vals) {
+  for (int32_t r = term_off[t]; r < term_off[t + 1]; ++r) {
+    Req req;
+    req.key = req_key[r];
+    req.op = req_op[r];
+    for (int32_t v = req_voff[r]; v < req_voff[r + 1]; ++v)
+      req.vals.push_back(req_vals[v]);
+    out->push_back(std::move(req));
+  }
+}
+}  // namespace
+
+// Compile a column.  Both selector sides arrive as nested CSR (see
+// decode_reqs); operator codes per the Op enum.
 void ktn_set_col(void* h, int32_t col, int32_t thr_ns, int32_t n_terms,
-                 const int32_t* pod_off, const int32_t* pod_keys,
-                 const int32_t* pod_vals, const int32_t* ns_off,
-                 const int32_t* ns_keys, const int32_t* ns_vals) {
+                 const int32_t* pod_term_off, const int32_t* pod_req_key,
+                 const int32_t* pod_req_op, const int32_t* pod_req_voff,
+                 const int32_t* pod_req_vals, const int32_t* ns_term_off,
+                 const int32_t* ns_req_key, const int32_t* ns_req_op,
+                 const int32_t* ns_req_voff, const int32_t* ns_req_vals) {
   Engine* e = static_cast<Engine*>(h);
   if (col >= static_cast<int32_t>(e->cols.size())) e->cols.resize(col + 1);
   unindex_col(e, col);
@@ -170,17 +248,19 @@ void ktn_set_col(void* h, int32_t col, int32_t thr_ns, int32_t n_terms,
   c.terms.reserve(n_terms);
   for (int32_t t = 0; t < n_terms; ++t) {
     Term term;
-    for (int32_t i = pod_off[t]; i < pod_off[t + 1]; ++i)
-      term.pod.push_back({pod_keys[i], pod_vals[i]});
-    for (int32_t i = ns_off[t]; i < ns_off[t + 1]; ++i)
-      term.ns.push_back({ns_keys[i], ns_vals[i]});
+    decode_reqs(&term.pod, t, pod_term_off, pod_req_key, pod_req_op,
+                pod_req_voff, pod_req_vals);
+    decode_reqs(&term.ns, t, ns_term_off, ns_req_key, ns_req_op, ns_req_voff,
+                ns_req_vals);
     c.terms.push_back(std::move(term));
   }
   index_col(e, col);
 }
 
-// Column whose selector needs the Python general tier (matchExpressions /
-// parse errors).  The namespace gate still applies natively.
+// Column whose selector needs the Python general tier (selectors that fail
+// validation — exact error-confinement semantics stay in Python; valid
+// matchExpressions compile natively via ktn_set_col).  The namespace gate
+// still applies natively.
 void ktn_set_col_general(void* h, int32_t col, int32_t thr_ns) {
   Engine* e = static_cast<Engine*>(h);
   if (col >= static_cast<int32_t>(e->cols.size())) e->cols.resize(col + 1);

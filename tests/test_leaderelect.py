@@ -113,8 +113,10 @@ class TestHttpLeaseElector:
     def _elector(self, apiserver, identity, **kw):
         from kube_throttler_tpu.client.transport import ApiClient, RestConfig
 
-        kw.setdefault("lease_duration", 0.6)
-        kw.setdefault("renew_period", 0.15)
+        # generous margins: the renewer must never miss a whole
+        # lease_duration under CI load, or tests flake
+        kw.setdefault("lease_duration", 1.5)
+        kw.setdefault("renew_period", 0.2)
         kw.setdefault("retry_period", 0.05)
         return HttpLeaseElector(
             ApiClient(RestConfig(server=apiserver.url)),
@@ -184,7 +186,7 @@ class TestHttpLeaseElector:
         assert a.acquire()
         b = self._elector(apiserver, "replica-b")
         # well past lease_duration: the renewer must have kept it fresh
-        time.sleep(1.0)
+        time.sleep(2.0)
         assert not b.try_acquire()
         assert a.is_leader
         a.release()

@@ -69,7 +69,7 @@ def test_native_engine_loads():
 def test_engine_matchlabels_semantics():
     eng = NativeRowEngine("throttle")
     # col 0: ns 7, one term {1:2}; col 1: ns 7, empty selector (no terms)
-    eng.set_col(0, 7, [([(1, 2)], [])])
+    eng.set_col(0, 7, [([(1, NativeRowEngine.OP_EQ, (2,))], [])])
     eng.set_col(1, 7, [])
     # col 2: empty TERM — matches every pod in ns 7
     eng.set_col(2, 7, [([], [])])
@@ -85,7 +85,7 @@ def test_engine_matchlabels_semantics():
 
 def test_engine_cluster_ns_gate():
     eng = NativeRowEngine("clusterthrottle")
-    eng.set_col(0, -1, [([(1, 1)], [(5, 6)])])
+    eng.set_col(0, -1, [([(1, NativeRowEngine.OP_EQ, (1,))], [(5, NativeRowEngine.OP_EQ, (6,))])])
     eng.set_col_general(1, -1)
     # namespace labels must satisfy the ns requirement
     match, general = eng.match_row(0, True, {1: 1}, {5: 6})
@@ -99,7 +99,7 @@ def test_engine_cluster_ns_gate():
 
 def test_engine_clear_and_or_terms():
     eng = NativeRowEngine("throttle")
-    eng.set_col(0, 1, [([(1, 1)], []), ([(2, 2)], [])])  # OR of two terms
+    eng.set_col(0, 1, [([(1, NativeRowEngine.OP_EQ, (1,))], []), ([(2, NativeRowEngine.OP_EQ, (2,))], [])])  # OR of two terms
     match, _ = eng.match_row(1, True, {2: 2}, {})
     assert match[0] == 1
     eng.clear_col(0)
@@ -107,16 +107,23 @@ def test_engine_clear_and_or_terms():
     assert match[0] == 0
 
 
+def _rand_expr(rng, keys, values):
+    op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])
+    if op in ("In", "NotIn"):
+        vals = tuple(
+            rng.choice(values) for _ in range(rng.randint(1, len(values)))
+        )
+    else:
+        vals = ()
+    return LabelSelectorRequirement(key=rng.choice(keys), operator=op, values=vals)
+
+
 def _rand_term(rng, keys, values, with_ns):
     pod_sel = LabelSelector(
         match_labels={rng.choice(keys): rng.choice(values) for _ in range(rng.randint(0, 2))},
         match_expressions=(
-            (
-                LabelSelectorRequirement(
-                    key=rng.choice(keys), operator="In", values=(rng.choice(values),)
-                ),
-            )
-            if rng.random() < 0.3
+            tuple(_rand_expr(rng, keys, values) for _ in range(rng.randint(1, 2)))
+            if rng.random() < 0.4
             else ()
         ),
     )
@@ -126,6 +133,64 @@ def _rand_term(rng, keys, values, with_ns):
         )
         return ClusterThrottleSelectorTerm(pod_selector=pod_sel, namespace_selector=ns_sel)
     return ThrottleSelectorTerm(pod_selector=pod_sel)
+
+
+def test_match_expressions_compile_natively():
+    """In/NotIn/Exists/DoesNotExist evaluate in the C++ tier (no general
+    flag); only selectors failing validation stay general."""
+    idx = SelectorIndex("throttle", use_native=True)
+    assert idx._native is not None
+    idx.upsert_namespace(Namespace("default"))
+    exprs = {
+        "in": LabelSelectorRequirement("tier", "In", ("web", "api")),
+        "notin": LabelSelectorRequirement("tier", "NotIn", ("db",)),
+        "exists": LabelSelectorRequirement("canary", "Exists"),
+        "dne": LabelSelectorRequirement("legacy", "DoesNotExist"),
+    }
+    for name, expr in exprs.items():
+        idx.upsert_throttle(
+            _throttle(name, "default", [
+                ThrottleSelectorTerm(LabelSelector(match_expressions=(expr,)))
+            ])
+        )
+    # evaluate via the row path and compare against the Python oracle
+    for labels in (
+        {"tier": "web"},
+        {"tier": "db"},
+        {"canary": "yes"},
+        {"legacy": "x", "tier": "api"},
+        {},
+    ):
+        pod = _pod("probe", "default", labels)
+        got = set(idx.affected_throttle_keys_for(pod))
+        want = {
+            t.key
+            for t in [
+                _throttle(n, "default", [
+                    ThrottleSelectorTerm(LabelSelector(match_expressions=(e,)))
+                ])
+                for n, e in exprs.items()
+            ]
+            if t.spec.selector.matches_to_pod(pod)
+        }
+        assert got == want, (labels, got, want)
+
+
+def test_invalid_selector_stays_general_and_matches_nothing():
+    idx = SelectorIndex("throttle", use_native=True)
+    idx.upsert_namespace(Namespace("default"))
+    bad = _throttle("bad", "default", [
+        ThrottleSelectorTerm(
+            LabelSelector(
+                match_expressions=(
+                    LabelSelectorRequirement("k", "In", ()),  # In needs values
+                )
+            )
+        )
+    ])
+    idx.upsert_throttle(bad)
+    pod = _pod("p", "default", {"k": "v"})
+    assert idx.affected_throttle_keys_for(pod) == []
 
 
 @pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
