@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import socket
 import ssl
 import threading
@@ -94,13 +95,50 @@ class RestConfig:
     token: str = ""
     verify_tls: bool = True
     ca_file: str = ""
+    cert_file: str = ""  # client certificate (mTLS auth)
+    key_file: str = ""
+
+
+# temp files holding decoded kubeconfig credential material — removed at
+# interpreter exit so private keys never outlive the process on disk
+_materialized_credentials: List[str] = []
+
+
+def _cleanup_materialized() -> None:
+    for path in _materialized_credentials:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    _materialized_credentials.clear()
+
+
+def _inline_or_file(data_b64: str, file_path: str, suffix: str) -> str:
+    """kubeconfigs carry credentials either as file paths or inline base64
+    ``*-data`` fields; materialize inline data to a private (0600) temp
+    file so the ssl module (which only takes paths) can load it. The file
+    is deleted at interpreter exit — decoded private keys must not persist
+    on disk beyond the process."""
+    if data_b64:
+        import atexit
+        import base64
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(suffix=suffix)
+        with os.fdopen(fd, "wb") as f:
+            f.write(base64.b64decode(data_b64))
+        if not _materialized_credentials:
+            atexit.register(_cleanup_materialized)
+        _materialized_credentials.append(tmp)
+        return tmp
+    return file_path
 
 
 def parse_kubeconfig(path: str) -> RestConfig:
     """Minimal kubeconfig loader: current-context → cluster server + user
-    token. Client certs are not supported (token / insecure only); a
-    cluster with ``insecure-skip-tls-verify`` or plain http works for the
-    integration tier."""
+    credentials. Supports bearer tokens AND client certificates (both as
+    file paths and inline ``*-data`` base64); exec/auth-provider plugins
+    are not supported."""
     import yaml
 
     with open(path) as f:
@@ -127,7 +165,21 @@ def parse_kubeconfig(path: str) -> RestConfig:
         server=str(cluster.get("server", "")).rstrip("/"),
         token=str(user.get("token", "") or ""),
         verify_tls=not bool(cluster.get("insecure-skip-tls-verify")),
-        ca_file=str(cluster.get("certificate-authority", "") or ""),
+        ca_file=_inline_or_file(
+            str(cluster.get("certificate-authority-data", "") or ""),
+            str(cluster.get("certificate-authority", "") or ""),
+            ".ca.crt",
+        ),
+        cert_file=_inline_or_file(
+            str(user.get("client-certificate-data", "") or ""),
+            str(user.get("client-certificate", "") or ""),
+            ".client.crt",
+        ),
+        key_file=_inline_or_file(
+            str(user.get("client-key-data", "") or ""),
+            str(user.get("client-key", "") or ""),
+            ".client.key",
+        ),
     )
 
 
@@ -147,18 +199,30 @@ class ApiClient:
         self._scheme = split.scheme
         self._host = split.hostname or "127.0.0.1"
         self._port = split.port or (443 if self._scheme == "https" else 80)
+        # one SSLContext per client: RestConfig is frozen, so re-reading and
+        # re-parsing the CA/cert/key PEMs per request would be pure waste
+        # on the status-write hot path
+        self._ssl_ctx = None
+        if self._scheme == "https":
+            if config.verify_tls:
+                self._ssl_ctx = ssl.create_default_context(
+                    cafile=config.ca_file or None
+                )
+            else:
+                self._ssl_ctx = ssl._create_unverified_context()
+            if config.cert_file:
+                # mTLS client auth (kubeconfig client-certificate/key)
+                self._ssl_ctx.load_cert_chain(
+                    config.cert_file, config.key_file or None
+                )
 
     # -- connection plumbing ----------------------------------------------
 
     def _connect(self, timeout: float):
         if self._scheme == "https":
-            if self.config.verify_tls:
-                ctx = ssl.create_default_context(
-                    cafile=self.config.ca_file or None
-                )
-            else:
-                ctx = ssl._create_unverified_context()
-            return HTTPSConnection(self._host, self._port, timeout=timeout, context=ctx)
+            return HTTPSConnection(
+                self._host, self._port, timeout=timeout, context=self._ssl_ctx
+            )
         return HTTPConnection(self._host, self._port, timeout=timeout)
 
     def _headers(self) -> Dict[str, str]:

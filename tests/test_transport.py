@@ -107,6 +107,37 @@ users:
         assert cfg.token == "sekrit"
         assert cfg.verify_tls
 
+    def test_parse_client_certs_inline_and_file(self, tmp_path):
+        """client-certificate/key as file paths AND as inline *-data base64
+        (the shape kubeadm/minikube kubeconfigs actually use)."""
+        import base64
+
+        cert_file = tmp_path / "crt.pem"
+        cert_file.write_text("CERT")
+        path = tmp_path / "kubeconfig"
+        path.write_text(
+            f"""
+clusters:
+- name: c1
+  cluster:
+    server: https://h:6443
+    certificate-authority-data: {base64.b64encode(b"CADATA").decode()}
+contexts:
+- name: ctx
+  context: {{cluster: c1, user: u1}}
+current-context: ctx
+users:
+- name: u1
+  user:
+    client-certificate: {cert_file}
+    client-key-data: {base64.b64encode(b"KEYDATA").decode()}
+"""
+        )
+        cfg = parse_kubeconfig(str(path))
+        assert cfg.cert_file == str(cert_file)
+        assert open(cfg.key_file, "rb").read() == b"KEYDATA"
+        assert open(cfg.ca_file, "rb").read() == b"CADATA"
+
     def test_parse_first_context_when_current_missing(self, tmp_path):
         path = tmp_path / "kubeconfig"
         path.write_text(
