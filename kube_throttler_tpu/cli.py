@@ -173,6 +173,15 @@ def main(argv: Optional[list] = None) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
 
+    rest_config = None
+    if plugin_args.kubeconfig:
+        from .client.transport import parse_kubeconfig
+
+        # parse ONCE, up front: the loader is side-effectful (inline
+        # *-data credentials materialize to memfds/tempfiles) and the
+        # elector and the reflector session share the same RestConfig
+        rest_config = parse_kubeconfig(plugin_args.kubeconfig)
+
     elector = None
     if leader_elect:
         if plugin_args.kubeconfig and not args.lock_file:
@@ -182,7 +191,7 @@ def main(argv: Optional[list] = None) -> int:
             import os as _os
             import socket
 
-            from .client.transport import ApiClient, parse_kubeconfig
+            from .client.transport import ApiClient
             from .utils.leaderelect import HttpLeaseElector
 
             def _leadership_lost():
@@ -192,7 +201,7 @@ def main(argv: Optional[list] = None) -> int:
                 stop.set()
 
             elector = HttpLeaseElector(
-                ApiClient(parse_kubeconfig(plugin_args.kubeconfig)),
+                ApiClient(rest_config),
                 name=f"kube-throttler-tpu-{plugin_args.name}",
                 identity=f"{socket.gethostname()}-{_os.getpid()}",
                 on_lost=_leadership_lost,
@@ -218,10 +227,10 @@ def main(argv: Optional[list] = None) -> int:
     store = Store()
     session = None
     journal = None
-    if plugin_args.kubeconfig:
+    if rest_config is not None:
         from .client.transport import RemoteSession
 
-        session = RemoteSession.from_kubeconfig(plugin_args.kubeconfig, store)
+        session = RemoteSession(rest_config, store)
         print(
             f"syncing from apiserver {session.config.server} "
             f"(kubeconfig={plugin_args.kubeconfig})...",

@@ -99,8 +99,11 @@ class RestConfig:
     key_file: str = ""
 
 
-# temp files holding decoded kubeconfig credential material — removed at
-# interpreter exit so private keys never outlive the process on disk
+# decoded kubeconfig credential material: memfd-backed on Linux (never
+# touches disk, gone with the process no matter how it dies); tempfile
+# fallback elsewhere, cleaned at interpreter exit (best-effort — atexit
+# does not run on SIGKILL, which is the memfd path's whole point)
+_credential_fds: List[int] = []  # keep memfds alive for the process
 _materialized_credentials: List[str] = []
 
 
@@ -115,23 +118,33 @@ def _cleanup_materialized() -> None:
 
 def _inline_or_file(data_b64: str, file_path: str, suffix: str) -> str:
     """kubeconfigs carry credentials either as file paths or inline base64
-    ``*-data`` fields; materialize inline data to a private (0600) temp
-    file so the ssl module (which only takes paths) can load it. The file
-    is deleted at interpreter exit — decoded private keys must not persist
-    on disk beyond the process."""
-    if data_b64:
-        import atexit
-        import base64
-        import tempfile
+    ``*-data`` fields; the ssl module only takes paths, so inline data is
+    materialized — into an anonymous memfd exposed via /proc/self/fd on
+    Linux (a path that works in-process and can never outlive it), or a
+    0600 temp file with atexit cleanup as the portable fallback."""
+    if not data_b64:
+        return file_path
+    import base64
 
-        fd, tmp = tempfile.mkstemp(suffix=suffix)
-        with os.fdopen(fd, "wb") as f:
-            f.write(base64.b64decode(data_b64))
-        if not _materialized_credentials:
-            atexit.register(_cleanup_materialized)
-        _materialized_credentials.append(tmp)
-        return tmp
-    return file_path
+    raw = base64.b64decode(data_b64)
+    if hasattr(os, "memfd_create"):
+        try:
+            fd = os.memfd_create(f"kubeconfig{suffix}")
+            os.write(fd, raw)
+            _credential_fds.append(fd)  # must stay open for the path to resolve
+            return f"/proc/self/fd/{fd}"
+        except OSError:
+            pass  # fall through to the tempfile path
+    import atexit
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(suffix=suffix)
+    with os.fdopen(fd, "wb") as f:
+        f.write(raw)
+    if not _materialized_credentials:
+        atexit.register(_cleanup_materialized)
+    _materialized_credentials.append(tmp)
+    return tmp
 
 
 def parse_kubeconfig(path: str) -> RestConfig:
@@ -199,27 +212,44 @@ class ApiClient:
         self._scheme = split.scheme
         self._host = split.hostname or "127.0.0.1"
         self._port = split.port or (443 if self._scheme == "https" else 80)
-        # one SSLContext per client: RestConfig is frozen, so re-reading and
-        # re-parsing the CA/cert/key PEMs per request would be pure waste
-        # on the status-write hot path
+        # SSLContext cached per credential-file mtimes: re-parsing PEMs per
+        # request would burden the status-write hot path, but a fully
+        # static context would hold expired certs across on-disk rotation
+        # (kubeadm renewal) for the process lifetime — a cheap stat per
+        # connect picks up rotated files and rebuilds only then
         self._ssl_ctx = None
+        self._ssl_ctx_stamp = None
         if self._scheme == "https":
-            if config.verify_tls:
-                self._ssl_ctx = ssl.create_default_context(
-                    cafile=config.ca_file or None
-                )
-            else:
-                self._ssl_ctx = ssl._create_unverified_context()
-            if config.cert_file:
-                # mTLS client auth (kubeconfig client-certificate/key)
-                self._ssl_ctx.load_cert_chain(
-                    config.cert_file, config.key_file or None
-                )
+            self._ssl_ctx = self._build_ssl_ctx()
+
+    def _cred_stamp(self):
+        def mtime(path):
+            try:
+                return os.stat(path).st_mtime_ns
+            except OSError:
+                return None
+
+        cfg = self.config
+        return tuple(mtime(p) for p in (cfg.ca_file, cfg.cert_file, cfg.key_file) if p)
+
+    def _build_ssl_ctx(self):
+        cfg = self.config
+        if cfg.verify_tls:
+            ctx = ssl.create_default_context(cafile=cfg.ca_file or None)
+        else:
+            ctx = ssl._create_unverified_context()
+        if cfg.cert_file:
+            # mTLS client auth (kubeconfig client-certificate/key)
+            ctx.load_cert_chain(cfg.cert_file, cfg.key_file or None)
+        self._ssl_ctx_stamp = self._cred_stamp()
+        return ctx
 
     # -- connection plumbing ----------------------------------------------
 
     def _connect(self, timeout: float):
         if self._scheme == "https":
+            if self._ssl_ctx_stamp != self._cred_stamp():
+                self._ssl_ctx = self._build_ssl_ctx()  # credentials rotated
             return HTTPSConnection(
                 self._host, self._port, timeout=timeout, context=self._ssl_ctx
             )

@@ -36,3 +36,57 @@ assert jax.local_device_count() == 8, (
     f"tests assume an 8-device mesh; ambient XLA_FLAGS pinned "
     f"{jax.local_device_count()} — unset xla_force_host_platform_device_count"
 )
+
+
+class ProcReader:
+    """Deadline-safe stdout scraping for daemon subprocesses: readline()
+    has no timeout, so a drain thread feeds a queue and callers poll with
+    deadlines. ONE reader per process — competing drain threads steal each
+    other's lines."""
+
+    def __init__(self, proc):
+        import queue
+        import threading
+
+        self.proc = proc
+        self.lines: "queue.Queue[str]" = queue.Queue()
+        self.seen: list = []
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self) -> None:
+        for line in self.proc.stdout:
+            self.lines.put(line)
+
+    def wait_for(self, pattern: str, timeout_s: float = 60.0) -> list:
+        """Block until a line matches ``pattern`` (regex); returns all lines
+        seen so far. Raises AssertionError (with the transcript) at the
+        deadline."""
+        import queue
+        import re
+        import time
+
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            try:
+                line = self.lines.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self.seen.append(line)
+            if re.search(pattern, line):
+                return list(self.seen)
+        raise AssertionError(f"pattern {pattern!r} not seen in {self.seen}")
+
+    def assert_absent(self, pattern: str, during_s: float) -> None:
+        """Drain for ``during_s`` asserting no line matches ``pattern``."""
+        import queue
+        import re
+        import time
+
+        deadline = time.time() + during_s
+        while time.time() < deadline:
+            try:
+                line = self.lines.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self.seen.append(line)
+            assert not re.search(pattern, line), (pattern, self.seen)

@@ -192,6 +192,77 @@ class TestHttpLeaseElector:
         a.release()
 
 
+class TestTwoDaemonFailover:
+    def test_two_daemons_fail_over_through_the_shared_apiserver(self, tmp_path):
+        """The VERDICT r2 task-8 done-bar, end to end: two REAL daemon
+        processes (separate workdirs, no shared filesystem state) compete
+        for the Lease on a shared apiserver; the standby only starts
+        serving after the leader dies."""
+        import re
+        import subprocess
+        import sys as _sys
+
+        from kube_throttler_tpu.client.mockserver import MockApiServer
+        from kube_throttler_tpu.api.pod import Namespace
+
+        apiserver = MockApiServer()
+        apiserver.store.create_namespace(Namespace("default"))
+        apiserver.start()
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(
+            f"clusters:\n- name: m\n  cluster: {{server: \"{apiserver.url}\"}}\n"
+            "contexts:\n- name: m\n  context: {cluster: m}\ncurrent-context: m\n"
+        )
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def launch(workdir):
+            workdir.mkdir()
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            return subprocess.Popen(
+                [
+                    _sys.executable, "-m", "kube_throttler_tpu.cli", "serve",
+                    "--name", "kube-throttler",
+                    "--target-scheduler-name", "my-scheduler",
+                    "--kubeconfig", str(kubeconfig), "--leader-elect",
+                    "--port", "0", "--no-device",
+                ],
+                cwd=workdir,  # separate workdirs: nothing shared but the apiserver
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+
+        from tests.conftest import ProcReader
+
+        a = b = None
+        try:
+            a = launch(tmp_path / "daemon-a")
+            ra = ProcReader(a)
+            ra.wait_for(r"serving on")  # A acquired and serves
+
+            b = launch(tmp_path / "daemon-b")
+            rb = ProcReader(b)
+            rb.wait_for(r"waiting")
+            # mutual exclusion: B must NOT start serving while A holds the
+            # lease (keep draining — a vacuous check on already-seen lines
+            # would pass even if both replicas acquired)
+            rb.assert_absent(r"serving on", during_s=3.0)
+
+            a.kill()  # crash, no release — failover must come from expiry
+            a.wait(timeout=10)
+            # default leaseDuration is 15s; B takes over after expiry
+            rb.wait_for(r"serving on", timeout_s=60)
+        finally:
+            for p in (a, b):
+                if p is not None:
+                    p.kill()
+                    p.wait(timeout=10)
+            apiserver.stop()
+
+
 def test_cli_wires_leader_election(tmp_path, monkeypatch):
     """`serve --leader-elect` blocks behind a held lease and starts once it
     frees (driven via SIGINT→stop to keep the test fast)."""

@@ -406,33 +406,19 @@ class TestStandaloneWireServer:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         try:
-            # drain stdout on a side thread: readline() has no timeout, and
-            # a daemon that stalls mid-startup must fail the assert at the
-            # deadline instead of hanging the suite
-            import queue
-            import threading as _threading
+            from tests.conftest import ProcReader
 
-            lines: queue.Queue = queue.Queue()
-
-            def drain():
-                for line in proc.stdout:
-                    lines.put(line)
-
-            _threading.Thread(target=drain, daemon=True).start()
+            reader = ProcReader(proc)
+            lines = reader.wait_for(r"serving on [^:]+:\d+", timeout_s=60)
             wire_port = api_port = None
-            deadline = time.time() + 60
-            while time.time() < deadline and (wire_port is None or api_port is None):
-                try:
-                    line = lines.get(timeout=0.5)
-                except queue.Empty:
-                    continue
+            for line in lines:
                 m = re.search(r"wire-protocol apiserver on [^:]+:(\d+)", line)
                 if m:
                     wire_port = int(m.group(1))
                 m = re.search(r"serving on [^:]+:(\d+)", line)
                 if m:
                     api_port = int(m.group(1))
-            assert wire_port and api_port, "daemon did not start"
+            assert wire_port and api_port, f"daemon did not start: {lines}"
 
             def post(doc):
                 req = urllib.request.Request(
