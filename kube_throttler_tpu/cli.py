@@ -227,10 +227,13 @@ def main(argv: Optional[list] = None) -> int:
     store = Store()
     session = None
     journal = None
+    from .metrics import Registry
+
+    metrics_registry = Registry()  # shared: reflector metrics + the 16 families
     if rest_config is not None:
         from .client.transport import RemoteSession
 
-        session = RemoteSession(rest_config, store)
+        session = RemoteSession(rest_config, store, metrics_registry=metrics_registry)
         print(
             f"syncing from apiserver {session.config.server} "
             f"(kubeconfig={plugin_args.kubeconfig})...",
@@ -264,6 +267,7 @@ def main(argv: Optional[list] = None) -> int:
         use_device=not args.no_device,
         start_workers=True,
         status_writer=session.status_writer if session is not None else None,
+        metrics_registry=metrics_registry,
     )
     scheduler = None
     if args.nodes > 0:

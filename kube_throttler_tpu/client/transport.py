@@ -396,6 +396,36 @@ class RemoteVersions:
             self._versions.pop((kind, key), None)
 
 
+class ReflectorMetrics:
+    """client-go reflector-metrics analog, exported through the daemon's
+    /metrics registry: lists/relists, watch (re)connects, events applied,
+    410 falls — the signals that tell an operator the watch path is
+    healthy vs thrashing."""
+
+    def __init__(self, registry) -> None:
+        self.lists = registry.counter_vec(
+            "kube_throttler_reflector_lists_total",
+            "LIST operations performed per kind (first sync + 410 relists)",
+            ["kind"],
+        )
+        self.watches = registry.counter_vec(
+            "kube_throttler_reflector_watches_total",
+            "WATCH streams opened per kind (reconnects included)",
+            ["kind"],
+        )
+        self.events = registry.counter_vec(
+            "kube_throttler_reflector_events_total",
+            "Watch events applied to the local cache per kind (bookmarks "
+            "and unknown types excluded)",
+            ["kind"],
+        )
+        self.gone = registry.counter_vec(
+            "kube_throttler_reflector_gone_total",
+            "410-expired resume points per kind (forced relists)",
+            ["kind"],
+        )
+
+
 class Reflector:
     """client-go reflector for one kind: ListAndWatch into the Store."""
 
@@ -406,16 +436,22 @@ class Reflector:
         store: Store,
         versions: Optional[RemoteVersions] = None,
         backoff: float = 1.0,
+        metrics: Optional[ReflectorMetrics] = None,
     ):
         self.client = client
         self.kind = kind
         self.store = store
         self.versions = versions
         self.backoff = backoff
+        self.metrics = metrics
         self.last_resource_version = "0"
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+    def _count(self, counter) -> None:
+        if self.metrics is not None:
+            counter(self.metrics).inc({"kind": self.kind})
 
     # -- store application -------------------------------------------------
 
@@ -516,6 +552,7 @@ class Reflector:
         else:
             logger.warning("reflector %s: unknown watch event %r", self.kind, etype)
             return
+        self._count(lambda m: m.events)  # applied to the cache (not bookmarks)
         if rv:
             self.last_resource_version = rv
 
@@ -524,6 +561,8 @@ class Reflector:
     def list_and_watch_once(self) -> None:
         """One LIST + one WATCH stream (until it ends). Split out for
         deterministic tests."""
+        self._count(lambda m: m.lists)
+        self._count(lambda m: m.watches)
         items, rv = self.client.list(self.kind)
         self._sync_list(items)
         self.last_resource_version = rv
@@ -534,6 +573,7 @@ class Reflector:
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
+                self._count(lambda m: m.lists)
                 items, rv = self.client.list(self.kind)
                 self._sync_list(items)
                 self.last_resource_version = rv
@@ -547,11 +587,13 @@ class Reflector:
             # watch → re-watch from last RV; Gone → fall through to relist
             while not self._stop.is_set():
                 try:
+                    self._count(lambda m: m.watches)
                     for event in self.client.watch(
                         self.kind, self.last_resource_version, stop=self._stop
                     ):
                         self._apply_event(event)
                 except GoneError:
+                    self._count(lambda m: m.gone)
                     logger.info(
                         "reflector %s: resourceVersion %s gone, relisting",
                         self.kind,
@@ -761,13 +803,18 @@ class RemoteSession:
 
     KINDS = ("Namespace", "Throttle", "ClusterThrottle", "Pod")
 
-    def __init__(self, config: RestConfig, store: Store):
+    def __init__(self, config: RestConfig, store: Store, metrics_registry=None):
         self.config = config
         self.store = store
         self.client = ApiClient(config)
         self.versions = RemoteVersions()
+        metrics = (
+            ReflectorMetrics(metrics_registry) if metrics_registry is not None else None
+        )
         self.reflectors = {
-            kind: Reflector(self.client, kind, store, versions=self.versions)
+            kind: Reflector(
+                self.client, kind, store, versions=self.versions, metrics=metrics
+            )
             for kind in self.KINDS
         }
         self.status_writer = RemoteStatusWriter(self.client, self.versions)

@@ -53,6 +53,16 @@ class GaugeVec:
             return dict(self._values)
 
 
+class CounterVec(GaugeVec):
+    """Monotonic counter family (exposition TYPE counter; use the
+    _total naming convention). ``inc`` is atomic under the family lock."""
+
+    def inc(self, labels: Dict[str, str], delta: float = 1.0) -> None:
+        key = tuple(labels[n] for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+
 class HistogramVec:
     """Prometheus histogram family: cumulative buckets + _sum/_count per
     label set. Backs the per-phase latency tracing (SURVEY §5's TPU-native
@@ -109,6 +119,7 @@ class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._gauges: Dict[str, GaugeVec] = {}
+        self._counters: Dict[str, CounterVec] = {}
         self._histograms: Dict[str, HistogramVec] = {}
 
     def gauge_vec(self, name: str, help_text: str, label_names: Sequence[str]) -> GaugeVec:
@@ -118,6 +129,14 @@ class Registry:
             g = GaugeVec(name, help_text, label_names)
             self._gauges[name] = g
             return g
+
+    def counter_vec(self, name: str, help_text: str, label_names: Sequence[str]) -> CounterVec:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            c = CounterVec(name, help_text, label_names)
+            self._counters[name] = c
+            return c
 
     def histogram_vec(
         self,
@@ -146,15 +165,17 @@ class Registry:
         lines = []
         with self._lock:
             gauges = list(self._gauges.values())
+            counters = list(self._counters.values())
             histograms = list(self._histograms.values())
-        for g in gauges:
-            lines.append(f"# HELP {g.name} {g.help}")
-            lines.append(f"# TYPE {g.name} gauge")
-            for key, value in sorted(g.collect().items()):
-                labels = ",".join(
-                    f'{n}="{esc(v)}"' for n, v in zip(g.label_names, key)
-                )
-                lines.append(f"{g.name}{{{labels}}} {fmt(value)}")
+        for family, ptype in [(gauges, "gauge"), (counters, "counter")]:
+            for g in family:
+                lines.append(f"# HELP {g.name} {g.help}")
+                lines.append(f"# TYPE {g.name} {ptype}")
+                for key, value in sorted(g.collect().items()):
+                    labels = ",".join(
+                        f'{n}="{esc(v)}"' for n, v in zip(g.label_names, key)
+                    )
+                    lines.append(f"{g.name}{{{labels}}} {fmt(value)}")
         for h in histograms:
             lines.append(f"# HELP {h.name} {h.help}")
             lines.append(f"# TYPE {h.name} histogram")

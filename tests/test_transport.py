@@ -218,6 +218,24 @@ class TestListWatch:
         finally:
             server.stop()
 
+    def test_reflector_metrics_exported(self, apiserver):
+        from kube_throttler_tpu.metrics import Registry
+
+        registry = Registry()
+        local = Store()
+        session = RemoteSession(
+            RestConfig(server=apiserver.url), local, metrics_registry=registry
+        )
+        session.start(sync_timeout=10)
+        try:
+            apiserver.store.create_pod(_bound(make_pod("p1")))
+            assert _wait(lambda: len(local.list_pods()) == 1)
+            expo = registry.exposition()
+            assert 'kube_throttler_reflector_lists_total{kind="Pod"}' in expo
+            assert 'kube_throttler_reflector_events_total{kind="Pod"}' in expo
+        finally:
+            session.stop()
+
     def test_reflector_recovers_from_410_by_relisting(self):
         server = MockApiServer(log_size=4, bookmark_interval=0.05)
         server.start()
