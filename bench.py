@@ -15,8 +15,12 @@ Resilience: the tunnel backend can be transiently unavailable. Before any
 in-process backend touch, a subprocess probe retries ``jax.devices()`` with
 bounded exponential backoff; if the platform never comes up the bench
 re-execs itself on CPU (degraded, flagged in the JSON). Every config is
-individually fenced so a single failure cannot cost the run its output:
-the final JSON line is ALWAYS printed.
+individually fenced so a single failure cannot cost the run its output;
+and because the tunnel can also drop MID-RUN (wedging a blocking device
+call forever, which no exception fence can catch), a global watchdog
+thread (KT_BENCH_DEADLINE_S, default 1800s) emits the best-so-far JSON
+line at the deadline and exits — rc=0 if a usable measurement (value>0)
+made it out, rc=1 otherwise. The final JSON line is ALWAYS printed.
 
 Run: python bench.py            (ambient platform — TPU in CI)
      python bench.py --quick    (scaled-down shapes for smoke runs)
@@ -26,6 +30,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 from datetime import datetime, timezone
@@ -55,6 +60,72 @@ GiB_m = 1024**3 * 1000  # 1Gi in milli-units
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------- global watchdog
+#
+# The tunnel backend can drop MID-RUN, leaving a blocking device call stuck
+# forever — a hang the per-config fences cannot catch (the exception never
+# raises). The contract is ONE JSON line no matter what, so a deadline
+# thread snapshots whatever has been measured so far and emits it. State
+# the emitter needs is progressively published into RESULT_STATE by main().
+
+RESULT_STATE: dict = {"detail": {}, "errors": {}}
+_EMITTED = threading.Event()
+_EMIT_LOCK = threading.Lock()
+_DEADLINE: list = [None]  # [monotonic deadline] once main() sets it
+
+
+def time_left() -> float:
+    return float("inf") if _DEADLINE[0] is None else _DEADLINE[0] - time.monotonic()
+
+
+def emit(out: dict) -> bool:
+    """Print the one JSON line exactly once, whoever gets there first.
+
+    Atomic test-and-set: the watchdog and the main thread can race here at
+    the deadline boundary, and two JSON lines would break the driver's
+    single-line contract.
+    """
+    with _EMIT_LOCK:
+        if _EMITTED.is_set():
+            return False
+        _EMITTED.set()
+    print(json.dumps(out), flush=True)
+    return True
+
+
+def _watchdog_main(margin: float = 30.0) -> None:
+    while not _EMITTED.is_set():
+        left = time_left() - margin
+        if left <= 0:
+            break
+        time.sleep(min(left, 5.0))
+    if _EMITTED.is_set():
+        return
+    log("WATCHDOG: deadline reached; emitting best-so-far result and exiting")
+    RESULT_STATE["errors"]["watchdog"] = "global deadline hit; remaining configs skipped"
+    try:
+        out = build_result()
+    except BaseException as e:  # noqa: BLE001 — last resort, never die silently
+        out = {
+            "metric": "bench deadline hit before any measurement",
+            "value": -1.0,
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "error": f"{e.__class__.__name__}: {str(e)[:200]}",
+        }
+    emit(out)
+    # A wedged device call cannot be unwound; exit hard. rc=0 only if a
+    # usable partial measurement made it out (same contract as __main__).
+    os._exit(0 if out.get("value", -1.0) > 0 else 1)
+
+
+def start_watchdog() -> None:
+    budget = float(os.environ.get("KT_BENCH_DEADLINE_S", "1800"))
+    _DEADLINE[0] = time.monotonic() + budget
+    t = threading.Thread(target=_watchdog_main, name="bench-watchdog", daemon=True)
+    t.start()
 
 
 # ------------------------------------------------------------- backend init
@@ -110,6 +181,12 @@ def init_devices_or_reexec():
             raise
         log(f"in-process backend init failed ({str(e)[:200]}); re-exec on CPU")
         env = {**os.environ, "JAX_PLATFORMS": "cpu", "KT_BENCH_CPU_FALLBACK": "1"}
+        # Carry the REMAINING deadline into the child: a fresh process would
+        # re-read the full budget and the combined wall time could outlive
+        # the external harness timeout — the exact hang-with-no-JSON-line
+        # failure the watchdog exists to prevent.
+        if time_left() != float("inf"):
+            env["KT_BENCH_DEADLINE_S"] = str(max(60.0, time_left()))
         os.execvpe(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
 
 
@@ -902,9 +979,10 @@ def bench_selector_index(label, T=10_000, n_pods=200):
 def main():
     quick = "--quick" in sys.argv
     rng = np.random.default_rng(0)
+    start_watchdog()
 
-    detail: dict = {}
-    errors: dict = {}
+    detail: dict = RESULT_STATE["detail"]
+    errors: dict = RESULT_STATE["errors"]
 
     def safe(name, fn, *a, **k):
         """Fence one config: a failure records an error but never kills the run."""
@@ -921,7 +999,10 @@ def main():
         # the down tunnel again would just burn the whole backoff budget.
         degraded = True
     else:
-        degraded = not ensure_backend(max_wait=120.0 if quick else 600.0)
+        # Leave at least ~8 minutes of deadline for the degraded CPU quick
+        # run (measured ~6 min end-to-end) if the probe burns its budget.
+        probe_budget = min(120.0 if quick else 600.0, max(60.0, time_left() - 480.0))
+        degraded = not ensure_backend(max_wait=probe_budget)
         if degraded:
             log("backend never came up; degrading to CPU for this run")
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -929,9 +1010,11 @@ def main():
                 jax.config.update("jax_platforms", "cpu")
             except Exception:
                 pass
+    RESULT_STATE["degraded"] = degraded
     devices = safe("init", init_devices_or_reexec)
     log(f"devices: {devices}")
     platform = devices[0].platform if devices else "none"
+    RESULT_STATE["platform"] = platform
 
     # degraded CPU fallback ALSO runs the quick shapes: the full 100k×10k
     # configs on a single host core take the best part of an hour — a
@@ -942,8 +1025,10 @@ def main():
             log("degraded/CPU platform: forcing --quick shapes (1/10 scale)")
         quick = True
     scale = 10 if quick else 1
+    RESULT_STATE["scale"] = scale
 
     rtt = safe("rtt", measure_dispatch_rtt) if devices else None
+    RESULT_STATE["rtt"] = rtt
     if rtt is not None:
         log(f"dispatch round-trip (environment tunnel overhead): {rtt*1e3:.1f}ms")
         detail["dispatch_rtt_ms"] = round(rtt * 1e3, 2)
@@ -952,12 +1037,28 @@ def main():
 
     # config 1: the reference example scenario end-to-end (host path; device-free)
     cfg1 = safe("cfg1", bench_example_scenario, "cfg1:example")
+    RESULT_STATE["cfg1"] = cfg1
     if cfg1:
         detail["cfg1_host_prefilter_p99_us"] = round(cfg1["p99"] * 1e6, 1)
     safe("host:index", bench_selector_index, "host:index", T=10_000 // scale)
 
+    # The SERVED path (last section) is the headline; the bare-kernel
+    # configs are supporting detail. When the backend probe has eaten the
+    # deadline, skip straight to the headline instead of spending what's
+    # left on kernels and letting the watchdog kill the part that matters.
+    # Budgets (measured: quick-CPU kernels ~2min + served ~2min; full-TPU
+    # kernels dominated by cfg4 compiles): thresholds must fit inside the
+    # default 1800s deadline minus a fast probe, or full runs would always
+    # skip the kernels.
+    served_budget = 240.0 if scale == 10 else 900.0
+    kernel_budget = 120.0 if scale == 10 else 420.0
+    kernels_ok = time_left() > served_budget + kernel_budget
+    if not kernels_ok:
+        log(f"time budget low ({time_left():.0f}s left): skipping bare-kernel configs")
+        errors["kernels"] = "skipped: low time budget after backend probe"
+
     single_stats = None
-    if devices:
+    if devices and kernels_ok:
         # config 2: 1k pods x 100 throttles, 4 active dims
         safe("cfg2", bench_batched, rng, 1000 // scale, 100, R, "cfg2:1kx100")
 
@@ -978,6 +1079,7 @@ def main():
             single_stats = safe(
                 "cfg4:indexed", bench_single_pod_indexed, rng, state, T, R, "cfg4:100kx10k"
             )
+            RESULT_STATE["single_stats"] = single_stats
 
         # config 5: streaming reconcile (bare device kernels)
         eps_scan = safe("cfg5:scan", bench_streaming, rng, T, R, "cfg5:streaming")
@@ -991,7 +1093,10 @@ def main():
     # the cfg4 scale — pre_filter end-to-end through check_pod (headline),
     # and cfg5 as store events through the controllers ----
     served_stats = None
-    if devices:
+    if devices and time_left() < served_budget:
+        log(f"time budget exhausted ({time_left():.0f}s left): skipping served path")
+        errors["served"] = "skipped: low time budget"
+    elif devices:
         stack = safe(
             "served:setup", build_served_stack, 100_000 // scale, 10_000 // scale
         )
@@ -1000,6 +1105,7 @@ def main():
             r = safe("served:prefilter", bench_served_prefilter, plugin_s, "served")
             if r:
                 served_stats, rate1, rate4 = r
+                RESULT_STATE["served_stats"] = served_stats
                 detail["served_p50_ms"] = round(served_stats["p50"] * 1e3, 4)
                 detail["served_decisions_per_sec_1t"] = round(rate1)
                 detail["served_decisions_per_sec_4t"] = round(rate4)
@@ -1029,6 +1135,26 @@ def main():
                 detail["cfg5_status_lag_p99_ms"] = round(s["lag_p99_ms"], 2)
                 detail["cfg5_lag_mode"] = "max-rate"
             safe("served:stop", plugin_s.stop)
+
+    emit(build_result())
+
+
+def build_result() -> dict:
+    """Assemble the one JSON line from whatever RESULT_STATE holds so far.
+
+    Called by main() on the normal path and by the watchdog thread on the
+    deadline path — every input is read with a safe default so a partial
+    run still produces an honest (degraded/fallback) record.
+    """
+    detail = dict(RESULT_STATE["detail"])
+    errors = RESULT_STATE["errors"]
+    served_stats = RESULT_STATE.get("served_stats")
+    single_stats = RESULT_STATE.get("single_stats")
+    cfg1 = RESULT_STATE.get("cfg1")
+    rtt = RESULT_STATE.get("rtt")
+    platform = RESULT_STATE.get("platform", "none")
+    degraded = RESULT_STATE.get("degraded", True)
+    scale = RESULT_STATE.get("scale", 10)
 
     target_ms = 1.0  # BASELINE north star: <1ms p99 on one v5e-1
     if served_stats is not None:
@@ -1106,8 +1232,8 @@ def main():
         **detail,
     }
     if errors:
-        out["errors"] = errors
-    print(json.dumps(out))
+        out["errors"] = dict(errors)
+    return out
 
 
 if __name__ == "__main__":
@@ -1117,15 +1243,19 @@ if __name__ == "__main__":
         if isinstance(e, SystemExit) and not e.code:
             raise
         log(traceback.format_exc())
-        print(
-            json.dumps(
-                {
-                    "metric": "bench crashed",
-                    "value": -1.0,
-                    "unit": "ms",
-                    "vs_baseline": 0.0,
-                    "error": f"{e.__class__.__name__}: {str(e)[:300]}",
-                }
-            )
-        )
-        sys.exit(130 if isinstance(e, KeyboardInterrupt) else 1)
+        RESULT_STATE["errors"]["fatal"] = f"{e.__class__.__name__}: {str(e)[:300]}"
+        try:
+            out = build_result()
+        except BaseException:
+            out = {
+                "metric": "bench crashed",
+                "value": -1.0,
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "error": f"{e.__class__.__name__}: {str(e)[:300]}",
+            }
+        emit(out)
+        # rc=0 only when a usable partial MEASUREMENT made it out (value>0);
+        # a crash that measured nothing must stay distinguishable by rc.
+        usable = out.get("value", -1.0) > 0
+        sys.exit(130 if isinstance(e, KeyboardInterrupt) else (0 if usable else 1))

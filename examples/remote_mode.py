@@ -76,11 +76,13 @@ def main() -> int:
         ).start()
 
         # a Running 800m pod lands on the cluster → reconcile → status.used
-        pod = make_pod("p1", labels={"grp": "a"}, requests={"cpu": "800m"})
-        from dataclasses import replace
-
-        pod = replace(pod, spec=replace(pod.spec, node_name="node-1"))
-        pod.status.phase = "Running"
+        pod = make_pod(
+            "p1",
+            labels={"grp": "a"},
+            requests={"cpu": "800m"},
+            node_name="node-1",
+            phase="Running",
+        )
         server.store.create_pod(pod)
         deadline = time.time() + 20
         while time.time() < deadline:
