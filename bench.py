@@ -696,6 +696,11 @@ def build_served_stack(P, T, groups=500, label="served"):
     t_rec = time.perf_counter() - t0
     log(f"[{label}] initial reconcile of {n} keys in {t_rec:.1f}s "
         f"(batched device aggregates)")
+
+    if plugin.device_manager is not None:
+        t0 = time.perf_counter()
+        nk = plugin.device_manager.prewarm()
+        log(f"[{label}] prewarmed {nk} kernel shapes in {time.perf_counter()-t0:.1f}s")
     return store, plugin
 
 
@@ -1146,8 +1151,19 @@ def build_result() -> dict:
     deadline path — every input is read with a safe default so a partial
     run still produces an honest (degraded/fallback) record.
     """
-    detail = dict(RESULT_STATE["detail"])
-    errors = RESULT_STATE["errors"]
+    def _snap(d: dict) -> dict:
+        # the watchdog thread snapshots while main may be inserting; a dict
+        # resize mid-copy raises RuntimeError — retry rather than lose the
+        # collected measurements to the bare fallback
+        for _ in range(8):
+            try:
+                return dict(d)
+            except RuntimeError:
+                time.sleep(0.01)
+        return {}
+
+    detail = _snap(RESULT_STATE["detail"])
+    errors = _snap(RESULT_STATE["errors"])
     served_stats = RESULT_STATE.get("served_stats")
     single_stats = RESULT_STATE.get("single_stats")
     cfg1 = RESULT_STATE.get("cfg1")
@@ -1232,7 +1248,7 @@ def build_result() -> dict:
         **detail,
     }
     if errors:
-        out["errors"] = dict(errors)
+        out["errors"] = errors  # already a point-in-time snapshot (_snap)
     return out
 
 

@@ -22,6 +22,7 @@ import argparse
 import signal
 import sys
 import threading
+import time as _time
 from typing import Any, Dict, Optional
 
 from . import __version__
@@ -269,6 +270,15 @@ def main(argv: Optional[list] = None) -> int:
         status_writer=session.status_writer if session is not None else None,
         metrics_registry=metrics_registry,
     )
+    if plugin.device_manager is not None:
+        # compile the steady-state kernel shapes before taking traffic —
+        # a mid-burst XLA compile would land in the serving latency tail
+        _t0 = _time.perf_counter()
+        _nk = plugin.device_manager.prewarm()
+        print(
+            f"device kernels prewarmed ({_nk} shapes, {_time.perf_counter()-_t0:.1f}s)",
+            flush=True,
+        )
     scheduler = None
     if args.nodes > 0:
         from .scheduler import Node, Scheduler

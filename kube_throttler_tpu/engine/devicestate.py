@@ -42,18 +42,48 @@ from ..ops.schema import DimRegistry, PodBatch, ThrottleState
 AnyThrottle = Union[Throttle, ClusterThrottle]
 
 
+def _next_rung(k: int) -> int:
+    """One step up the shape ladder: ×4 below 128, ×2 above. The single
+    definition both _next_pow2 and _bucket_ladder derive from — if the
+    live bucketing and the prewarm walk ever disagreed, serving would hit
+    mid-burst compiles on rungs prewarm never visited."""
+    return k * (4 if k < 128 else 2)
+
+
 def _next_pow2(n: int, lo: int = 8) -> int:
-    """Smallest power of two ≥ n (≥ lo) — THE shape-bucketing policy:
-    every dynamically-sized device index/batch pads to one of these so the
-    set of compiled XLA shapes stays logarithmic, not one per count."""
+    """Smallest ladder rung ≥ n — THE shape-bucketing policy: every
+    dynamically-sized device index/batch pads to one of these so the set
+    of compiled XLA shapes stays logarithmic, not one per count. The
+    ladder steps ×4 below 128 and ×2 above (8, 32, 128, 256, 512, …):
+    small-burst sizes vary the most, so coarse rungs there cut the
+    distinct-shape count (every extra shape is a full XLA compile —
+    ~10-100ms CPU, seconds through a cold TPU tunnel, and prewarm() has
+    to walk the whole ladder), while capping padding waste at 2× for the
+    large shapes whose execution cost is real. (Name kept from the
+    original pure-pow2 policy; rungs are now the sparse ladder above.)"""
     k = lo
     while k < n:
-        k *= 2
+        k = _next_rung(k)
     return k
 
 
+def _bucket_ladder(ladder_max: int, lo: int = 8) -> List[int]:
+    """The rungs _next_pow2 can produce, ≤ ladder_max (prewarm walks these)."""
+    out = []
+    k = lo
+    while k <= ladder_max:
+        out.append(k)
+        k = _next_rung(k)
+    return out
+
+
+# fixed per-delta column width (see apply_agg_work): one compiled shape
+# axis for the streaming-delta kernel instead of two
+DELTA_KMAX = 4
+
+
 def _pad_pow2(idx: np.ndarray, lo: int = 8) -> np.ndarray:
-    """Pad a 1-D index array to the next power of two by repeating its
+    """Pad a 1-D index array to the next ladder rung by repeating its
     first element (a duplicate scatter index writing the same value is a
     no-op; a duplicate gather index is simply read twice)."""
     k = _next_pow2(idx.size, lo)
@@ -570,14 +600,25 @@ class _KindState:
                 pods, mask, counted, cols_pad,
             )
         if pending:
-            n = len(pending)
-            kmax = self._bucket(max(c.size for c, _, _, _ in pending), lo=4)
+            # the per-delta column width is FIXED at DELTA_KMAX: a pod
+            # matching more throttles is split into several delta rows
+            # (scatter-adds compose), so the compiled shape family is
+            # (nb, DELTA_KMAX) for the nb ladder alone — one axis of shape
+            # variation instead of two, which prewarm() can walk completely
+            kmax = DELTA_KMAX
+            chunks = pending
+            if any(c.size > kmax for c, _, _, _ in pending):
+                chunks = []
+                for cols, sign, req, present in pending:
+                    for i in range(0, cols.size, kmax):
+                        chunks.append((cols[i : i + kmax], sign, req, present))
+            n = len(chunks)
             nb = self._bucket(n)
             ids = np.full((nb, kmax), tcap, dtype=np.int32)
             signs = np.zeros((nb, kmax), dtype=np.int64)
             reqs = np.zeros((nb, R), dtype=np.int64)
             presents = np.zeros((nb, R), dtype=bool)
-            for i, (cols, sign, req, present) in enumerate(pending):
+            for i, (cols, sign, req, present) in enumerate(chunks):
                 ids[i, : cols.size] = cols
                 signs[i, : cols.size] = sign
                 reqs[i, : req.shape[0]] = req  # pad if R grew since capture
@@ -626,6 +667,82 @@ class DeviceStateManager:
         store.add_event_handler("Pod", self._on_pod)
         store.add_event_handler("Throttle", self._on_throttle)
         store.add_event_handler("ClusterThrottle", self._on_cluster_throttle)
+
+    def prewarm(self, ladder_max: int = 512) -> int:
+        """Compile the steady-state device kernels for every bucket shape
+        up front (the pow4 ladder ≤ ladder_max), so serving never hits a
+        mid-burst XLA compile — one compile is ~10-100ms on CPU and can be
+        seconds through a cold TPU tunnel, which lands straight in the
+        event→status lag tail. All warm dispatches are semantic no-ops
+        (padding-only indices) against the live handles. Returns the number
+        of kernel dispatches issued. Call after cache sync, before serving.
+        """
+        import jax
+
+        from ..ops.aggregate import aggregate_used, apply_pod_deltas_batched, rebase_cols
+        from ..ops.fastcheck import fast_check_pod_packed
+
+        ladder = _bucket_ladder(ladder_max)
+        # warm dispatches EXECUTE, not just compile: the full-reduction
+        # kernels (aggregate_used, rebase_cols over [pcap, kb, R]) cost
+        # real seconds on a single host core, so on CPU — where a compile
+        # is only ~10-100ms anyway — walk just the cheap shape families
+        # and the bottom rebase rungs
+        on_cpu = jax.devices()[0].platform == "cpu"
+        rebase_ladder = ladder[:2] if on_cpu else ladder
+        n = 0
+        last = None
+        for kind in ("throttle", "clusterthrottle"):
+            ks = self._kind(kind)
+            with self._agg_locks[kind]:
+                with self._lock:
+                    ks.ensure_capacity()
+                    pods, mask = ks.device_pods()
+                    counted = ks._device_counted()
+                    packed = ks.device_packed()
+                    tcap, R = ks.tcap, ks.R
+                if not on_cpu:
+                    cnt, req, ctb = aggregate_used(pods, mask, counted)
+                    n += 1
+                elif ks.agg_cnt is not None:
+                    cnt, req, ctb = ks.agg_cnt, ks.agg_req, ks.agg_contrib
+                else:
+                    cnt, req, ctb = aggregate_used(pods, mask, counted)
+                    n += 1
+                for nb in ladder:
+                    ids = np.full((nb, DELTA_KMAX), tcap, dtype=np.int32)
+                    signs = np.zeros((nb, DELTA_KMAX), dtype=np.int64)
+                    reqs = np.zeros((nb, R), dtype=np.int64)
+                    presents = np.zeros((nb, R), dtype=bool)
+                    last = apply_pod_deltas_batched(cnt, req, ctb, ids, signs, reqs, presents)
+                    n += 1
+                for kb in rebase_ladder:
+                    cols_pad = np.full(kb, tcap, dtype=np.int32)
+                    last = rebase_cols(cnt, req, ctb, pods, mask, counted, cols_pad)
+                    n += 1
+                for kb in ladder:
+                    idx = jnp.zeros(kb, dtype=np.int32)
+                    jax.device_get((cnt[idx], req[idx], ctb[idx]))
+                    n += 1
+            # the indexed single-pod check (the PreFilter fast path): the
+            # K-affected buckets actually seen are small; warm the bottom
+            # two rungs with the kind's live step3 variant (pre_filter
+            # always passes on_equal=False, plugin.go:153,165)
+            step3 = kind == "throttle"
+            row_req = np.zeros(R, dtype=np.int64)
+            row_present = np.zeros(R, dtype=bool)
+            for kb in ladder[:2]:
+                idx = np.zeros(kb, dtype=np.int32)
+                idx_valid = np.zeros(kb, dtype=bool)
+                np.asarray(
+                    fast_check_pod_packed(
+                        packed, row_req, row_present, idx, idx_valid, False, step3
+                    )
+                )
+                n += 1
+        if last is not None:
+            jax.device_get(last[0])  # one blocking read drains the queue
+        return n
 
     # -- event wiring -----------------------------------------------------
 

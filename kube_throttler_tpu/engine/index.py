@@ -182,6 +182,20 @@ class SelectorIndex:
                 self._row_prev = (row, prev, self.mask[row, : self._tcap].copy())
             self._row_pods[row] = pod
             self._pod_valid[row] = True
+
+            # Selector matching reads only (pod.labels, pod.namespace) — the
+            # namespace-side inputs (existence, ns labels) are maintained by
+            # upsert_namespace, which recomputes affected rows itself. So a
+            # pod update that changes neither (the dominant churn shape:
+            # requests/status-only updates) cannot flip this mask row, and
+            # the O(T) column sweep is skipped entirely.
+            if (
+                prev is not None
+                and prev.labels == pod.labels
+                and prev.namespace == pod.namespace
+            ):
+                return row
+
             self._pod_ns[row] = self._ns_ids.id_of(pod.namespace)
             self._pod_ns_exists[row] = pod.namespace in self._namespaces
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import math
 import threading
 from fractions import Fraction
+from functools import lru_cache
 from typing import Dict, Optional, Sequence, Tuple, Union
 
 from .api.types import ClusterThrottle, IsResourceAmountThrottled, ResourceAmount, Throttle
@@ -39,7 +40,11 @@ class GaugeVec:
         self._values: Dict[Tuple[str, ...], float] = {}
 
     def set(self, labels: Dict[str, str], value: float) -> None:
-        key = tuple(labels[n] for n in self.label_names)
+        self.set_key(tuple(labels[n] for n in self.label_names), value)
+
+    def set_key(self, key: Tuple[str, ...], value: float) -> None:
+        """Hot-path setter for a precomputed label-value tuple (order must
+        match ``label_names``); skips the per-call dict→tuple rebuild."""
         with self._lock:
             self._values[key] = float(value)
 
@@ -193,6 +198,7 @@ class Registry:
         return "\n".join(lines) + "\n"
 
 
+@lru_cache(maxsize=8192)
 def _quantity_metric_value(resource: str, q: Fraction) -> float:
     if resource == "cpu":
         # MilliValue: ceil to integer milli (metrics_recorder.go:40-41)
@@ -207,6 +213,8 @@ class _KindRecorder:
     def __init__(self, kind_prefix: str, label_names: Sequence[str], registry: Registry):
         mk = registry.gauge_vec
         k = kind_prefix
+        assert tuple(label_names)[-1] == "resource"  # set_key relies on this order
+        self._base_names = tuple(label_names)[:-1]
         self.spec_counts = mk(
             f"{k}_spec_threshold_resourceCounts",
             f"threshold on specific resourceCounts of the {k}",
@@ -248,31 +256,35 @@ class _KindRecorder:
             label_names,
         )
 
-    def _record_counts(self, gauge: GaugeVec, labels: Dict[str, str], counts: Optional[int]) -> None:
-        gauge.set({**labels, "resource": "pod"}, 0.0 if counts is None else float(counts))
+    def _record_counts(self, gauge: GaugeVec, base: Tuple[str, ...], counts: Optional[int]) -> None:
+        gauge.set_key(base + ("pod",), 0.0 if counts is None else float(counts))
 
-    def _record_requests(self, gauge: GaugeVec, labels: Dict[str, str], amount: ResourceAmount) -> None:
+    def _record_requests(self, gauge: GaugeVec, base: Tuple[str, ...], amount: ResourceAmount) -> None:
         for resource, q in (amount.resource_requests or {}).items():
-            gauge.set({**labels, "resource": resource}, _quantity_metric_value(resource, q))
+            gauge.set_key(base + (resource,), _quantity_metric_value(resource, q))
 
-    def _record_flags(self, labels: Dict[str, str], flags: IsResourceAmountThrottled) -> None:
-        self.throttled_counts.set(
-            {**labels, "resource": "pod"}, 1.0 if flags.resource_counts_pod else 0.0
+    def _record_flags(self, base: Tuple[str, ...], flags: IsResourceAmountThrottled) -> None:
+        self.throttled_counts.set_key(
+            base + ("pod",), 1.0 if flags.resource_counts_pod else 0.0
         )
         for resource, throttled in (flags.resource_requests or {}).items():
-            self.throttled_requests.set(
-                {**labels, "resource": resource}, 1.0 if throttled else 0.0
+            self.throttled_requests.set_key(
+                base + (resource,), 1.0 if throttled else 0.0
             )
 
     def record(self, labels: Dict[str, str], thr: Union[Throttle, ClusterThrottle]) -> None:
-        self._record_counts(self.spec_counts, labels, thr.spec.threshold.resource_counts)
-        self._record_requests(self.spec_requests, labels, thr.spec.threshold)
-        self._record_flags(labels, thr.status.throttled)
-        self._record_counts(self.used_counts, labels, thr.status.used.resource_counts)
-        self._record_requests(self.used_requests, labels, thr.status.used)
+        # ~7 gauge writes per status update land on the reconcile hot path;
+        # all families share the (labels..., resource) order with resource
+        # last, so one base tuple serves every set_key.
+        base = tuple(labels[n] for n in self._base_names)
+        self._record_counts(self.spec_counts, base, thr.spec.threshold.resource_counts)
+        self._record_requests(self.spec_requests, base, thr.spec.threshold)
+        self._record_flags(base, thr.status.throttled)
+        self._record_counts(self.used_counts, base, thr.status.used.resource_counts)
+        self._record_requests(self.used_requests, base, thr.status.used)
         calc = thr.status.calculated_threshold.threshold
-        self._record_counts(self.calculated_counts, labels, calc.resource_counts)
-        self._record_requests(self.calculated_requests, labels, calc)
+        self._record_counts(self.calculated_counts, base, calc.resource_counts)
+        self._record_requests(self.calculated_requests, base, calc)
 
 
 class ThrottleMetricsRecorder:

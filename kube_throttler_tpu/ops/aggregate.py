@@ -16,13 +16,63 @@ resource_amount.go:91-110 over throttle_controller.go:116-119).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
 from .schema import PodBatch
 
 
-@jax.jit
+def _masked_colsum_exact(m: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Exact ``m.T @ vals`` for bool[P,K] × int64[P,R] (non-negative vals)
+    as two float64 dots over 32-bit limbs.
+
+    Quantities are non-negative int64 milli-units; split each into
+    ``hi*2^32 + lo`` with both limbs < 2^32. A float64 dot of a 0/1 mask
+    against a limb column sums < 2^32 · P, exact in f64 while P < 2^21 —
+    far above any padded pod capacity — and the int64 recombination is
+    overflow-safe whenever the true total fits int64 (then hi_sum ≤ 2^31).
+    Dots hit the platform's GEMM path, ~500× the [P,K,R] broadcast+reduce
+    on a host core. Memory: materializes the f64 mask [P,K] (the CPU-side
+    quick shapes; the TPU path below never calls this).
+    """
+    mt = m.astype(jnp.float64).T  # [K,P]
+    lo = (vals & 0xFFFFFFFF).astype(jnp.float64)
+    hi = (vals >> 32).astype(jnp.float64)
+    lo_s = jnp.dot(mt, lo)
+    hi_s = jnp.dot(mt, hi)
+    return (hi_s.astype(jnp.int64) << 32) + lo_s.astype(jnp.int64)
+
+
+def _aggregate_core(pods: PodBatch, m: jnp.ndarray, use_dots: bool):
+    """Shared body: used aggregates from an already-combined mask bool[P,K]."""
+    used_cnt = jnp.sum(m, axis=0, dtype=jnp.int64)  # each pod contributes count 1
+    if use_dots:
+        used_req = _masked_colsum_exact(m, pods.req)
+        contrib = _masked_colsum_exact(
+            m, pods.req_present.astype(jnp.int64)
+        ).astype(jnp.int32)
+    else:
+        # broadcast+reduce instead of dot_general: TPU's X64 rewriter
+        # emulates s64 add/select/compare as s32 pairs but has no s64 dot
+        # lowering, and the MXU cannot accumulate 64-bit integers exactly.
+        # XLA loop-fuses the [P,K,R] product into the reduction, so nothing
+        # [P,K,R] materializes.
+        mb = m[:, :, None]
+        used_req = jnp.sum(jnp.where(mb, pods.req[:, None, :], 0), axis=0)
+        contrib = jnp.sum(
+            (mb & pods.req_present[:, None, :]).astype(jnp.int32), axis=0
+        )
+    return used_cnt, used_req, contrib
+
+
+@partial(jax.jit, static_argnames=("use_dots",))
+def _aggregate_used_impl(pods, mask, counted, use_dots):
+    m = mask & counted[:, None]  # bool[P,T]
+    return _aggregate_core(pods, m, use_dots)
+
+
 def aggregate_used(pods: PodBatch, mask: jnp.ndarray, counted: jnp.ndarray):
     """Full recompute of used amounts for every throttle.
 
@@ -33,19 +83,15 @@ def aggregate_used(pods: PodBatch, mask: jnp.ndarray, counted: jnp.ndarray):
         (schedulerName match, nodeName set — throttle_controller.go:217-219).
 
     Returns (used_cnt int64[T], used_req int64[T,R], contrib int32[T,R]).
+
+    Backend-adaptive: on CPU the masked sum runs as exact limb-split f64
+    GEMMs (a [P,T,R] elementwise reduce takes ~26s on one host core at
+    16k×1k×8, the dot form ~50ms); on TPU the fused broadcast+reduce is
+    used (no s64 dot lowering, and the f64 mask would materialize [P,T]×8B).
     """
-    m = mask & counted[:, None]  # bool[P,T]
-    used_cnt = jnp.sum(m, axis=0, dtype=jnp.int64)  # each pod contributes count 1
-    # broadcast+reduce instead of dot_general: TPU's X64 rewriter emulates
-    # s64 add/select/compare as s32 pairs but has no s64 dot lowering, and
-    # the MXU cannot accumulate 64-bit integers exactly. XLA loop-fuses the
-    # [P,T,R] product into the reduction, so nothing [P,T,R] materializes.
-    mb = m[:, :, None]
-    used_req = jnp.sum(jnp.where(mb, pods.req[:, None, :], 0), axis=0)
-    contrib = jnp.sum(
-        (mb & pods.req_present[:, None, :]).astype(jnp.int32), axis=0
+    return _aggregate_used_impl(
+        pods, mask, counted, use_dots=jax.default_backend() == "cpu"
     )
-    return used_cnt, used_req, contrib
 
 
 @jax.jit
@@ -106,7 +152,17 @@ def apply_pod_deltas_batched(
     return used_cnt, used_req, contrib
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("use_dots",))
+def _rebase_cols_impl(agg_cnt, agg_req, contrib, pods, mask, counted, cols, use_dots):
+    m = mask[:, cols] & (counted & pods.valid)[:, None]  # bool[P,K]
+    cnt, req, ctb = _aggregate_core(pods, m, use_dots)
+    return (
+        agg_cnt.at[cols].set(cnt, mode="drop"),
+        agg_req.at[cols].set(req, mode="drop"),
+        contrib.at[cols].set(ctb, mode="drop"),
+    )
+
+
 def rebase_cols(
     agg_cnt: jnp.ndarray,  # int64[T]
     agg_req: jnp.ndarray,  # int64[T,R]
@@ -121,16 +177,11 @@ def rebase_cols(
     aggregate — the membership set changed, so deltas no longer apply).
 
     One masked [P,K] reduction + scatter, entirely on device; K is bucketed
-    by the caller so recompilation is bounded."""
-    m = mask[:, cols] & (counted & pods.valid)[:, None]  # bool[P,K]
-    cnt = jnp.sum(m, axis=0, dtype=jnp.int64)
-    mb = m[:, :, None]
-    req = jnp.sum(jnp.where(mb, pods.req[:, None, :], 0), axis=0)
-    ctb = jnp.sum((mb & pods.req_present[:, None, :]).astype(jnp.int32), axis=0)
-    return (
-        agg_cnt.at[cols].set(cnt, mode="drop"),
-        agg_req.at[cols].set(req, mode="drop"),
-        contrib.at[cols].set(ctb, mode="drop"),
+    by the caller so recompilation is bounded. Backend-adaptive like
+    ``aggregate_used`` (exact limb-split GEMMs on CPU)."""
+    return _rebase_cols_impl(
+        agg_cnt, agg_req, contrib, pods, mask, counted, cols,
+        use_dots=jax.default_backend() == "cpu",
     )
 
 

@@ -125,7 +125,12 @@ def to_milli(value: Fraction) -> int:
     return result
 
 
+@lru_cache(maxsize=65536)
 def from_milli(milli: int) -> Fraction:
+    """Milli-units → exact Fraction. Cached: the reconcile gather decodes
+    the same small set of milli values (request sizes × throttle counts)
+    thousands of times per second, and Fraction construction normalizes
+    via gcd each call."""
     return Fraction(int(milli), 1000)
 
 

@@ -134,7 +134,10 @@ class TestHTTPServer:
         deadline = time.time() + 10
         while time.time() < deadline:
             code, thrs = _req(server, "GET", "/v1/throttles")
-            if thrs and thrs[0]["status"]["throttled"]["resourceRequests"].get("cpu"):
+            # .get chains: before the first reconcile lands (cold-JIT runs
+            # take ~1s standalone) the stored status is the pre-reconcile
+            # default, whose throttled map has no resourceRequests key
+            if thrs and thrs[0]["status"]["throttled"].get("resourceRequests", {}).get("cpu"):
                 break
             time.sleep(0.05)
         assert thrs[0]["status"]["used"]["resourceRequests"]["cpu"] == "200m"
