@@ -662,6 +662,10 @@ class DeviceStateManager:
             "throttle": threading.Lock(),
             "clusterthrottle": threading.Lock(),
         }
+        # compiled shard_map steps for full_tick_sharded, keyed
+        # (mesh, on_equal, step3) — rebuilding the jit wrapper per call
+        # would recompile every tick
+        self._sharded_steps: dict = {}
 
         store.add_event_handler("Namespace", self._on_namespace)
         store.add_event_handler("Pod", self._on_pod)
@@ -1048,6 +1052,98 @@ class DeviceStateManager:
             state, pods, mask, on_equal=on_equal, step3_on_equal=step3
         )
         return counts, schedulable, row_map
+
+    def full_tick_sharded(self, mesh, on_equal: bool = False, now=None):
+        """Both kinds' COMPLETE tick over a ("pods","throttles") device
+        Mesh — the multi-chip serving path for bulk triage at cluster
+        scale. One shard_map program per kind (parallel/sharded.py)
+        resolves time-varying thresholds from the override schedule,
+        re-aggregates ``used`` from the live pod set, recomputes the
+        throttled flags, and classifies every (pod × throttle) admission
+        cell; each device owns a [P/dp, T/tp] tile and the only
+        cross-device traffic is two psum all-reduces (used partials over
+        the pods axis, verdict counts over the throttles axis) — no [P,T]
+        global tensor ever exists on any device.
+
+        Semantics: unlike ``check_batch`` (which classifies against the
+        WRITTEN statuses, exactly what the reference's PreFilter reads —
+        plugin.go:148-215), the full tick derives used/thresholds/flags
+        from one coherent snapshot: the fused reconcile+PreFilter sweep.
+        On a static store both agree (tested); under churn the tick is
+        ahead of the written statuses by design.
+
+        Returns {kind: (counts int32[P,4], schedulable bool[P], row_map,
+        used_cnt int64[T], used_req int64[T,R], col_map)}.
+        """
+        from datetime import datetime, timezone
+
+        from ..ops.overrides import _datetime_to_ns, encode_override_schedule
+        from ..parallel.sharded import sharded_full_update
+
+        dp, tp = (mesh.shape["pods"], mesh.shape["throttles"])
+        now_ns = jnp.asarray(
+            _datetime_to_ns(now or datetime.now(timezone.utc)), dtype=jnp.int64
+        )
+        snaps = {}
+        with self._lock:
+            for kind in ("throttle", "clusterthrottle"):
+                ks = self._kind(kind)
+                ks.ensure_capacity()
+                if ks.pcap % dp or ks.tcap % tp:
+                    raise ValueError(
+                        f"mesh shape ({dp},{tp}) must divide padded capacities "
+                        f"({ks.pcap},{ks.tcap}); capacities are ladder rungs "
+                        "(multiples of 8), so use power-of-two mesh axes"
+                    )
+                pods, mask = ks.device_pods()
+                specs = [None] * ks.tcap
+                for col, thr in ks.index._col_thrs.items():
+                    specs[col] = thr.spec
+                snaps[kind] = dict(
+                    pods=pods,
+                    mask=mask,
+                    counted=ks._device_counted(),
+                    res=(
+                        ks.res_cnt.copy(), ks.res_cnt_present.copy(),
+                        ks.res_req.copy(), ks.res_req_present.copy(),
+                    ),
+                    thr_valid=ks.thr_valid.copy(),
+                    specs=specs,
+                    tcap=ks.tcap,
+                    row_map=dict(ks.index._pod_rows),
+                    col_map={c: t.key for c, t in ks.index._col_thrs.items()},
+                )
+        out = {}
+        for kind, snap in snaps.items():
+            # encode outside the lock: O(T) host work over spec objects
+            max_o = max(
+                (len(s.temporary_threshold_overrides) for s in snap["specs"] if s),
+                default=0,
+            )
+            sched = encode_override_schedule(
+                snap["specs"],
+                self.dims,
+                throttle_capacity=snap["tcap"],
+                override_capacity=_next_pow2(max_o, lo=1),
+            )
+            step3 = True if kind == "throttle" else on_equal
+            key = (mesh, on_equal, step3)
+            step = self._sharded_steps.get(key)
+            if step is None:
+                step = self._sharded_steps[key] = sharded_full_update(
+                    mesh, on_equal=on_equal, step3_on_equal=step3
+                )
+            res_cnt, res_cnt_p, res_req, res_req_p = snap["res"]
+            counts, schedulable, used_cnt, used_req, _, _ = step(
+                sched, snap["pods"], snap["mask"], snap["counted"],
+                res_cnt, res_cnt_p, res_req, res_req_p,
+                snap["thr_valid"], now_ns,
+            )
+            out[kind] = (
+                np.asarray(counts), np.asarray(schedulable), snap["row_map"],
+                np.asarray(used_cnt), np.asarray(used_req), snap["col_map"],
+            )
+        return out
 
     def check_batch_all(self, on_equal: bool = False):
         """Both kinds' batch checks against ONE coherent device snapshot:

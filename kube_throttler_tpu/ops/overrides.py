@@ -103,6 +103,8 @@ def encode_override_schedule(
     override_capacity: Optional[int] = None,
 ) -> OverrideSchedule:
     for spec in specs:
+        if spec is None:  # unoccupied device column (padded capacity)
+            continue
         for name in (spec.threshold.resource_requests or {}):
             dims.index_of(name)
         for o in spec.temporary_threshold_overrides:
@@ -110,7 +112,10 @@ def encode_override_schedule(
                 dims.index_of(name)
 
     T = throttle_capacity if throttle_capacity is not None else max(len(specs), 1)
-    max_overrides = max((len(s.temporary_threshold_overrides) for s in specs), default=0)
+    max_overrides = max(
+        (len(s.temporary_threshold_overrides) for s in specs if s is not None),
+        default=0,
+    )
     O = override_capacity if override_capacity is not None else max(max_overrides, 1)
     if max_overrides > O:
         raise ValueError(
@@ -133,6 +138,8 @@ def encode_override_schedule(
     spec_req_present = np.zeros((T, R), dtype=bool)
 
     for i, spec in enumerate(specs):
+        if spec is None:
+            continue
         if spec.threshold.resource_counts is not None:
             spec_cnt[i] = spec.threshold.resource_counts
             spec_cnt_present[i] = True

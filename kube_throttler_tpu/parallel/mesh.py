@@ -19,7 +19,17 @@ def make_mesh(
     """
     devices = jax.devices()
     n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"requested {n} devices but only {len(devices)} are visible"
+        )
     devices = devices[:n]
+    if shape is not None and shape[0] * shape[1] != n:
+        raise ValueError(
+            f"mesh shape {tuple(shape)} needs {shape[0] * shape[1]} devices, "
+            f"got {n} (visible: {len(jax.devices())}); pass a shape whose "
+            "product matches the device count, or omit it"
+        )
     if shape is None:
         # largest factor pair with pods-major
         t = 1

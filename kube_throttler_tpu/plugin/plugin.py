@@ -222,16 +222,75 @@ class KubeThrottler:
             # one coherent device snapshot for BOTH kinds (a single lock
             # hold inside check_batch_all) — the composed verdict matches
             # one point in the event stream
-            for kind, (_, ok, rows) in self.device_manager.check_batch_all(False).items():
-                ok = np.asarray(ok)
-                for key, row in rows.items():
-                    schedulable[key] = schedulable.get(key, True) and bool(ok[row])
-            for key in list(schedulable):
-                ns, _, _ = key.partition("/")
-                if ns not in known_ns:
-                    del schedulable[key]
-                    errors.append(key)
+            per_kind = {
+                kind: (ok, rows)
+                for kind, (_, ok, rows) in self.device_manager.check_batch_all(False).items()
+            }
+            schedulable, errors = self._merge_verdicts(per_kind, known_ns)
             return {"schedulable": schedulable, "errors": errors}
+
+    @staticmethod
+    def _merge_verdicts(per_kind: dict, known_ns: set):
+        """AND the per-kind schedulable verdicts per pod, then route pods of
+        unknown namespaces to errors (the per-pod path returns ERROR for
+        them, clusterthrottle_controller.go:273-276 — the batch surfaces
+        must never report them schedulable). Shared by pre_filter_batch and
+        full_tick_sharded so the two surfaces cannot drift."""
+        import numpy as np
+
+        schedulable: dict = {}
+        errors: list = []
+        for _, (ok, rows) in per_kind.items():
+            ok = np.asarray(ok)
+            for key, row in rows.items():
+                schedulable[key] = schedulable.get(key, True) and bool(ok[row])
+        for key in list(schedulable):
+            ns, _, _ = key.partition("/")
+            if ns not in known_ns:
+                del schedulable[key]
+                errors.append(key)
+        return schedulable, errors
+
+    def full_tick_sharded(self, n_devices: Optional[int] = None, shape=None) -> dict:
+        """The fused reconcile+PreFilter sweep over a device mesh — the
+        multi-chip serving surface. Builds a 2D ("pods","throttles") Mesh
+        over the first ``n_devices`` (default: all visible devices; one
+        chip degenerates to a 1×1 mesh) and runs both kinds' complete
+        tick tiled across it (DeviceStateManager.full_tick_sharded):
+        override-resolved thresholds, used re-aggregation, throttled
+        flags, and the [P,T] classification, with two psum all-reduces of
+        tile partials as the only cross-device traffic.
+
+        Returns ``{"schedulable": {pod_key: bool}, "used": {kind:
+        {throttle_key: pod_count}}, "mesh": [dp, tp], "errors": [...]}``.
+        Unlike ``pre_filter_batch`` this classifies against the
+        freshly-derived state, not the written statuses (ahead of them
+        under churn).
+        """
+        import numpy as np
+
+        from ..parallel.mesh import make_mesh
+
+        if self.device_manager is None:
+            raise RuntimeError("full_tick_sharded requires the device data plane")
+        with self.tracer.trace("full_tick"):
+            mesh = make_mesh(n_devices, tuple(shape) if shape else None)
+            known_ns = {ns.name for ns in self.listers.namespaces.list()}
+            used: dict = {}
+            out = self.device_manager.full_tick_sharded(mesh, on_equal=False)
+            for kind, (_, _, _, used_cnt, _, col_map) in out.items():
+                used[kind] = {
+                    tkey: int(used_cnt[col]) for col, tkey in col_map.items()
+                }
+            schedulable, errors = self._merge_verdicts(
+                {k: (v[1], v[2]) for k, v in out.items()}, known_ns
+            )
+            return {
+                "schedulable": schedulable,
+                "used": used,
+                "mesh": [mesh.shape["pods"], mesh.shape["throttles"]],
+                "errors": errors,
+            }
 
     # ---------------------------------------------------------------- reserve
 

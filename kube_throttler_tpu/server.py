@@ -253,6 +253,15 @@ class ThrottlerHTTPServer:
             )
         elif h.path == "/v1/prefilter-batch":
             h._send(200, self.plugin.pre_filter_batch())
+        elif h.path == "/v1/tick":
+            # fused reconcile+PreFilter sweep over a device mesh;
+            # body: {"devices": N?, "shape": [dp, tp]?}
+            h._send(
+                200,
+                self.plugin.full_tick_sharded(
+                    body.get("devices"), body.get("shape")
+                ),
+            )
         elif h.path == "/v1/reserve":
             pod = self._resolve_pod(body)
             status = self.plugin.reserve(pod)
