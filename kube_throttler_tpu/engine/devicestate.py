@@ -978,9 +978,11 @@ class DeviceStateManager:
                 else:
                     # pod not (yet) in the store — the PreFilter common case:
                     # evaluate its row via the index's compiled columns
-                    # (native C++ row-match; NOT a Python loop over T)
+                    # (native C++ row-match behind a (ns,labels) probe LRU —
+                    # scheduler retries of the same Pending pod skip the
+                    # O(T) evaluation entirely; NOT a Python loop over T)
                     with ks.index._lock:  # noqa: SLF001 — same-package access
-                        row = ks.index._match_row_arbitrary(pod) & ks.index._thr_valid
+                        row = ks.index.match_row_cached(pod) & ks.index._thr_valid
                     mask_row = np.zeros((1, ks.tcap), dtype=bool)
                     mask_row[0, : row.shape[0]] = row[: ks.tcap]
 

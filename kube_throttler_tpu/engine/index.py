@@ -30,6 +30,7 @@ between growth events (no kernel recompilation on object churn).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
 
 import numpy as np
@@ -92,6 +93,14 @@ class SelectorIndex:
         self._values = _Interner()
         self._ns_ids = _Interner()
         self._key_ids = _Interner()
+
+        # probe-row cache for NOT-stored pods (the PreFilter common case):
+        # a selector match depends only on (namespace, labels), and the
+        # scheduler retries the same Pending pod across backoff cycles, so
+        # repeats skip the O(T) compiled-column evaluation. Invalidated
+        # wholesale by bumping _gen on any column/namespace change.
+        self._probe_cache: "OrderedDict[tuple, Tuple[int, np.ndarray]]" = OrderedDict()
+        self._gen = 0
 
         # native C++ row-match tier (kube_throttler_tpu/native/ktnative.cpp); None → pure Python
         self._native: Optional[NativeRowEngine] = None
@@ -236,6 +245,7 @@ class SelectorIndex:
 
     def upsert_throttle(self, thr: AnyThrottle) -> int:
         with self._lock:
+            self._gen += 1  # compiled columns change → probe cache stale
             key = thr.key
             col = self._thr_cols.get(key)
             if col is None:
@@ -280,6 +290,7 @@ class SelectorIndex:
 
     def remove_throttle(self, throttle_key: str) -> None:
         with self._lock:
+            self._gen += 1
             col = self._thr_cols.pop(throttle_key, None)
             if col is None:
                 return
@@ -297,6 +308,11 @@ class SelectorIndex:
         """Namespace (re)definition: refresh ns-label columns of its pods and
         recompute their rows (cluster selectors may flip)."""
         with self._lock:
+            if self.kind == "clusterthrottle":
+                # ns existence/labels feed clusterthrottle probe matches;
+                # throttle matching reads only thr.namespace == pod.namespace
+                # (in the cache key), so that kind's cache survives ns churn
+                self._gen += 1
             self._namespaces[ns.name] = ns
             self._ns_label_ids.pop(ns.name, None)
             self._row_prev = None  # ns labels feed clusterthrottle matches
@@ -465,6 +481,30 @@ class SelectorIndex:
             out[col] = self._match_one(self._col_thrs[col], pod)
         return out
 
+    _PROBE_CACHE_MAX = 4096
+
+    def match_row_cached(self, pod: Pod) -> np.ndarray:
+        """``_match_row_arbitrary`` behind a (namespace, labels)-keyed LRU.
+
+        Caller must hold ``_lock``. The returned array is SHARED with the
+        cache — treat it as read-only. Correctness: a selector match reads
+        nothing of the pod beyond namespace + labels (``_match_one``), and
+        ``_gen`` is bumped by every column or namespace mutation, so a hit
+        can never serve a stale compiled-column evaluation."""
+        key = (pod.namespace, frozenset(pod.labels.items()))
+        hit = self._probe_cache.get(key)
+        if hit is not None and hit[0] == self._gen:
+            self._probe_cache.move_to_end(key)
+            return hit[1]
+        row = self._match_row_arbitrary(pod)
+        self._probe_cache[key] = (self._gen, row)
+        # assignment to an existing (gen-stale) key keeps its old LRU slot;
+        # a just-refreshed hot entry must not be the next eviction victim
+        self._probe_cache.move_to_end(key)
+        if len(self._probe_cache) > self._PROBE_CACHE_MAX:
+            self._probe_cache.popitem(last=False)
+        return row
+
     def _recompute_row(self, row: int) -> None:
         self.mask[row, :] = self._match_row_arbitrary(self._row_pods[row])
 
@@ -542,7 +582,7 @@ class SelectorIndex:
                     # processed: its row was saved before the overwrite
                     cols = np.nonzero(prev[2] & self._thr_valid[: prev[2].shape[0]])[0]
                 else:
-                    cols = np.nonzero(self._match_row_arbitrary(pod) & self._thr_valid)[0]
+                    cols = np.nonzero(self.match_row_cached(pod) & self._thr_valid)[0]
             return [self._col_thrs[int(c)].key for c in cols if int(c) in self._col_thrs]
 
     def matched_pod_keys(self, throttle_key: str) -> List[str]:

@@ -264,6 +264,67 @@ class TestSelectorIndex:
             want_keys = {tk for tk in throttles if oracle[(pk, tk)]}
             assert got == want_keys
 
+    @pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
+    def test_probe_cache_tracks_mutations(self, kind):
+        """match_row_cached must never serve a stale compiled-column
+        evaluation: interleave probe queries (repeating (ns,labels) keys,
+        so hits DO occur) with throttle/namespace churn and diff every
+        result against the uncached evaluation."""
+        rng = random.Random(7)
+        index = SelectorIndex(kind, throttle_capacity=2)
+        for name in ("ns1", "ns2"):
+            index.upsert_namespace(Namespace(name, labels=_random_label(rng)))
+
+        labels_pool = [_random_label(rng) for _ in range(5)]
+
+        def probe():
+            pod = make_pod(
+                f"probe{rng.randrange(3)}",
+                namespace=rng.choice(["ns1", "ns2"]),
+                labels=rng.choice(labels_pool),
+            )
+            with index._lock:
+                got = index.match_row_cached(pod).copy()
+                want = index._match_row_arbitrary(pod)
+            np.testing.assert_array_equal(got, want)
+
+        mk_throttle = (
+            (lambda i: Throttle(
+                name=f"t{i}", namespace="ns1",
+                spec=ThrottleSpec(selector=ThrottleSelector(selector_terms=(
+                    ThrottleSelectorTerm(LabelSelector(match_labels=_random_label(rng))),
+                ))),
+            ))
+            if kind == "throttle"
+            else (lambda i: ClusterThrottle(
+                name=f"c{i}",
+                spec=ClusterThrottleSpec(selector=ClusterThrottleSelector(selector_terms=(
+                    ClusterThrottleSelectorTerm(
+                        pod_selector=LabelSelector(match_labels=_random_label(rng))
+                    ),
+                ))),
+            ))
+        )
+        live = {}
+        for step in range(200):
+            op = rng.random()
+            if op < 0.5:
+                probe()
+            elif op < 0.8:
+                thr = mk_throttle(rng.randrange(4))
+                live[thr.key] = thr
+                index.upsert_throttle(thr)
+                probe()
+            elif op < 0.9 and live:
+                index.remove_throttle(live.popitem()[0])
+                probe()
+            else:
+                index.upsert_namespace(
+                    Namespace(rng.choice(["ns1", "ns2"]), labels=_random_label(rng))
+                )
+                probe()
+        assert index._probe_cache, "cache should have entries"
+
 
 class TestDeviceMirrorRegressions:
     """Round-1 review findings on the device mirror."""
