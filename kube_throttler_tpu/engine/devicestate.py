@@ -753,10 +753,17 @@ class DeviceStateManager:
     def _on_namespace(self, event: Event) -> None:
         with self._lock:
             for ks in (self.throttle, self.clusterthrottle):
-                ks.index.upsert_namespace(event.obj)
-                ks.refresh_mask()
-            # namespace (re)definition can flip many clusterthrottle mask
-            # rows at once — the incremental aggregate cannot follow that
+                if event.type == EventType.DELETED:
+                    # deletion must NOT re-upsert: pods of a deleted
+                    # namespace can no longer match any clusterthrottle
+                    ks.index.remove_namespace(event.obj.name)
+                else:
+                    ks.index.upsert_namespace(event.obj)
+            # only clusterthrottle mask rows can flip on namespace events
+            # (the throttle index's upsert/remove drop bookkeeping only), so
+            # only that kind pays the device mask re-upload and the full
+            # aggregate rebase
+            self.clusterthrottle.refresh_mask()
             self.clusterthrottle.mark_full_rebase()
 
     def _on_pod(self, event: Event) -> None:

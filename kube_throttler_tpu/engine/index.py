@@ -332,6 +332,29 @@ class SelectorIndex:
                         arr[row] = _MISSING
                 self._recompute_row(int(row))
 
+    def remove_namespace(self, name: str) -> None:
+        """Namespace deletion: its pods can no longer match any
+        ClusterThrottle (the oracle requires the Namespace object —
+        clusterthrottle_controller.go:273-276 answers ERROR for pods of an
+        unknown namespace, and an unknown namespace matches no selector).
+        Throttle-kind matching ignores Namespace objects entirely, so that
+        kind only drops its bookkeeping."""
+        with self._lock:
+            self._namespaces.pop(name, None)
+            self._ns_label_ids.pop(name, None)
+            self._row_prev = None
+            if self.kind != "clusterthrottle":
+                return
+            self._gen += 1  # existence feeds clusterthrottle probe matches
+            ns_id = self._ns_ids.id_of(name)
+            rows = np.nonzero(self._pod_valid & (self._pod_ns == ns_id))[0]
+            self._pod_ns_exists[rows] = False
+            # every match path returns False for an absent Namespace (native
+            # gate ktnative.cpp ns_exists; _match_one/_eval_general ns None),
+            # so the rows' recompute result is provably all-False — clear
+            # vectorized instead of O(rows × T) selector evaluations
+            self.mask[rows, :] = False
+
     # ------------------------------------------------------------- recompute
 
     def _term_col_match(self, pairs: Dict[str, str], store: Dict[str, np.ndarray]) -> np.ndarray:

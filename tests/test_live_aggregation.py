@@ -216,6 +216,33 @@ class TestFullRebasePaths:
         _assert_status_matches_oracle(store, plugin)
         assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
 
+    def test_namespace_delete_clears_clusterthrottle_used(self):
+        """Deleting a Namespace object must un-match its pods from every
+        clusterthrottle (the oracle requires the Namespace,
+        clusterthrottle_controller.go:273-276) — a DELETED event must not
+        be treated as an upsert that re-marks the namespace as existing."""
+        store, plugin, _ = _stack()
+        store.create_cluster_throttle(_ct_team_x("ct1"))
+        store.create_namespace(Namespace("team-ns", labels={"team": "x"}))
+        store.create_pod(
+            _bound(
+                make_pod(
+                    "p1", namespace="team-ns", labels={"grp": "a"}, requests={"cpu": "1"}
+                )
+            )
+        )
+        plugin.run_pending_once()
+        assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
+
+        store.delete_namespace("team-ns")
+        plugin.run_pending_once()
+        assert store.get_cluster_throttle("ct1").status.used == ResourceAmount()
+
+        # re-creating the namespace restores the match (existence flips back)
+        store.create_namespace(Namespace("team-ns", labels={"team": "x"}))
+        plugin.run_pending_once()
+        assert store.get_cluster_throttle("ct1").status.used.resource_counts == 1
+
     def test_namespace_move_between_selector_terms_converges(self):
         """A relabel that moves the namespace from one selector term to
         another keeps the OR-aggregate namespace match True on both sides
