@@ -80,6 +80,10 @@ def _bucket_ladder(ladder_max: int, lo: int = 8) -> List[int]:
 # fixed per-delta column width (see apply_agg_work): one compiled shape
 # axis for the streaming-delta kernel instead of two
 DELTA_KMAX = 4
+# per-dispatch cap on the delta batch = the prewarm ladder top; bursts
+# beyond it split into several warm dispatches instead of compiling a
+# cold shape mid-drain
+DELTA_BATCH_MAX = 512
 
 
 def _pad_pow2(idx: np.ndarray, lo: int = 8) -> np.ndarray:
@@ -559,8 +563,10 @@ class _KindState:
 
     def apply_agg_work(self, work: dict) -> None:
         """Land stolen aggregate maintenance on device: col rebases and the
-        pod-delta burst each cost ONE dispatch (apply_pod_deltas_batched /
-        rebase_cols); a full rebase is one masked aggregate_used reduction.
+        pod-delta burst cost ceil(n / DELTA_BATCH_MAX) warm-shaped
+        dispatches each (apply_pod_deltas_batched / rebase_cols — one
+        dispatch for any burst ≤ the prewarm ladder top); a full rebase is
+        one masked aggregate_used reduction.
 
         Caller holds the per-kind agg lock (NOT the main lock): ``agg_*``
         are only ever touched under it, and consecutive flushes are
@@ -592,13 +598,17 @@ class _KindState:
                     kept.append((cols_kept, sign, req, present))
             pending = kept
             arr = np.fromiter(rb, dtype=np.int32, count=len(rb))
-            k = self._bucket(arr.size)
-            cols_pad = np.full(k, tcap, dtype=np.int32)
-            cols_pad[: arr.size] = arr
-            self.agg_cnt, self.agg_req, self.agg_contrib = rebase_cols(
-                self.agg_cnt, self.agg_req, self.agg_contrib,
-                pods, mask, counted, cols_pad,
-            )
+            # same warm-shape cap as the delta path: each column's rebase
+            # is independent, so a burst splits into ladder-sized dispatches
+            for start in range(0, arr.size, DELTA_BATCH_MAX):
+                part = arr[start : start + DELTA_BATCH_MAX]
+                k = self._bucket(part.size)
+                cols_pad = np.full(k, tcap, dtype=np.int32)
+                cols_pad[: part.size] = part
+                self.agg_cnt, self.agg_req, self.agg_contrib = rebase_cols(
+                    self.agg_cnt, self.agg_req, self.agg_contrib,
+                    pods, mask, counted, cols_pad,
+                )
         if pending:
             # the per-delta column width is FIXED at DELTA_KMAX: a pod
             # matching more throttles is split into several delta rows
@@ -612,20 +622,25 @@ class _KindState:
                 for cols, sign, req, present in pending:
                     for i in range(0, cols.size, kmax):
                         chunks.append((cols[i : i + kmax], sign, req, present))
-            n = len(chunks)
-            nb = self._bucket(n)
-            ids = np.full((nb, kmax), tcap, dtype=np.int32)
-            signs = np.zeros((nb, kmax), dtype=np.int64)
-            reqs = np.zeros((nb, R), dtype=np.int64)
-            presents = np.zeros((nb, R), dtype=bool)
-            for i, (cols, sign, req, present) in enumerate(chunks):
-                ids[i, : cols.size] = cols
-                signs[i, : cols.size] = sign
-                reqs[i, : req.shape[0]] = req  # pad if R grew since capture
-                presents[i, : present.shape[0]] = present
-            self.agg_cnt, self.agg_req, self.agg_contrib = apply_pod_deltas_batched(
-                self.agg_cnt, self.agg_req, self.agg_contrib, ids, signs, reqs, presents
-            )
+            # cap each dispatch at the prewarmed ladder top: a backlog burst
+            # beyond it would compile a cold shape mid-drain (~10-100ms CPU,
+            # seconds on a cold TPU tunnel); several warm scatter dispatches
+            # are far cheaper. Scatter-adds compose, so splitting is exact.
+            for start in range(0, len(chunks), DELTA_BATCH_MAX):
+                part = chunks[start : start + DELTA_BATCH_MAX]
+                nb = self._bucket(len(part))
+                ids = np.full((nb, kmax), tcap, dtype=np.int32)
+                signs = np.zeros((nb, kmax), dtype=np.int64)
+                reqs = np.zeros((nb, R), dtype=np.int64)
+                presents = np.zeros((nb, R), dtype=bool)
+                for i, (cols, sign, req, present) in enumerate(part):
+                    ids[i, : cols.size] = cols
+                    signs[i, : cols.size] = sign
+                    reqs[i, : req.shape[0]] = req  # pad if R grew since capture
+                    presents[i, : present.shape[0]] = present
+                self.agg_cnt, self.agg_req, self.agg_contrib = apply_pod_deltas_batched(
+                    self.agg_cnt, self.agg_req, self.agg_contrib, ids, signs, reqs, presents
+                )
 
     def flush_agg(self) -> None:
         """Single-threaded convenience (tests): steal + apply in one go.
@@ -672,7 +687,7 @@ class DeviceStateManager:
         store.add_event_handler("Throttle", self._on_throttle)
         store.add_event_handler("ClusterThrottle", self._on_cluster_throttle)
 
-    def prewarm(self, ladder_max: int = 512) -> int:
+    def prewarm(self, ladder_max: int = DELTA_BATCH_MAX) -> int:
         """Compile the steady-state device kernels for every bucket shape
         up front (the pow4 ladder ≤ ladder_max), so serving never hits a
         mid-burst XLA compile — one compile is ~10-100ms on CPU and can be
