@@ -69,6 +69,19 @@ def main(argv: Optional[list] = None) -> int:
         "(plugin.go:71-130); without it the daemon runs its own in-memory "
         "apiserver fed via the HTTP surface",
     )
+    serve.add_argument(
+        "--api-qps",
+        type=float,
+        default=50.0,
+        help="client-side write rate limit against the remote apiserver "
+        "(client-go rest.Config QPS analog; 0 disables)",
+    )
+    serve.add_argument(
+        "--api-burst",
+        type=int,
+        default=100,
+        help="token-bucket burst for --api-qps (rest.Config Burst analog)",
+    )
     serve.add_argument("--controller-threadiness", type=int, default=0)
     serve.add_argument("--num-key-mutex", type=int, default=0)
     serve.add_argument("--host", default="127.0.0.1")
@@ -202,7 +215,10 @@ def main(argv: Optional[list] = None) -> int:
                 stop.set()
 
             elector = HttpLeaseElector(
-                ApiClient(rest_config),
+                # lease renew traffic is ~0.5 writes/s — exempt from the
+                # --api-qps bucket so a saturated status pipeline can never
+                # starve leadership renewal into a spurious failover
+                ApiClient(rest_config, qps=None),
                 name=f"kube-throttler-tpu-{plugin_args.name}",
                 identity=f"{socket.gethostname()}-{_os.getpid()}",
                 on_lost=_leadership_lost,
@@ -234,7 +250,13 @@ def main(argv: Optional[list] = None) -> int:
     if rest_config is not None:
         from .client.transport import RemoteSession
 
-        session = RemoteSession(rest_config, store, metrics_registry=metrics_registry)
+        session = RemoteSession(
+            rest_config,
+            store,
+            metrics_registry=metrics_registry,
+            qps=args.api_qps if args.api_qps > 0 else None,
+            burst=args.api_burst,
+        )
         print(
             f"syncing from apiserver {session.config.server} "
             f"(kubeconfig={plugin_args.kubeconfig})...",

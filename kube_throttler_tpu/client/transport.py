@@ -196,16 +196,66 @@ def parse_kubeconfig(path: str) -> RestConfig:
     )
 
 
+class _TokenBucket:
+    """Client-side write rate limiter — the analog of client-go's
+    rest.Config QPS/Burst that the reference's generated clientset
+    inherits (flowcontrol token bucket behind every request). Blocking
+    ``take`` is the back-pressure: the status-writer thread slows down
+    instead of flooding the apiserver."""
+
+    def __init__(self, qps: float, burst: int):
+        if qps <= 0 or burst < 1:
+            # ValueError, not assert: reachable from CLI flags, and under
+            # python -O a stripped assert would build a bucket whose take()
+            # blocks forever (refill capped at burst=0)
+            raise ValueError(f"qps must be > 0 and burst >= 1 (got {qps}, {burst})")
+        self.qps = float(qps)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._stamp) * self.qps
+                )
+                self._stamp = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+
+
 class ApiClient:
     """Blocking REST client for the four watched kinds + status subresource.
 
     One short-lived connection per request; ``watch`` holds a streaming
     connection and yields decoded watch events.
-    """
 
-    def __init__(self, config: RestConfig, timeout: float = 10.0):
+    Mutating verbs (POST/PUT) pass a client-side token bucket
+    (``qps``/``burst``), mirroring client-go's rest.Config rate limiting
+    that the reference inherits (plugin.go:71 BuildConfigFromFlags →
+    default 5 QPS / 10 burst). The defaults here are the kube-scheduler's
+    clientConnection values (50/100): the streaming status pipeline
+    sustains ~1k coalesced writes/sec against the in-memory store, and a
+    5-QPS ceiling would make the remote mode's write lag pathological.
+    Reads are not limited — they are a handful of long-lived watches.
+    ``qps=None`` disables limiting (in-process/mock servers)."""
+
+    def __init__(
+        self,
+        config: RestConfig,
+        timeout: float = 10.0,
+        qps: Optional[float] = 50.0,
+        burst: int = 100,
+    ):
         self.config = config
         self.timeout = timeout
+        self._write_bucket = _TokenBucket(qps, burst) if qps else None
         split = urlsplit(config.server)
         if split.scheme not in ("http", "https"):
             raise ValueError(f"unsupported server scheme: {config.server!r}")
@@ -364,12 +414,16 @@ class ApiClient:
 
     def post(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
         """POST (create) a JSON document; 409 raises ConflictError."""
+        if self._write_bucket is not None:
+            self._write_bucket.take()
         return self._request("POST", path, body=body)
 
     def put(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
         """PUT a JSON document (status-subresource / lease writes). The body
         must carry ``metadata.resourceVersion`` for optimistic concurrency;
         409 raises ConflictError."""
+        if self._write_bucket is not None:
+            self._write_bucket.take()
         return self._request("PUT", path, body=body)
 
 
@@ -803,10 +857,17 @@ class RemoteSession:
 
     KINDS = ("Namespace", "Throttle", "ClusterThrottle", "Pod")
 
-    def __init__(self, config: RestConfig, store: Store, metrics_registry=None):
+    def __init__(
+        self,
+        config: RestConfig,
+        store: Store,
+        metrics_registry=None,
+        qps: Optional[float] = 50.0,
+        burst: int = 100,
+    ):
         self.config = config
         self.store = store
-        self.client = ApiClient(config)
+        self.client = ApiClient(config, qps=qps, burst=burst)
         self.versions = RemoteVersions()
         metrics = (
             ReflectorMetrics(metrics_registry) if metrics_registry is not None else None

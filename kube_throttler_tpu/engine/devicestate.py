@@ -687,11 +687,13 @@ class DeviceStateManager:
         store.add_event_handler("Throttle", self._on_throttle)
         store.add_event_handler("ClusterThrottle", self._on_cluster_throttle)
 
-    def prewarm(self, ladder_max: int = DELTA_BATCH_MAX) -> int:
+    def prewarm(self) -> int:
         """Compile the steady-state device kernels for every bucket shape
-        up front (the pow4 ladder ≤ ladder_max), so serving never hits a
-        mid-burst XLA compile — one compile is ~10-100ms on CPU and can be
-        seconds through a cold TPU tunnel, which lands straight in the
+        up front (the ladder ≤ DELTA_BATCH_MAX — the same constant
+        apply_agg_work caps its dispatches at, so the warmed set and the
+        live shapes cannot diverge), so serving never hits a mid-burst XLA
+        compile — one compile is ~10-100ms on CPU and can be seconds
+        through a cold TPU tunnel, which lands straight in the
         event→status lag tail. All warm dispatches are semantic no-ops
         (padding-only indices) against the live handles. Returns the number
         of kernel dispatches issued. Call after cache sync, before serving.
@@ -701,7 +703,7 @@ class DeviceStateManager:
         from ..ops.aggregate import aggregate_used, apply_pod_deltas_batched, rebase_cols
         from ..ops.fastcheck import fast_check_pod_packed
 
-        ladder = _bucket_ladder(ladder_max)
+        ladder = _bucket_ladder(DELTA_BATCH_MAX)
         # warm dispatches EXECUTE, not just compile: the full-reduction
         # kernels (aggregate_used, rebase_cols over [pcap, kb, R]) cost
         # real seconds on a single host core, so on CPU — where a compile

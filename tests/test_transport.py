@@ -309,6 +309,41 @@ class TestStatusWriter:
             writer.update_throttle_status(thr)
 
 
+class TestWriteRateLimit:
+    def test_token_bucket_burst_then_paced(self):
+        from kube_throttler_tpu.client.transport import _TokenBucket
+
+        tb = _TokenBucket(qps=100.0, burst=5)
+        t0 = time.monotonic()
+        for _ in range(5):
+            tb.take()  # burst: immediate
+        burst_t = time.monotonic() - t0
+        assert burst_t < 0.05, f"burst takes should not wait ({burst_t:.3f}s)"
+        t0 = time.monotonic()
+        for _ in range(10):
+            tb.take()  # drained: ~1/qps each
+        paced_t = time.monotonic() - t0
+        assert paced_t >= 0.08, f"drained takes must pace at qps ({paced_t:.3f}s)"
+
+    def test_writes_pass_the_bucket_reads_do_not(self, apiserver):
+        apiserver.store.create_throttle(_throttle("t1", {"a": "b"}, pod=5))
+        client = ApiClient(RestConfig(server=apiserver.url), qps=10_000.0, burst=1)
+        taken = []
+        orig = client._write_bucket.take
+        client._write_bucket.take = lambda: (taken.append(1), orig())[1]
+        client.list("Throttle")  # read: no bucket
+        assert taken == []
+        thr = apiserver.store.get_throttle("default", "t1")
+        # no tracked rv → the PUT omits resourceVersion (no optimistic check)
+        RemoteStatusWriter(client, RemoteVersions()).update_throttle_status(thr)
+        assert len(taken) == 1  # the PUT took a token
+
+    def test_disabled_bucket(self, apiserver):
+        client = ApiClient(RestConfig(server=apiserver.url), qps=None)
+        assert client._write_bucket is None
+        client.list("Throttle")  # still works
+
+
 class TestRemoteModeGuards:
     def test_http_surface_refuses_local_writes_in_remote_mode(self, apiserver):
         import json as _json
