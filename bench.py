@@ -39,9 +39,15 @@ sys.path.insert(0, ".")
 
 import numpy as np
 
-from kube_throttler_tpu.utils.platform import honor_jax_platforms_env
+from kube_throttler_tpu.utils.platform import (
+    enable_persistent_compilation_cache,
+    honor_jax_platforms_env,
+)
 
 honor_jax_platforms_env()  # must run before the first backend init
+# compiles dominate TPU cold-start; the on-disk cache survives the probe
+# subprocess, the CPU re-exec, and repeat runs
+enable_persistent_compilation_cache()
 
 import jax
 import jax.numpy as jnp

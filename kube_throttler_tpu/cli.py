@@ -174,6 +174,9 @@ def main(argv: Optional[list] = None) -> int:
     except ValueError as e:
         parser.error(str(e))  # clean usage error, not a traceback
 
+    if args.api_qps > 0 and args.api_burst < 1:
+        parser.error("--api-burst must be >= 1 when --api-qps is enabled")
+
     if plugin_args.kubeconfig and args.nodes > 0:
         # the embedded scheduler binds pods in the LOCAL store; in remote
         # mode the reflectors own those objects and would revert every bind
@@ -294,7 +297,12 @@ def main(argv: Optional[list] = None) -> int:
     )
     if plugin.device_manager is not None:
         # compile the steady-state kernel shapes before taking traffic —
-        # a mid-burst XLA compile would land in the serving latency tail
+        # a mid-burst XLA compile would land in the serving latency tail.
+        # The persistent cache makes restarts deserialize instead of
+        # recompile (KT_JAX_CACHE_DIR overrides the location).
+        from .utils.platform import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache()
         _t0 = _time.perf_counter()
         _nk = plugin.device_manager.prewarm()
         print(

@@ -20,3 +20,41 @@ def honor_jax_platforms_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def enable_persistent_compilation_cache(path: str = "") -> bool:
+    """Point XLA's persistent compilation cache at a writable directory.
+
+    Compiles dominate cold-start on a TPU tunnel (seconds per shape; the
+    prewarm ladder alone is ~30 shapes) and are pure recomputation across
+    processes — the bench's backend probe, its CPU re-exec, every daemon
+    restart. The on-disk cache makes the second process deserialize in
+    milliseconds instead. Safe to share across platforms: cache keys
+    include the backend/topology. Returns False (and stays off) when the
+    config knob is unavailable or the dir cannot be created.
+    """
+    import stat
+    import tempfile
+
+    path = path or os.environ.get(
+        "KT_JAX_CACHE_DIR",
+        # per-user path in shared tmp: a fixed name would let another user
+        # pre-create the dir and plant cache entries this process would
+        # deserialize as compiled executables
+        os.path.join(tempfile.gettempdir(), f"kt-jax-cache-{os.getuid()}"),
+    )
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.stat(path)
+        if st.st_uid != os.getuid() or (st.st_mode & stat.S_IWOTH):
+            return False  # someone else's (or world-writable) dir — refuse
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache small computations too — this workload is many small
+        # scatter/gather shapes (~10-100ms compiles on CPU), all under the
+        # default threshold
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return True
+    except Exception:
+        return False
