@@ -150,7 +150,8 @@ class ClusterThrottleController(ControllerBase):
             return {}
         errors: Dict[str, Exception] = {}
         used_map = None
-        if self.device_manager is not None:
+        dm = self.device_manager
+        if dm is not None and dm.device_available():
             try:
                 reserved = {
                     t.key: self.cache.reserved_pod_keys(t.key) for t in thrs.values()
@@ -159,7 +160,10 @@ class ClusterThrottleController(ControllerBase):
                     self.KIND, [t.key for t in thrs.values()], reserved
                 )
             except Exception as e:
-                return {key: e for key in keys}
+                # breaker opens; reconcile via the host walk below (the
+                # mask read is host-side), statuses keep converging
+                dm.note_device_failure("reconcile", e)
+                used_map = None
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
@@ -319,23 +323,30 @@ class ClusterThrottleController(ControllerBase):
     ) -> Tuple[
         List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle]
     ]:
-        if self.device_manager is not None:
+        dm = self.device_manager
+        if dm is not None and dm.device_available():
             # the missing-namespace error contract holds on the device path
             # too (clusterthrottle_controller.go:273-276)
             if self._get_namespace(pod.namespace) is None:
                 raise NotFoundError(f"namespace {pod.namespace!r} not found")
-            results = self.device_manager.check_pod(pod, self.KIND, is_throttled_on_equal)
-            active, insufficient, exceeds, affected = [], [], [], []
-            for key, status in results.items():
-                thr = self._get_cluster_throttle(key.lstrip("/"))
-                affected.append(thr)
-                if status == "active":
-                    active.append(thr)
-                elif status == "insufficient":
-                    insufficient.append(thr)
-                elif status == "pod-requests-exceeds-threshold":
-                    exceeds.append(thr)
-            return active, insufficient, exceeds, affected
+            try:
+                results = dm.check_pod(pod, self.KIND, is_throttled_on_equal)
+            except Exception as e:
+                # breaker opens; fall through to the host oracle below, so a
+                # device outage degrades latency, never availability
+                dm.note_device_failure("check", e)
+            else:
+                active, insufficient, exceeds, affected = [], [], [], []
+                for key, status in results.items():
+                    thr = self._get_cluster_throttle(key.lstrip("/"))
+                    affected.append(thr)
+                    if status == "active":
+                        active.append(thr)
+                    elif status == "insufficient":
+                        insufficient.append(thr)
+                    elif status == "pod-requests-exceeds-threshold":
+                        exceeds.append(thr)
+                return active, insufficient, exceeds, affected
         throttles = self.affected_cluster_throttles(pod)
         active: List[ClusterThrottle] = []
         insufficient: List[ClusterThrottle] = []

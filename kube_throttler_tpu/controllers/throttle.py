@@ -145,14 +145,19 @@ class ThrottleController(ControllerBase):
             return {}
         errors: Dict[str, Exception] = {}
         used_map = None
-        if self.device_manager is not None:
+        dm = self.device_manager
+        if dm is not None and dm.device_available():
             try:
                 reserved = {key: self.cache.reserved_pod_keys(key) for key in thrs}
                 used_map = self.device_manager.aggregate_used_for(
                     self.KIND, list(thrs), reserved
                 )
-            except Exception as e:  # device failure fails the whole batch
-                return {key: e for key in keys}
+            except Exception as e:
+                # breaker opens; this batch reconciles via the host walk
+                # below (matched_pods reads the host-side mask, no device),
+                # so statuses keep converging through a device outage
+                dm.note_device_failure("reconcile", e)
+                used_map = None
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
@@ -304,21 +309,29 @@ class ThrottleController(ControllerBase):
         (throttle_controller.go:349-397).
 
         With a device manager the classification runs as one kernel call
-        over the mirrored tensors; otherwise the host oracle loops."""
-        if self.device_manager is not None:
-            results = self.device_manager.check_pod(pod, self.KIND, is_throttled_on_equal)
-            active, insufficient, exceeds, affected = [], [], [], []
-            for key, status in results.items():
-                namespace, _, name = key.partition("/")
-                thr = self._get_throttle(namespace, name)
-                affected.append(thr)
-                if status == "active":
-                    active.append(thr)
-                elif status == "insufficient":
-                    insufficient.append(thr)
-                elif status == "pod-requests-exceeds-threshold":
-                    exceeds.append(thr)
-            return active, insufficient, exceeds, affected
+        over the mirrored tensors; otherwise — or while the device circuit
+        breaker is open after a dispatch failure (backend/tunnel death) —
+        the host oracle loops, so a device outage degrades latency, never
+        availability."""
+        dm = self.device_manager
+        if dm is not None and dm.device_available():
+            try:
+                results = dm.check_pod(pod, self.KIND, is_throttled_on_equal)
+            except Exception as e:
+                dm.note_device_failure("check", e)
+            else:
+                active, insufficient, exceeds, affected = [], [], [], []
+                for key, status in results.items():
+                    namespace, _, name = key.partition("/")
+                    thr = self._get_throttle(namespace, name)
+                    affected.append(thr)
+                    if status == "active":
+                        active.append(thr)
+                    elif status == "insufficient":
+                        insufficient.append(thr)
+                    elif status == "pod-requests-exceeds-threshold":
+                        exceeds.append(thr)
+                return active, insufficient, exceeds, affected
         throttles = self.affected_throttles(pod)
         active: List[Throttle] = []
         insufficient: List[Throttle] = []

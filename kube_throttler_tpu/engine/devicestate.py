@@ -22,7 +22,9 @@ same contract as informer handlers); reads (``check_pod``,
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
@@ -38,6 +40,8 @@ from .reservations import ReservedResourceAmounts
 from .store import Event, EventType, Store
 from ..ops.check import CHECK_NOT_AFFECTED, STATUS_NAMES, check_pods, check_pods_compact
 from ..ops.schema import DimRegistry, PodBatch, ThrottleState
+
+logger = logging.getLogger(__name__)
 
 AnyThrottle = Union[Throttle, ClusterThrottle]
 
@@ -681,11 +685,41 @@ class DeviceStateManager:
         # (mesh, on_equal, step3) — rebuilding the jit wrapper per call
         # would recompile every tick
         self._sharded_steps: dict = {}
+        # device circuit breaker: a failed dispatch (backend/tunnel died)
+        # opens it for a cooldown so callers fall back to their host-oracle
+        # paths instead of paying a failing dispatch per decision. The host
+        # staging keeps accumulating during an outage (handlers are pure
+        # numpy) and the pending-overflow full-rebase mark self-heals the
+        # aggregates on recovery, so reopening needs no special resync.
+        self.device_retry_cooldown = 30.0
+        self._device_down_until = 0.0
+        self._monotonic = None  # test injection point; defaults to time.monotonic
+        self.fallback_counter = None  # CounterVec set by the plugin
 
         store.add_event_handler("Namespace", self._on_namespace)
         store.add_event_handler("Pod", self._on_pod)
         store.add_event_handler("Throttle", self._on_throttle)
         store.add_event_handler("ClusterThrottle", self._on_cluster_throttle)
+
+    def _now_monotonic(self) -> float:
+        return (self._monotonic or time.monotonic)()
+
+    def device_available(self) -> bool:
+        """False while the circuit breaker is open (recent device failure);
+        callers should serve from their host-oracle paths meanwhile."""
+        return self._now_monotonic() >= self._device_down_until
+
+    def note_device_failure(self, surface: str, exc: BaseException) -> None:
+        """Open the breaker for ``device_retry_cooldown`` seconds and count
+        the fallback. Called by controllers when a device dispatch raises
+        (tunnel drop, backend death) right before they fall back to host."""
+        self._device_down_until = self._now_monotonic() + self.device_retry_cooldown
+        if self.fallback_counter is not None:
+            self.fallback_counter.inc({"surface": surface})
+        logger.warning(
+            "device dispatch failed on %s (%s: %s); serving host-side for %.0fs",
+            surface, exc.__class__.__name__, str(exc)[:200], self.device_retry_cooldown,
+        )
 
     def prewarm(self) -> int:
         """Compile the steady-state device kernels for every bucket shape

@@ -111,6 +111,12 @@ class KubeThrottler:
         )
         if self.device_manager is not None:
             self.device_manager.tracer = self.tracer
+            self.device_manager.fallback_counter = self.metrics_registry.counter_vec(
+                "kube_throttler_device_fallback_total",
+                "dispatch failures that opened the device circuit breaker "
+                "(decisions/reconciles served host-side meanwhile)",
+                ["surface"],
+            )
         self.throttle_ctr.tracer = self.tracer
         self.cluster_throttle_ctr.tracer = self.tracer
         if start_workers:
@@ -206,27 +212,34 @@ class KubeThrottler:
             known_ns = {ns.name for ns in self.listers.namespaces.list()}
             schedulable: dict = {}
             errors: list = []
-            if self.device_manager is None:
-                # host oracle, side-effect-free (no Warning events — triage
-                # only, matching the device path)
-                for pod in self.listers.pods.list():
-                    try:
-                        ta, ti, te, _ = self.throttle_ctr.check_throttled(pod, False)
-                        ca, ci, ce, _ = self.cluster_throttle_ctr.check_throttled(pod, False)
-                    except Exception:
-                        errors.append(pod.key)
-                        continue
-                    schedulable[pod.key] = not (ta or ti or te or ca or ci or ce)
-                return {"schedulable": schedulable, "errors": errors}
+            dm = self.device_manager
+            if dm is not None and dm.device_available():
+                try:
+                    # one coherent device snapshot for BOTH kinds (a single
+                    # lock hold inside check_batch_all) — the composed
+                    # verdict matches one point in the event stream
+                    per_kind = {
+                        kind: (ok, rows)
+                        for kind, (_, ok, rows) in dm.check_batch_all(False).items()
+                    }
+                except Exception as e:
+                    # breaker opens; this and subsequent batch calls serve
+                    # from the host oracle below until the cooldown expires
+                    dm.note_device_failure("batch", e)
+                else:
+                    schedulable, errors = self._merge_verdicts(per_kind, known_ns)
+                    return {"schedulable": schedulable, "errors": errors}
 
-            # one coherent device snapshot for BOTH kinds (a single lock
-            # hold inside check_batch_all) — the composed verdict matches
-            # one point in the event stream
-            per_kind = {
-                kind: (ok, rows)
-                for kind, (_, ok, rows) in self.device_manager.check_batch_all(False).items()
-            }
-            schedulable, errors = self._merge_verdicts(per_kind, known_ns)
+            # host oracle, side-effect-free (no Warning events — triage
+            # only, matching the device path)
+            for pod in self.listers.pods.list():
+                try:
+                    ta, ti, te, _ = self.throttle_ctr.check_throttled(pod, False)
+                    ca, ci, ce, _ = self.cluster_throttle_ctr.check_throttled(pod, False)
+                except Exception:
+                    errors.append(pod.key)
+                    continue
+                schedulable[pod.key] = not (ta or ti or te or ca or ci or ce)
             return {"schedulable": schedulable, "errors": errors}
 
     @staticmethod

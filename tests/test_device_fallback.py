@@ -1,0 +1,172 @@
+"""Device circuit breaker: a failed device dispatch (tunnel drop, backend
+death) must degrade LATENCY, never availability — decisions and reconciles
+fall back to the host-oracle paths, the breaker skips the device for a
+cooldown, and service resumes on the device after it.
+"""
+
+import pytest
+
+from kube_throttler_tpu.api.pod import Namespace, make_pod
+from kube_throttler_tpu.api.types import (
+    LabelSelector,
+    ResourceAmount,
+    Throttle,
+    ThrottleSelector,
+    ThrottleSelectorTerm,
+    ThrottleSpec,
+)
+from kube_throttler_tpu.engine.store import Store
+from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+from kube_throttler_tpu.plugin.framework import StatusCode
+
+
+def _throttle(name="t1", cpu="200m"):
+    return Throttle(
+        name=name,
+        spec=ThrottleSpec(
+            throttler_name="kube-throttler",
+            threshold=ResourceAmount.of(requests={"cpu": cpu}),
+            selector=ThrottleSelector(
+                selector_terms=(
+                    ThrottleSelectorTerm(
+                        pod_selector=LabelSelector(match_labels={"grp": "a"})
+                    ),
+                )
+            ),
+        ),
+    )
+
+
+@pytest.fixture()
+def stack():
+    store = Store()
+    plugin = KubeThrottler(
+        decode_plugin_args(
+            {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+        ),
+        store,
+        use_device=True,
+        start_workers=False,
+    )
+    store.create_namespace(Namespace("default"))
+    store.create_throttle(_throttle())
+    store.create_pod(
+        make_pod(
+            "running",
+            labels={"grp": "a"},
+            requests={"cpu": "150m"},
+            node_name="n1",
+            phase="Running",
+        )
+    )
+    plugin.run_pending_once()
+    return store, plugin
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+def _break_device(dm, method):
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise _Boom("tunnel died")
+
+    setattr(dm, method, boom)
+    return calls
+
+
+class TestCheckFallback:
+    def test_prefilter_survives_device_failure(self, stack):
+        store, plugin = stack
+        dm = plugin.device_manager
+        pending = make_pod("pending", labels={"grp": "a"}, requests={"cpu": "100m"})
+
+        # healthy: device path serves, and the verdict is 'insufficient'
+        # (150m used of 200m, +100m would exceed)
+        st = plugin.pre_filter(pending)
+        assert st.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert st.reasons == ("throttle[insufficient]=default/t1",)
+
+        calls = _break_device(dm, "check_pod")
+        st = plugin.pre_filter(pending)
+        assert st.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert st.reasons == ("throttle[insufficient]=default/t1",)
+        assert calls == [1], "first failing dispatch opens the breaker"
+
+        # breaker open: the device is not touched again within the cooldown
+        st = plugin.pre_filter(pending)
+        assert st.code == StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert calls == [1]
+
+        # fallback counted per surface
+        counter = plugin.metrics_registry.counter_vec(
+            "kube_throttler_device_fallback_total", "", ["surface"]
+        )
+        assert counter.collect()[("check",)] == 1.0
+
+        # a schedulable pod stays schedulable host-side
+        small = make_pod("small", labels={"grp": "a"}, requests={"cpu": "10m"})
+        assert plugin.pre_filter(small).code == StatusCode.SUCCESS
+
+    def test_breaker_reopens_after_cooldown(self, stack):
+        _, plugin = stack
+        dm = plugin.device_manager
+        now = [100.0]
+        dm._monotonic = lambda: now[0]
+
+        calls = _break_device(dm, "check_pod")
+        pending = make_pod("pending", labels={"grp": "a"}, requests={"cpu": "100m"})
+        plugin.pre_filter(pending)
+        assert calls == [1] and not dm.device_available()
+
+        now[0] += dm.device_retry_cooldown + 1
+        assert dm.device_available()
+        plugin.pre_filter(pending)  # device retried (and fails again)
+        assert calls == [1, 1]
+
+
+class TestBatchFallback:
+    def test_prefilter_batch_survives_device_failure(self, stack):
+        store, plugin = stack
+        dm = plugin.device_manager
+        healthy = plugin.pre_filter_batch()
+        # the running pod classifies against state already containing it
+        # (used 150m + own 150m > 200m → insufficient): not schedulable
+        assert healthy["schedulable"] == {"default/running": False}
+
+        calls = _break_device(dm, "check_batch_all")
+        out = plugin.pre_filter_batch()
+        assert out["schedulable"] == healthy["schedulable"]
+        assert calls == [1]
+        out = plugin.pre_filter_batch()  # breaker open: device untouched
+        assert out["schedulable"] == healthy["schedulable"]
+        assert calls == [1]
+
+
+class TestReconcileFallback:
+    def test_status_converges_host_side(self, stack):
+        store, plugin = stack
+        dm = plugin.device_manager
+        _break_device(dm, "aggregate_used_for")
+
+        store.create_pod(
+            make_pod(
+                "running2",
+                labels={"grp": "a"},
+                requests={"cpu": "40m"},
+                node_name="n1",
+                phase="Running",
+            )
+        )
+        plugin.run_pending_once()
+        thr = store.get_throttle("default", "t1")
+        # host-walk reconcile landed the fresh aggregate: 150m + 40m
+        assert thr.status.used.resource_counts == 2
+        assert str(thr.status.used.resource_requests["cpu"]) == "19/100"
+        counter = plugin.metrics_registry.counter_vec(
+            "kube_throttler_device_fallback_total", "", ["surface"]
+        )
+        assert counter.collect()[("reconcile",)] >= 1.0
