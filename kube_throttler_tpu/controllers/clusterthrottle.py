@@ -151,19 +151,19 @@ class ClusterThrottleController(ControllerBase):
         errors: Dict[str, Exception] = {}
         used_map = None
         dm = self.device_manager
-        if dm is not None and dm.device_available():
-            try:
-                reserved = {
-                    t.key: self.cache.reserved_pod_keys(t.key) for t in thrs.values()
-                }
-                used_map = self.device_manager.aggregate_used_for(
-                    self.KIND, [t.key for t in thrs.values()], reserved
-                )
-            except Exception as e:
-                # breaker opens; reconcile via the host walk below (the
-                # mask read is host-side), statuses keep converging
-                dm.note_device_failure("reconcile", e)
-                used_map = None
+        if dm is not None:
+            # on breaker-open/failure reconcile falls to the host walk
+            # below (the mask read is host-side); statuses keep converging
+            reserved = {
+                t.key: self.cache.reserved_pod_keys(t.key) for t in thrs.values()
+            }
+            used_map = dm.guarded(
+                "reconcile",
+                dm.aggregate_used_for,
+                self.KIND,
+                [t.key for t in thrs.values()],
+                reserved,
+            )
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
@@ -324,18 +324,14 @@ class ClusterThrottleController(ControllerBase):
         List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle]
     ]:
         dm = self.device_manager
-        if dm is not None and dm.device_available():
+        if dm is not None:
             # the missing-namespace error contract holds on the device path
-            # too (clusterthrottle_controller.go:273-276)
-            if self._get_namespace(pod.namespace) is None:
+            # too (clusterthrottle_controller.go:273-276); with the breaker
+            # open the host path below enforces it itself
+            if dm.device_available() and self._get_namespace(pod.namespace) is None:
                 raise NotFoundError(f"namespace {pod.namespace!r} not found")
-            try:
-                results = dm.check_pod(pod, self.KIND, is_throttled_on_equal)
-            except Exception as e:
-                # breaker opens; fall through to the host oracle below, so a
-                # device outage degrades latency, never availability
-                dm.note_device_failure("check", e)
-            else:
+            results = dm.guarded("check", dm.check_pod, pod, self.KIND, is_throttled_on_equal)
+            if results is not None:
                 active, insufficient, exceeds, affected = [], [], [], []
                 for key, status in results.items():
                     thr = self._get_cluster_throttle(key.lstrip("/"))

@@ -146,18 +146,14 @@ class ThrottleController(ControllerBase):
         errors: Dict[str, Exception] = {}
         used_map = None
         dm = self.device_manager
-        if dm is not None and dm.device_available():
-            try:
-                reserved = {key: self.cache.reserved_pod_keys(key) for key in thrs}
-                used_map = self.device_manager.aggregate_used_for(
-                    self.KIND, list(thrs), reserved
-                )
-            except Exception as e:
-                # breaker opens; this batch reconciles via the host walk
-                # below (matched_pods reads the host-side mask, no device),
-                # so statuses keep converging through a device outage
-                dm.note_device_failure("reconcile", e)
-                used_map = None
+        if dm is not None:
+            # on breaker-open/failure this batch reconciles via the host
+            # walk below (matched_pods reads the host-side mask, no
+            # device), so statuses keep converging through a device outage
+            reserved = {key: self.cache.reserved_pod_keys(key) for key in thrs}
+            used_map = dm.guarded(
+                "reconcile", dm.aggregate_used_for, self.KIND, list(thrs), reserved
+            )
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
@@ -314,12 +310,9 @@ class ThrottleController(ControllerBase):
         the host oracle loops, so a device outage degrades latency, never
         availability."""
         dm = self.device_manager
-        if dm is not None and dm.device_available():
-            try:
-                results = dm.check_pod(pod, self.KIND, is_throttled_on_equal)
-            except Exception as e:
-                dm.note_device_failure("check", e)
-            else:
+        if dm is not None:
+            results = dm.guarded("check", dm.check_pod, pod, self.KIND, is_throttled_on_equal)
+            if results is not None:
                 active, insufficient, exceeds, affected = [], [], [], []
                 for key, status in results.items():
                     namespace, _, name = key.partition("/")

@@ -709,6 +709,23 @@ class DeviceStateManager:
         callers should serve from their host-oracle paths meanwhile."""
         return self._now_monotonic() >= self._device_down_until
 
+    def guarded(self, surface: str, fn, *args, **kwargs):
+        """Run one device dispatch behind the circuit breaker.
+
+        Returns the dispatch result, or None when the breaker is open or
+        the dispatch raised (opening it). THE single guard implementation —
+        every serving surface (per-pod check, batch triage, reconcile)
+        routes through here so breaker semantics cannot drift between
+        hand-rolled copies. All guarded dispatches return dicts, so None
+        is unambiguous."""
+        if not self.device_available():
+            return None
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — any dispatch failure opens it
+            self.note_device_failure(surface, e)
+            return None
+
     def note_device_failure(self, surface: str, exc: BaseException) -> None:
         """Open the breaker for ``device_retry_cooldown`` seconds and count
         the fallback. Called by controllers when a device dispatch raises

@@ -206,27 +206,21 @@ class KubeThrottler:
         batch must not report them schedulable. Per-pod reasons stay on
         ``pre_filter``.
         """
-        import numpy as np
-
         with self.tracer.trace("prefilter_batch"):
             known_ns = {ns.name for ns in self.listers.namespaces.list()}
             schedulable: dict = {}
             errors: list = []
             dm = self.device_manager
-            if dm is not None and dm.device_available():
-                try:
-                    # one coherent device snapshot for BOTH kinds (a single
-                    # lock hold inside check_batch_all) — the composed
-                    # verdict matches one point in the event stream
+            if dm is not None:
+                # one coherent device snapshot for BOTH kinds (a single
+                # lock hold inside check_batch_all) — the composed verdict
+                # matches one point in the event stream. On breaker-open/
+                # failure, batch calls serve from the host oracle below.
+                batches = dm.guarded("batch", dm.check_batch_all, False)
+                if batches is not None:
                     per_kind = {
-                        kind: (ok, rows)
-                        for kind, (_, ok, rows) in dm.check_batch_all(False).items()
+                        kind: (ok, rows) for kind, (_, ok, rows) in batches.items()
                     }
-                except Exception as e:
-                    # breaker opens; this and subsequent batch calls serve
-                    # from the host oracle below until the cooldown expires
-                    dm.note_device_failure("batch", e)
-                else:
                     schedulable, errors = self._merge_verdicts(per_kind, known_ns)
                     return {"schedulable": schedulable, "errors": errors}
 

@@ -6,6 +6,8 @@ standalone TPU framework exposes the same operations over HTTP so any
 scheduler (or test driver) can use it without embedding Python:
 
 - ``GET  /healthz``                  liveness
+- ``GET  /readyz``                   component readiness (device breaker,
+                                     workqueue depths)
 - ``GET  /metrics``                  Prometheus exposition (the 16 families)
 - ``POST /v1/objects``               create-or-update a manifest
                                      (Pod / Namespace / Throttle / ClusterThrottle)
@@ -157,6 +159,28 @@ class ThrottlerHTTPServer:
     def _get(self, h) -> None:
         if h.path == "/healthz":
             h._send(200, "ok", content_type="text/plain")
+        elif h.path == "/readyz":
+            # component readiness: workqueue depths, device breaker state.
+            # 200 while serving is possible (the device being down is a
+            # degraded-latency state, not unreadiness — the host oracle
+            # serves); deep JSON for operators/probes that want detail.
+            dm = self.plugin.device_manager
+            body = {
+                "ok": True,
+                "device": (
+                    {"enabled": False}
+                    if dm is None
+                    else {
+                        "enabled": True,
+                        "available": dm.device_available(),
+                    }
+                ),
+                "workqueues": {
+                    "throttle": len(self.plugin.throttle_ctr.workqueue),
+                    "clusterthrottle": len(self.plugin.cluster_throttle_ctr.workqueue),
+                },
+            }
+            h._send(200, body)
         elif h.path == "/metrics":
             h._send(
                 200,

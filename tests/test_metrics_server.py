@@ -94,6 +94,10 @@ class TestHTTPServer:
 
         code, _ = _req(server, "GET", "/healthz")
         assert code == 200
+        code, ready = _req(server, "GET", "/readyz")
+        assert code == 200 and ready["ok"]
+        assert ready["device"]["enabled"] and ready["device"]["available"]
+        assert set(ready["workqueues"]) == {"throttle", "clusterthrottle"}
 
         # apply a throttle and two pods via manifests
         code, out = _req(
