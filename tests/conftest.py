@@ -90,3 +90,15 @@ class ProcReader:
                 continue
             self.seen.append(line)
             assert not re.search(pattern, line), (pattern, self.seen)
+
+
+def normalize_reasons(reasons):
+    """Order-insensitive form of PreFilter reason strings: throttle-name
+    order within one reason is not part of the contract (set iteration
+    differs between the device decode and the host walk). Shared by the
+    differential and flaky-device soaks."""
+    out = []
+    for r in reasons:
+        head, _, names = r.partition("=")
+        out.append(f"{head}={','.join(sorted(names.split(',')))}")
+    return sorted(out)

@@ -146,6 +146,121 @@ class TestBatchFallback:
         assert calls == [1]
 
 
+class TestFlakyDeviceSoak:
+    @pytest.mark.parametrize("seed", [11, 23])
+    def test_verdicts_stay_oracle_correct_through_outages(self, seed):
+        """Random churn while the device flips between healthy and failing:
+        at every checkpoint the (possibly degraded) device stack must agree
+        with a pure host-oracle stack — across outage windows, breaker
+        cooldown reopenings, and post-recovery device serving (the staged
+        aggregates must self-heal when the device returns)."""
+        import random
+
+        from dataclasses import replace
+
+        rng = random.Random(seed)
+
+        def _mk(use_device):
+            store = Store()
+            plugin = KubeThrottler(
+                decode_plugin_args(
+                    {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+                ),
+                store,
+                use_device=use_device,
+                start_workers=False,
+            )
+            store.create_namespace(Namespace("default"))
+            return store, plugin
+
+        (store_d, plug_d), (store_h, plug_h) = _mk(True), _mk(False)
+        dm = plug_d.device_manager
+        now = [1000.0]
+        dm._monotonic = lambda: now[0]
+        down = [False]
+
+        def flaky(real):
+            def f(*a, **k):
+                if down[0]:
+                    raise RuntimeError("injected tunnel failure")
+                return real(*a, **k)
+
+            return f
+
+        dm.check_pod = flaky(dm.check_pod)
+        dm.aggregate_used_for = flaky(dm.aggregate_used_for)
+
+        pods = []
+
+        def both(fn):
+            fn(store_d)
+            fn(store_h)
+
+        from conftest import normalize_reasons as norm
+
+        def checkpoint():
+            plug_d.run_pending_once()
+            plug_h.run_pending_once()
+            for pod in pods:
+                sd, sh = plug_d.pre_filter(pod), plug_h.pre_filter(pod)
+                assert sd.code == sh.code, (pod.key, down[0], sd.reasons, sh.reasons)
+                assert norm(sd.reasons) == norm(sh.reasons), pod.key
+            for thr_d in store_d.list_throttles():
+                thr_h = store_h.get_throttle(thr_d.namespace, thr_d.name)
+                assert thr_d.status.used.to_dict() == thr_h.status.used.to_dict(), (
+                    thr_d.key,
+                    down[0],
+                )
+
+        for step in range(90):
+            op = rng.random()
+            if op < 0.2:
+                name = f"t{rng.randint(0, 4)}"
+                thr = _throttle(name, cpu=f"{rng.randint(1, 6)}00m")
+
+                def apply_thr(s, thr=thr):
+                    try:
+                        s.create_throttle(thr)
+                    except ValueError:
+                        cur = s.get_throttle("default", thr.name)
+                        s.update_throttle(replace(thr, status=cur.status))
+
+                both(apply_thr)
+            elif op < 0.55 or not pods:
+                pod = make_pod(
+                    f"p{step}",
+                    labels={"grp": rng.choice("ab")},
+                    requests={"cpu": f"{rng.randint(1, 5)}00m"},
+                    node_name="n1" if rng.random() < 0.6 else "",
+                    phase="Running" if rng.random() < 0.5 else "Pending",
+                )
+                pods.append(pod)
+                both(lambda s, pod=pod: s.create_pod(pod))
+            elif op < 0.75:
+                old = rng.choice(pods)
+                moved = replace(old, labels={"grp": rng.choice("ab")})
+                pods[pods.index(old)] = moved
+                both(lambda s, moved=moved: s.update_pod(moved))
+            elif op < 0.85:
+                pod = rng.choice(pods)
+                sd, sh = plug_d.reserve(pod), plug_h.reserve(pod)
+                assert sd.code == sh.code
+            else:
+                pod = pods.pop(rng.randrange(len(pods)))
+                both(lambda s, pod=pod: s.delete_pod(pod.namespace, pod.name))
+
+            if step % 15 == 14:
+                # flip device health; advancing past the cooldown lets the
+                # breaker retry (and re-open if still down)
+                down[0] = not down[0]
+                now[0] += dm.device_retry_cooldown + 1
+            if step % 9 == 8:
+                checkpoint()
+        down[0] = False
+        now[0] += dm.device_retry_cooldown + 1
+        checkpoint()  # final: device healthy again, healed state serves
+
+
 class TestReconcileFallback:
     def test_status_converges_host_side(self, stack):
         store, plugin = stack

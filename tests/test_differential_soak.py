@@ -117,12 +117,7 @@ def _rand_overrides(rng):
     return tuple(out)
 
 
-def _normalize_reasons(reasons):
-    out = []
-    for r in reasons:
-        head, _, names = r.partition("=")
-        out.append(f"{head}={','.join(sorted(names.split(',')))}")
-    return sorted(out)
+from conftest import normalize_reasons as _normalize_reasons
 
 
 def _status_dict(thr):
