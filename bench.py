@@ -45,9 +45,6 @@ from kube_throttler_tpu.utils.platform import (
 )
 
 honor_jax_platforms_env()  # must run before the first backend init
-# compiles dominate TPU cold-start; the on-disk cache survives the probe
-# subprocess, the CPU re-exec, and repeat runs
-enable_persistent_compilation_cache()
 
 import jax
 import jax.numpy as jnp
@@ -1032,6 +1029,10 @@ def main():
     log(f"devices: {devices}")
     platform = devices[0].platform if devices else "none"
     RESULT_STATE["platform"] = platform
+    # accelerator backends only (the helper itself declines on CPU): the
+    # on-disk cache survives the probe subprocess and repeat runs
+    if enable_persistent_compilation_cache():
+        log("persistent XLA compilation cache enabled")
 
     # degraded CPU fallback ALSO runs the quick shapes: the full 100k×10k
     # configs on a single host core take the best part of an hour — a

@@ -298,8 +298,9 @@ def main(argv: Optional[list] = None) -> int:
     if plugin.device_manager is not None:
         # compile the steady-state kernel shapes before taking traffic —
         # a mid-burst XLA compile would land in the serving latency tail.
-        # The persistent cache makes restarts deserialize instead of
-        # recompile (KT_JAX_CACHE_DIR overrides the location).
+        # On accelerators the persistent cache makes restarts deserialize
+        # instead of recompile (KT_JAX_CACHE_DIR overrides the location);
+        # the helper itself declines on CPU.
         from .utils.platform import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache()

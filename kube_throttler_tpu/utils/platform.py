@@ -27,14 +27,25 @@ def enable_persistent_compilation_cache(path: str = "") -> bool:
 
     Compiles dominate cold-start on a TPU tunnel (seconds per shape; the
     prewarm ladder alone is ~30 shapes) and are pure recomputation across
-    processes — the bench's backend probe, its CPU re-exec, every daemon
-    restart. The on-disk cache makes the second process deserialize in
-    milliseconds instead. Safe to share across platforms: cache keys
-    include the backend/topology. Returns False (and stays off) when the
-    config knob is unavailable or the dir cannot be created.
+    processes — the bench's backend probe, every daemon restart. The
+    on-disk cache makes the second process deserialize in milliseconds
+    instead. Accelerator backends ONLY — enforced here, not by callers:
+    on CPU this returns False, because XLA's CPU AOT loader logs a
+    machine-feature warning (and threatens SIGILL on feature drift) for
+    every cache hit, while CPU compiles are only ~10-100ms anyway. Also
+    returns False when the config knob is unavailable or the dir cannot
+    be created/owned. Call after backend init.
     """
     import stat
     import tempfile
+
+    import jax
+
+    try:
+        if jax.default_backend() == "cpu":
+            return False
+    except Exception:
+        return False  # no backend — nothing to cache for
 
     path = path or os.environ.get(
         "KT_JAX_CACHE_DIR",
@@ -52,8 +63,8 @@ def enable_persistent_compilation_cache(path: str = "") -> bool:
 
         jax.config.update("jax_compilation_cache_dir", path)
         # cache small computations too — this workload is many small
-        # scatter/gather shapes (~10-100ms compiles on CPU), all under the
-        # default threshold
+        # scatter/gather shapes whose individual compile times sit under
+        # the default min-compile-time threshold
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         return True
     except Exception:
