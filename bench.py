@@ -1029,9 +1029,9 @@ def main():
     log(f"devices: {devices}")
     platform = devices[0].platform if devices else "none"
     RESULT_STATE["platform"] = platform
-    # accelerator backends only (the helper itself declines on CPU): the
+    # accelerator backends only (the helper declines on cpu/none): the
     # on-disk cache survives the probe subprocess and repeat runs
-    if enable_persistent_compilation_cache():
+    if enable_persistent_compilation_cache(platform):
         log("persistent XLA compilation cache enabled")
 
     # degraded CPU fallback ALSO runs the quick shapes: the full 100k×10k

@@ -300,10 +300,14 @@ def main(argv: Optional[list] = None) -> int:
         # a mid-burst XLA compile would land in the serving latency tail.
         # On accelerators the persistent cache makes restarts deserialize
         # instead of recompile (KT_JAX_CACHE_DIR overrides the location);
-        # the helper itself declines on CPU.
+        # the helper declines on CPU. The jax.devices() probe here is the
+        # daemon's intended device cold-start (prewarm right below needs
+        # the backend anyway).
+        import jax
+
         from .utils.platform import enable_persistent_compilation_cache
 
-        enable_persistent_compilation_cache()
+        enable_persistent_compilation_cache(jax.devices()[0].platform)
         _t0 = _time.perf_counter()
         _nk = plugin.device_manager.prewarm()
         print(

@@ -22,30 +22,29 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
-def enable_persistent_compilation_cache(path: str = "") -> bool:
+def enable_persistent_compilation_cache(backend: str, path: str = "") -> bool:
     """Point XLA's persistent compilation cache at a writable directory.
 
     Compiles dominate cold-start on a TPU tunnel (seconds per shape; the
     prewarm ladder alone is ~30 shapes) and are pure recomputation across
     processes — the bench's backend probe, every daemon restart. The
     on-disk cache makes the second process deserialize in milliseconds
-    instead. Accelerator backends ONLY — enforced here, not by callers:
-    on CPU this returns False, because XLA's CPU AOT loader logs a
-    machine-feature warning (and threatens SIGILL on feature drift) for
-    every cache hit, while CPU compiles are only ~10-100ms anyway. Also
-    returns False when the config knob is unavailable or the dir cannot
-    be created/owned. Call after backend init.
+    instead. Accelerator backends ONLY: pass the already-initialized
+    backend's platform name (``jax.devices()[0].platform``) — this helper
+    deliberately never queries the backend itself, because a
+    ``default_backend()`` probe INITIALIZES it as a side effect and can
+    block indefinitely on a dead tunnel (or poison the in-process backend
+    cache) when called pre-init. On ``"cpu"`` it returns False: XLA's CPU
+    AOT loader logs a machine-feature warning (and threatens SIGILL on
+    feature drift) for every cache hit, while CPU compiles are only
+    ~10-100ms anyway. Also returns False when the config knob is
+    unavailable or the dir cannot be created/owned.
     """
     import stat
     import tempfile
 
-    import jax
-
-    try:
-        if jax.default_backend() == "cpu":
-            return False
-    except Exception:
-        return False  # no backend — nothing to cache for
+    if not backend or backend == "cpu":
+        return False
 
     path = path or os.environ.get(
         "KT_JAX_CACHE_DIR",
