@@ -128,6 +128,13 @@ def main(argv: Optional[list] = None) -> int:
     )
     serve.add_argument("--node-max-pods", type=int, default=300)
     serve.add_argument(
+        "--node-allocatable",
+        default="",
+        help="per-node allocatable resources for the embedded scheduler, "
+        'e.g. "cpu=8,memory=32Gi" (NodeResourcesFit analog); empty = '
+        "pod-count capacity only",
+    )
+    serve.add_argument(
         "--v", type=int, default=0, dest="verbosity",
         help="klog-style verbosity (0-5); change at runtime via PUT /debug/flags/v",
     )
@@ -176,6 +183,27 @@ def main(argv: Optional[list] = None) -> int:
 
     if args.api_qps > 0 and args.api_burst < 1:
         parser.error("--api-burst must be >= 1 when --api-qps is enabled")
+
+    # validate EARLY, with the other usage checks: this must fail as a clean
+    # parser error before any heavy startup (plugin construction initializes
+    # the device backend, which can block on a dead tunnel)
+    node_allocatable = None
+    if args.node_allocatable:
+        from .quantity import parse_quantity
+
+        try:
+            node_allocatable = {}
+            for kv in args.node_allocatable.split(","):
+                if not kv.strip():
+                    continue
+                resource, _, value = kv.partition("=")
+                resource, value = resource.strip(), value.strip()
+                if not resource or not value:
+                    raise ValueError(f"bad entry {kv!r}")
+                parse_quantity(value)  # validate NOW, not inside the scheduler
+                node_allocatable[resource] = value
+        except ValueError as e:
+            parser.error(f"--node-allocatable must look like 'cpu=8,memory=32Gi': {e}")
 
     if plugin_args.kubeconfig and args.nodes > 0:
         # the embedded scheduler binds pods in the LOCAL store; in remote
@@ -321,7 +349,14 @@ def main(argv: Optional[list] = None) -> int:
         scheduler = Scheduler(
             plugin,
             store,
-            nodes=[Node(f"node-{i+1}", max_pods=args.node_max_pods) for i in range(args.nodes)],
+            nodes=[
+                Node(
+                    f"node-{i+1}",
+                    max_pods=args.node_max_pods,
+                    allocatable=node_allocatable,
+                )
+                for i in range(args.nodes)
+            ],
         )
         scheduler.start()
 

@@ -35,6 +35,8 @@ from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 from .api.pod import Pod
+from .quantity import parse_quantity
+from .resourcelist import add as rl_add, pod_request_resource_list, sub as rl_sub
 from .utils.tracing import vlog
 from .engine.store import Event, EventType, Store
 from .plugin.plugin import KubeThrottler
@@ -44,11 +46,17 @@ logger = logging.getLogger(__name__)
 
 @dataclass
 class Node:
-    """Minimal node model: bind capacity only (the integration fixture is
-    one node with max-pods 300 — hack/integration/kind.conf)."""
+    """Node model: pod-count capacity (the integration fixture is one node
+    with max-pods 300 — hack/integration/kind.conf) plus optional
+    allocatable resources. With ``allocatable`` set, binding also requires
+    the pod's effective requests to fit the remaining capacity per declared
+    dimension (the NodeResourcesFit analog of the embedded kube-scheduler
+    the reference relies on); requesting an undeclared resource never fits.
+    ``allocatable=None`` keeps the resource-blind behavior."""
 
     name: str
     max_pods: int = 300
+    allocatable: Optional[Dict[str, str]] = None
 
 
 @dataclass
@@ -79,6 +87,16 @@ class Scheduler:
         self.store = store
         self.nodes = list(nodes) if nodes else [Node("node-1")]
         self._bound_per_node: Dict[str, int] = {n.name: 0 for n in self.nodes}
+        # resource accounting (only consulted for nodes declaring allocatable)
+        self._alloc_cap = {
+            n.name: (
+                {r: parse_quantity(v) for r, v in n.allocatable.items()}
+                if n.allocatable is not None
+                else None
+            )
+            for n in self.nodes
+        }
+        self._alloc_used: Dict[str, Dict] = {n.name: {} for n in self.nodes}
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
 
@@ -131,6 +149,7 @@ class Scheduler:
                 freed = self._occupies_node(pod)
                 if freed is not None:
                     self._bound_per_node[freed] -= 1
+                    rl_sub(self._alloc_used[freed], pod_request_resource_list(pod))
                 self._queued_keys.discard(pod.key)
                 self._unschedulable.pop(pod.key, None)
                 self._active = [q for q in self._active if q.key != pod.key]
@@ -143,13 +162,15 @@ class Scheduler:
                 held = self._occupies_node(pod)
                 if held is not None:
                     self._bound_per_node[held] += 1
+                    rl_add(self._alloc_used[held], pod_request_resource_list(pod))
                 elif self._is_schedulable_target(pod) and pod.key not in self._queued_keys:
                     self._queued_keys.add(pod.key)
                     self._active.append(_QueuedPod(pod.key))
                     self._cv.notify_all()
             return
-        # MODIFIED: adjust occupancy for bind/unbind/termination transitions,
-        # then treat the change as a requeue hint for unschedulable pods
+        # MODIFIED: adjust occupancy for bind/unbind/termination transitions
+        # AND in-place request edits (same node, different requests), then
+        # treat the change as a requeue hint for unschedulable pods
         with self._cv:
             before = self._occupies_node(event.old_obj)
             after = self._occupies_node(pod)
@@ -158,6 +179,10 @@ class Scheduler:
                     self._bound_per_node[before] -= 1
                 if after is not None:
                     self._bound_per_node[after] += 1
+            if before is not None:
+                rl_sub(self._alloc_used[before], pod_request_resource_list(event.old_obj))
+            if after is not None:
+                rl_add(self._alloc_used[after], pod_request_resource_list(pod))
         self._wake_unschedulable()
 
     def _on_cluster_event(self, event: Event) -> None:
@@ -185,10 +210,27 @@ class Scheduler:
 
     # -- the scheduling cycle ---------------------------------------------
 
-    def _pick_node(self) -> Optional[Node]:
+    def _fits_resources(self, node: Node, req) -> bool:
+        """NodeResourcesFit: every requested dimension must be declared in
+        the node's allocatable and leave headroom. Resource-blind when the
+        node declares no allocatable."""
+        cap = self._alloc_cap[node.name]
+        if cap is None:
+            return True
+        used = self._alloc_used[node.name]
+        for resource, q in req.items():
+            limit = cap.get(resource)
+            if limit is None or used.get(resource, 0) + q > limit:
+                return False
+        return True
+
+    def _pick_node(self, pod: Pod) -> Optional[Node]:
+        req = pod_request_resource_list(pod)
         with self._cv:
             for node in self.nodes:
-                if self._bound_per_node[node.name] < node.max_pods:
+                if self._bound_per_node[node.name] < node.max_pods and self._fits_resources(
+                    node, req
+                ):
                     return node
         return None
 
@@ -222,7 +264,7 @@ class Scheduler:
             self._park(queued, now, gen)
             return None
 
-        node = self._pick_node()
+        node = self._pick_node(pod)
         if node is None:
             self._record_failed_scheduling(pod, "0/%d nodes are available" % len(self.nodes))
             self._park(queued, now, gen)

@@ -43,7 +43,7 @@ def enable_persistent_compilation_cache(backend: str, path: str = "") -> bool:
     import stat
     import tempfile
 
-    if not backend or backend == "cpu":
+    if not backend or backend in ("cpu", "none"):
         return False
 
     path = path or os.environ.get(

@@ -81,6 +81,40 @@ class TestScheduleOne:
             for e in recorder.events
         )
 
+    def test_node_allocatable_limits_binding(self):
+        """NodeResourcesFit analog: cpu=1 node fits exactly two 500m pods;
+        deleting one frees the capacity and the parked pod binds."""
+        store, plugin, sched, recorder = _setup(
+            nodes=[Node("small", allocatable={"cpu": "1"})]
+        )
+        for i in range(3):
+            store.create_pod(make_pod(f"p{i}", requests={"cpu": "500m"}))
+        assert sched.run_until_idle() == 2
+        assert sched.pending_count() == 1
+        assert any(
+            e.reason == "FailedScheduling" and "nodes are available" in e.note
+            for e in recorder.events
+        )
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        store.delete_pod(bound[0].namespace, bound[0].name)
+        assert sched.run_until_idle() == 1  # freed capacity admits the third
+        assert sum(1 for p in store.list_pods() if p.spec.node_name) == 2
+
+    def test_undeclared_resource_never_fits(self):
+        store, plugin, sched, _ = _setup(
+            nodes=[Node("cpu-only", allocatable={"cpu": "64"})]
+        )
+        store.create_pod(
+            make_pod("gpu-pod", requests={"cpu": "100m", "nvidia.com/gpu": "1"})
+        )
+        assert sched.run_until_idle() == 0
+        assert sched.pending_count() == 1
+
+    def test_resource_blind_node_still_binds_anything(self):
+        store, plugin, sched, _ = _setup(nodes=[Node("blind")])
+        store.create_pod(make_pod("big", requests={"cpu": "10000"}))
+        assert sched.run_until_idle() == 1
+
 
 class TestBurstAdmission:
     def test_21_pods_exactly_20_fit_under_1_cpu(self):
