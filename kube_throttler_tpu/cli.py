@@ -200,8 +200,13 @@ def main(argv: Optional[list] = None) -> int:
                 resource, value = resource.strip(), value.strip()
                 if not resource or not value:
                     raise ValueError(f"bad entry {kv!r}")
-                parse_quantity(value)  # validate NOW, not inside the scheduler
+                # validate NOW, not inside the scheduler; negatives would
+                # silently make the node unusable
+                if parse_quantity(value) < 0:
+                    raise ValueError(f"negative quantity for {resource!r}")
                 node_allocatable[resource] = value
+            if not node_allocatable:
+                raise ValueError("no resource entries")
         except ValueError as e:
             parser.error(f"--node-allocatable must look like 'cpu=8,memory=32Gi': {e}")
 

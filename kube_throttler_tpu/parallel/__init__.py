@@ -24,4 +24,8 @@ each host's ICI island.
 from .distributed import hybrid_mesh, init_distributed, shard_global_array  # noqa: F401
 from .mesh import make_mesh, mesh_shardings  # noqa: F401
 from .ring import make_ring_mesh, ring_full_update  # noqa: F401
-from .sharded import full_update_step, sharded_full_update  # noqa: F401
+from .sharded import (  # noqa: F401
+    full_update_step,
+    sharded_apply_deltas,
+    sharded_full_update,
+)

@@ -114,6 +114,50 @@ def full_update_step(
     return counts, schedulable, used_cnt, used_req, st_cnt, st_req
 
 
+def sharded_apply_deltas(mesh: Mesh):
+    """Streaming reconcile (BASELINE cfg5) over a throttle-sharded mesh.
+
+    The used-aggregates live tiled over the mesh's ``throttles`` axis —
+    each device owns agg rows [T/tp] — and a batch of pod-churn deltas
+    (global throttle ids) is REPLICATED to every device: each shard
+    rebases ids into its tile (global id − tile offset) and scatter-adds
+    only the rows it owns, dropping the rest (``mode="drop"``). No
+    collective is needed at all — scatter targets partition exactly by
+    ownership, so the update is embarrassingly parallel across shards;
+    reads (gathers for status writes) stay tile-local too.
+
+    Returns a jitted fn
+    ``(used_cnt[T], used_req[T,R], contrib[T,R], ids[N,K], sign[N,K],
+    pod_req[N,R], pod_present[N,R]) → (used_cnt, used_req, contrib)``
+    with the agg arrays sharded on "throttles" and deltas replicated.
+    Exactness: scatter-adds commute in int64, and each global id lands in
+    exactly one tile, so the result is bit-identical to the single-device
+    ``apply_pod_deltas_batched`` (property-tested on the 8-device mesh).
+    """
+    from ..ops.aggregate import apply_pod_deltas_batched
+
+    thr_spec = P("throttles")
+
+    def _apply(used_cnt, used_req, contrib, ids, sign, pod_req, pod_present):
+        t_local = used_cnt.shape[0]  # tile rows (shard_map sees the local view)
+        idx = jax.lax.axis_index("throttles")
+        offset = idx * t_local
+        local_ids = jnp.where(
+            (ids >= offset) & (ids < offset + t_local), ids - offset, t_local
+        ).astype(ids.dtype)  # out-of-tile → t_local → dropped by the scatter
+        return apply_pod_deltas_batched(
+            used_cnt, used_req, contrib, local_ids, sign, pod_req, pod_present
+        )
+
+    mapped = jax.shard_map(
+        _apply,
+        mesh=mesh,
+        in_specs=(thr_spec, thr_spec, thr_spec, P(), P(), P(), P()),
+        out_specs=(thr_spec, thr_spec, thr_spec),
+    )
+    return jax.jit(mapped)
+
+
 def sharded_full_update(mesh: Mesh, *, on_equal: bool = False, step3_on_equal: bool = True):
     """Compile the full step over a ("pods","throttles") mesh via shard_map.
 

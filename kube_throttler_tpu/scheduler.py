@@ -87,7 +87,8 @@ class Scheduler:
         self.store = store
         self.nodes = list(nodes) if nodes else [Node("node-1")]
         self._bound_per_node: Dict[str, int] = {n.name: 0 for n in self.nodes}
-        # resource accounting (only consulted for nodes declaring allocatable)
+        # resource accounting (only maintained/consulted for nodes that
+        # declare allocatable — the default path stays Fraction-free)
         self._alloc_cap = {
             n.name: (
                 {r: parse_quantity(v) for r, v in n.allocatable.items()}
@@ -129,6 +130,15 @@ class Scheduler:
 
     # -- queue management --------------------------------------------------
 
+    def _track_usage(self, node_name: Optional[str], pod: Optional[Pod], sign: int) -> None:
+        """Adjust a node's used-resources ledger — no-op for resource-blind
+        nodes, keeping the hot event path free of Fraction work."""
+        if pod is None or node_name is None or self._alloc_cap.get(node_name) is None:
+            return
+        (rl_add if sign > 0 else rl_sub)(
+            self._alloc_used[node_name], pod_request_resource_list(pod)
+        )
+
     def _is_schedulable_target(self, pod: Pod) -> bool:
         return (
             pod.spec.scheduler_name == self._target
@@ -149,7 +159,7 @@ class Scheduler:
                 freed = self._occupies_node(pod)
                 if freed is not None:
                     self._bound_per_node[freed] -= 1
-                    rl_sub(self._alloc_used[freed], pod_request_resource_list(pod))
+                    self._track_usage(freed, pod, -1)
                 self._queued_keys.discard(pod.key)
                 self._unschedulable.pop(pod.key, None)
                 self._active = [q for q in self._active if q.key != pod.key]
@@ -162,7 +172,7 @@ class Scheduler:
                 held = self._occupies_node(pod)
                 if held is not None:
                     self._bound_per_node[held] += 1
-                    rl_add(self._alloc_used[held], pod_request_resource_list(pod))
+                    self._track_usage(held, pod, +1)
                 elif self._is_schedulable_target(pod) and pod.key not in self._queued_keys:
                     self._queued_keys.add(pod.key)
                     self._active.append(_QueuedPod(pod.key))
@@ -179,10 +189,8 @@ class Scheduler:
                     self._bound_per_node[before] -= 1
                 if after is not None:
                     self._bound_per_node[after] += 1
-            if before is not None:
-                rl_sub(self._alloc_used[before], pod_request_resource_list(event.old_obj))
-            if after is not None:
-                rl_add(self._alloc_used[after], pod_request_resource_list(pod))
+            self._track_usage(before, event.old_obj, -1)
+            self._track_usage(after, pod, +1)
         self._wake_unschedulable()
 
     def _on_cluster_event(self, event: Event) -> None:
@@ -219,6 +227,8 @@ class Scheduler:
             return True
         used = self._alloc_used[node.name]
         for resource, q in req.items():
+            if q == 0:
+                continue  # NodeResourcesFit skips zero requests
             limit = cap.get(resource)
             if limit is None or used.get(resource, 0) + q > limit:
                 return False

@@ -12,7 +12,12 @@ from kube_throttler_tpu.api import ResourceAmount, TemporaryThresholdOverride
 from kube_throttler_tpu.api.types import ThrottleSpecBase
 from kube_throttler_tpu.ops.overrides import encode_override_schedule
 from kube_throttler_tpu.ops.schema import DimRegistry, PodBatch
-from kube_throttler_tpu.parallel import full_update_step, make_mesh, sharded_full_update
+from kube_throttler_tpu.parallel import (
+    full_update_step,
+    make_mesh,
+    sharded_apply_deltas,
+    sharded_full_update,
+)
 
 NOW = datetime(2024, 1, 15, tzinfo=timezone.utc)
 
@@ -100,3 +105,33 @@ def test_all_mesh_shapes(shape):
     stepped = sharded_full_update(mesh)(*inputs)
     for got, want in zip(stepped, single):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("shape", [(1, 8), (4, 2), (8, 1)])
+def test_sharded_deltas_match_single_device(shape):
+    """cfg5's streaming scatter-add over a throttle-sharded mesh must be
+    bit-identical to the single-device batched apply: every global id
+    lands in exactly one tile, out-of-tile slots drop, int64 scatter-adds
+    commute."""
+    from kube_throttler_tpu.ops.aggregate import apply_pod_deltas_batched
+
+    rng = np.random.default_rng(3)
+    T, R, N, K = 16, 4, 24, 3
+    used_cnt = rng.integers(0, 50, T).astype(np.int64)
+    used_req = rng.integers(0, 64, (T, R)).astype(np.int64) * 1000
+    contrib = rng.integers(0, 10, (T, R)).astype(np.int32)
+    # ids include out-of-range padding (T) that must drop on every shard
+    ids = rng.integers(0, T + 1, (N, K)).astype(np.int32)
+    sign = rng.choice(np.array([-1, 0, 1], dtype=np.int64), (N, K))
+    pod_req = rng.integers(0, 900, (N, R)).astype(np.int64)
+    pod_present = rng.random((N, R)) < 0.7
+
+    want = apply_pod_deltas_batched(
+        used_cnt, used_req, contrib, ids, sign, pod_req, pod_present
+    )
+    mesh = make_mesh(8, shape=shape)
+    got = sharded_apply_deltas(mesh)(
+        used_cnt, used_req, contrib, ids, sign, pod_req, pod_present
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))

@@ -110,6 +110,17 @@ class TestScheduleOne:
         assert sched.run_until_idle() == 0
         assert sched.pending_count() == 1
 
+    def test_zero_request_for_undeclared_resource_still_fits(self):
+        """NodeResourcesFit skips zero requests: a 0-gpu request must not
+        block binding on a cpu-only node."""
+        store, plugin, sched, _ = _setup(
+            nodes=[Node("cpu-only", allocatable={"cpu": "64"})]
+        )
+        store.create_pod(
+            make_pod("zero-gpu", requests={"cpu": "100m", "nvidia.com/gpu": "0"})
+        )
+        assert sched.run_until_idle() == 1
+
     def test_resource_blind_node_still_binds_anything(self):
         store, plugin, sched, _ = _setup(nodes=[Node("blind")])
         store.create_pod(make_pod("big", requests={"cpu": "10000"}))
