@@ -55,6 +55,12 @@ def _args_from_config(cfg: Dict[str, Any], path: str) -> Dict[str, Any]:
 
 
 def main(argv: Optional[list] = None) -> int:
+    # an operator's explicit JAX_PLATFORMS (e.g. =cpu when the TPU is down)
+    # must win over ambient platform pinning; must run before any backend
+    # touch (the device prewarm at startup), or serve hangs on a dead tunnel
+    from .utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     parser = argparse.ArgumentParser(prog="kube-throttler-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -204,6 +210,9 @@ def main(argv: Optional[list] = None) -> int:
                 # silently make the node unusable
                 if parse_quantity(value) < 0:
                     raise ValueError(f"negative quantity for {resource!r}")
+                if resource in node_allocatable:
+                    # last-one-wins would silently shrink a typoed resource
+                    raise ValueError(f"duplicate resource {resource!r}")
                 node_allocatable[resource] = value
             if not node_allocatable:
                 raise ValueError("no resource entries")

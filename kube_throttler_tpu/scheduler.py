@@ -51,7 +51,8 @@ class Node:
     allocatable resources. With ``allocatable`` set, binding also requires
     the pod's effective requests to fit the remaining capacity per declared
     dimension (the NodeResourcesFit analog of the embedded kube-scheduler
-    the reference relies on); requesting an undeclared resource never fits.
+    the reference relies on); a NONZERO request for an undeclared resource
+    never fits (zero requests are skipped, as NodeResourcesFit does).
     ``allocatable=None`` keeps the resource-blind behavior."""
 
     name: str
