@@ -13,6 +13,10 @@ from datetime import datetime, timedelta, timezone
 
 sys.path.insert(0, ".")
 
+from kube_throttler_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()  # an explicit JAX_PLATFORMS wins over ambient pinning
+
 import jax
 import numpy as np
 
@@ -83,6 +87,73 @@ def main():
     out = calculate_thresholds(sched, np.int64(int(NOW.timestamp() * 1e9)))
     jax.block_until_ready(out)
     print("calculate_thresholds ok")
+
+    # the serving hot path: packed residual-form indexed single-pod check
+    from kube_throttler_tpu.ops.fastcheck import (
+        fast_check_pod_packed,
+        pack_check_state,
+        precompute_check_state,
+    )
+
+    packed = pack_check_state(precompute_check_state(state))
+    idx = np.zeros(8, dtype=np.int32)
+    idx[:3] = [0, 5, 63]
+    idx_valid = np.zeros(8, dtype=bool)
+    idx_valid[:3] = True
+    out = fast_check_pod_packed(
+        packed, np.asarray(batch.req[0]), np.asarray(batch.req_present[0]),
+        idx, idx_valid, False, True,
+    )
+    jax.block_until_ready(out)
+    print("fast_check_pod_packed ok")
+
+    # streaming-batch + rebase kernels (the reconcile data plane)
+    from kube_throttler_tpu.ops.aggregate import apply_pod_deltas_batched, rebase_cols
+
+    nb, kmax, R = 32, 4, dims.capacity
+    bids = np.full((nb, kmax), 64, dtype=np.int32)
+    bids[0, :2] = [1, 2]
+    bsign = np.zeros((nb, kmax), dtype=np.int64)
+    bsign[0, :2] = 1
+    breq = np.zeros((nb, R), dtype=np.int64)
+    bpresent = np.zeros((nb, R), dtype=bool)
+    out = apply_pod_deltas_batched(used_cnt, used_req, contrib, bids, bsign, breq, bpresent)
+    jax.block_until_ready(out)
+    print("apply_pod_deltas_batched ok")
+    cols_pad = np.full(8, 64, dtype=np.int32)
+    cols_pad[:2] = [0, 1]
+    out = rebase_cols(used_cnt, used_req, contrib, batch, mask, counted, cols_pad)
+    jax.block_until_ready(out)
+    print("rebase_cols ok")
+
+    # the Pallas mosaic sweep (TPU backends only)
+    if jax.devices()[0].platform != "cpu":
+        try:
+            from kube_throttler_tpu.ops.pallas_check import pallas_check_pods
+
+            out = pallas_check_pods(state, batch, mask)
+            jax.block_until_ready(out)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+            print("pallas sweep ok (matches XLA)")
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            print(f"pallas sweep FAILED: {e.__class__.__name__}: {str(e)[:200]}")
+
+    # the full serving-stack prewarm ladder (every bucketed shape compiles)
+    from kube_throttler_tpu.api.pod import Namespace
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    store = Store()
+    plugin = KubeThrottler(
+        decode_plugin_args({"name": "kt", "targetSchedulerName": "s"}),
+        store,
+        use_device=True,
+        start_workers=False,
+    )
+    store.create_namespace(Namespace("default"))
+    t0 = time.perf_counter()
+    n = plugin.device_manager.prewarm()
+    print(f"prewarm ok: {n} shapes in {time.perf_counter()-t0:.1f}s")
     print("ALL TPU KERNELS OK")
 
 
