@@ -1,6 +1,9 @@
 """Manual TPU compatibility smoke: run every device kernel on real hardware.
 
-Usage: python tools/tpu_smoke.py   (no env overrides — uses ambient platform)
+Usage: python tools/tpu_smoke.py     — ambient platform (the TPU in CI).
+An explicit JAX_PLATFORMS (e.g. =cpu) is honored for off-hardware dry
+runs; note that skips the Pallas sweep, which only a TPU backend can
+validate. Exit code is nonzero if any kernel fails.
 
 Catches TPU-only lowering gaps (e.g. the X64 rewriter has no s64 dot_general)
 that CPU-only unit tests cannot see.
@@ -126,17 +129,26 @@ def main():
     jax.block_until_ready(out)
     print("rebase_cols ok")
 
-    # the Pallas mosaic sweep (TPU backends only)
+    # the Pallas mosaic sweep (TPU backends only): block-padded shapes,
+    # precomputed residual form, compared against check_pods on the same
+    # padded state — the one kernel only real hardware can validate
+    failed = []
     if jax.devices()[0].platform != "cpu":
         try:
-            from kube_throttler_tpu.ops.pallas_check import pallas_check_pods
+            from kube_throttler_tpu.ops.pallas_check import BP, BT, pallas_check_pods
 
-            out = pallas_check_pods(state, batch, mask)
-            jax.block_until_ready(out)
-            np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+            p_state = encode_throttle_state(throttles, dims, capacity=BT)
+            p_batch = encode_pods(pods, dims, capacity=BP)
+            p_mask = np.asarray(rng.choices([True, False], k=BP * BT)).reshape(BP, BT)
+            want = np.asarray(check_pods(p_state, p_batch, p_mask))
+            got = np.asarray(
+                pallas_check_pods(precompute_check_state(p_state), p_batch, p_mask)
+            )
+            np.testing.assert_array_equal(got, want)
             print("pallas sweep ok (matches XLA)")
-        except Exception as e:  # noqa: BLE001 — report, don't die
-            print(f"pallas sweep FAILED: {e.__class__.__name__}: {str(e)[:200]}")
+        except Exception as e:  # noqa: BLE001 — report now, fail at exit
+            failed.append(f"pallas: {e.__class__.__name__}: {str(e)[:200]}")
+            print(f"pallas sweep FAILED: {failed[-1]}")
 
     # the full serving-stack prewarm ladder (every bucketed shape compiles)
     from kube_throttler_tpu.api.pod import Namespace
@@ -154,6 +166,9 @@ def main():
     t0 = time.perf_counter()
     n = plugin.device_manager.prewarm()
     print(f"prewarm ok: {n} shapes in {time.perf_counter()-t0:.1f}s")
+    if failed:
+        print("SMOKE FAILED:", "; ".join(failed))
+        sys.exit(1)
     print("ALL TPU KERNELS OK")
 
 
