@@ -212,6 +212,38 @@ class TestReadmeWalkthrough:
             # double-admission within the scheduling cycle window
         assert admitted == 20
 
+    def test_clusterthrottle_burst_exactly_20_of_21_fit(self, harness):
+        """clusterthrottle_test.go mirror of the burst: the CLUSTER kind's
+        separately-implemented reserve path must prevent double-admission
+        inside the scheduling-cycle window just like the namespaced one."""
+        h = harness
+        ct = ClusterThrottle(
+            name="cburst",
+            spec=ClusterThrottleSpec(
+                throttler_name="kube-throttler",
+                threshold=ResourceAmount.of(requests={"cpu": "1"}),
+                selector=ClusterThrottleSelector(
+                    selector_terms=(
+                        ClusterThrottleSelectorTerm(
+                            pod_selector=LabelSelector(match_labels={"throttle": "t1"})
+                        ),
+                    )
+                ),
+            ),
+        )
+        h.store.create_cluster_throttle(ct)
+        h.settle()
+        admitted = 0
+        for i in range(21):
+            pod = labeled_pod(f"cb{i}", {"cpu": "50m"})
+            h.store.create_pod(pod)
+            status = h.plugin.pre_filter(pod)
+            if status.is_success():
+                assert h.plugin.reserve(pod).is_success()
+                admitted += 1
+            # deliberately NO settle (see the namespaced variant)
+        assert admitted == 20
+
     def test_unreserve_on_bind_failure(self, harness):
         h = harness
         thr = Throttle(
