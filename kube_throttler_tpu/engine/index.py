@@ -577,14 +577,15 @@ class SelectorIndex:
     # --------------------------------------------------------------- queries
 
     def affected_throttle_keys(self, pod_key: str) -> List[str]:
-        """Keys of throttles matching the pod (affectedThrottles batched)."""
+        """Keys of throttles matching the pod (affectedThrottles batched).
+        O(K) via the col→object map — an inverted {col: key} dict built
+        per call would be O(T) and dominated full-scale event ingest."""
         with self._lock:
             row = self._pod_rows.get(pod_key)
             if row is None:
                 return []
             cols = np.nonzero(self.mask[row, : self._tcap])[0]
-            col_to_key = {col: key for key, col in self._thr_cols.items()}
-            return [col_to_key[c] for c in cols if c in col_to_key]
+            return [self._col_thrs[int(c)].key for c in cols if int(c) in self._col_thrs]
 
     def affected_throttle_keys_for(self, pod: Pod) -> List[str]:
         """affectedThrottles for an ARBITRARY pod object.
