@@ -41,6 +41,26 @@ def _load_config_file(path: str) -> Dict[str, Any]:
         return yaml.safe_load(f) or {}
 
 
+def _resolve_client_connection(raw_cfg: Dict[str, Any], args, fail) -> None:
+    """KubeSchedulerConfiguration ``clientConnection.{qps,burst}`` parity:
+    the scheduler-level block governs apiserver traffic in the reference's
+    embedded scheduler. Precedence: explicit flag > config > defaults
+    (50/100) — flags are declared with default=None so an explicitly
+    passed default value still wins over the config. Non-numeric config
+    values report through ``fail`` (parser.error)."""
+    cc = (raw_cfg or {}).get("clientConnection") or {}
+    try:
+        cfg_qps = float(cc["qps"]) if "qps" in cc else None
+        cfg_burst = int(cc["burst"]) if "burst" in cc else None
+    except (TypeError, ValueError):
+        fail(f"clientConnection qps/burst must be numeric (got {cc!r})")
+        return
+    if args.api_qps is None:
+        args.api_qps = cfg_qps if cfg_qps is not None else 50.0
+    if args.api_burst is None:
+        args.api_burst = cfg_burst if cfg_burst is not None else 100
+
+
 def _args_from_config(cfg: Dict[str, Any], path: str) -> Dict[str, Any]:
     for profile in cfg.get("profiles", []) or []:
         for pc in profile.get("pluginConfig", []) or []:
@@ -78,15 +98,17 @@ def main(argv: Optional[list] = None) -> int:
     serve.add_argument(
         "--api-qps",
         type=float,
-        default=50.0,
+        default=None,
         help="client-side write rate limit against the remote apiserver "
-        "(client-go rest.Config QPS analog; 0 disables)",
+        "(client-go rest.Config QPS analog; 0 disables; default 50, or "
+        "the --config clientConnection.qps)",
     )
     serve.add_argument(
         "--api-burst",
         type=int,
-        default=100,
-        help="token-bucket burst for --api-qps (rest.Config Burst analog)",
+        default=None,
+        help="token-bucket burst for --api-qps (rest.Config Burst analog; "
+        "default 100, or the --config clientConnection.burst)",
     )
     serve.add_argument("--controller-threadiness", type=int, default=0)
     serve.add_argument("--num-key-mutex", type=int, default=0)
@@ -187,6 +209,7 @@ def main(argv: Optional[list] = None) -> int:
     except ValueError as e:
         parser.error(str(e))  # clean usage error, not a traceback
 
+    _resolve_client_connection(raw_cfg if args.config else {}, args, parser.error)
     if args.api_qps > 0 and args.api_burst < 1:
         parser.error("--api-burst must be >= 1 when --api-qps is enabled")
 

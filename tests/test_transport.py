@@ -585,3 +585,39 @@ class TestRemoteModeEndToEnd:
         finally:
             plugin.stop()
             session.stop()
+
+
+class TestClientConnectionConfig:
+    def test_config_block_sets_defaults_flags_win(self):
+        """KubeSchedulerConfiguration clientConnection.{qps,burst} parity."""
+        import argparse
+
+        import pytest as _pytest
+
+        from kube_throttler_tpu.cli import _resolve_client_connection
+
+        def fail(msg):
+            raise AssertionError(msg)
+
+        raw = {"clientConnection": {"qps": 25, "burst": 40}}
+        ns = argparse.Namespace(api_qps=None, api_burst=None)  # flags unset
+        _resolve_client_connection(raw, ns, fail)
+        assert (ns.api_qps, ns.api_burst) == (25.0, 40)
+
+        # an explicit flag wins EVEN at the default value (50)
+        ns = argparse.Namespace(api_qps=50.0, api_burst=None)
+        _resolve_client_connection(raw, ns, fail)
+        assert (ns.api_qps, ns.api_burst) == (50.0, 40)
+
+        ns = argparse.Namespace(api_qps=None, api_burst=None)
+        _resolve_client_connection({}, ns, fail)  # no block: defaults
+        assert (ns.api_qps, ns.api_burst) == (50.0, 100)
+
+        # non-numeric values report through fail, not a raw traceback
+        errs = []
+        _resolve_client_connection(
+            {"clientConnection": {"qps": "unlimited"}},
+            argparse.Namespace(api_qps=None, api_burst=None),
+            errs.append,
+        )
+        assert errs and "numeric" in errs[0]
