@@ -210,6 +210,58 @@ class TestFullTickSharded:
         _, ok, rows, *_ = tick["throttle"]
         assert not bool(np.asarray(ok)[rows["default/p-pending"]])
 
+    def test_tick_races_live_churn(self, stack):
+        """full_tick_sharded snapshots under the main lock while store
+        events mutate rows/columns concurrently: ticks must never crash and
+        every verdict map must cover exactly the pods of SOME point in the
+        event stream (keys are a superset of never-deleted pods)."""
+        import random
+        import threading
+
+        store, plugin = stack
+        _populate(store, random.Random(3), n_thr=12, n_pods=40)
+        plugin.run_pending_once()
+        mesh = make_mesh(8, (4, 2))
+        stable = {p.key for p in store.list_pods()}  # never deleted below
+
+        errors = []
+        results = []
+
+        def churner():
+            rng = random.Random(4)
+            try:
+                for i in range(150):
+                    store.create_pod(
+                        make_pod(
+                            f"churn{i}",
+                            labels={"grp": f"g{rng.randrange(8)}"},
+                            requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+                            node_name="node-1",
+                            phase="Running",
+                        )
+                    )
+                    if i % 3 == 0 and i:
+                        store.delete_pod("default", f"churn{i - 1}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=churner)
+        t.start()
+        try:
+            for _ in range(5):
+                out = plugin.device_manager.full_tick_sharded(mesh, on_equal=False)
+                results.append(out)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            t.join()
+        assert not errors, errors
+        for out in results:
+            for kind in ("throttle", "clusterthrottle"):
+                _, ok, rows, *_ = out[kind]
+                assert stable <= set(rows), "tick lost stable pods"
+                assert len(ok) >= len(rows)
+
     def test_plugin_surface_and_http(self, stack):
         store, plugin = stack
         _populate(store, random.Random(2), n_thr=8, n_pods=24)
