@@ -215,22 +215,27 @@ class TestFullTickSharded:
         events mutate rows/columns concurrently: ticks must never crash and
         every verdict map must cover exactly the pods of SOME point in the
         event stream (keys are a superset of never-deleted pods)."""
-        import random
         import threading
 
         store, plugin = stack
-        _populate(store, random.Random(3), n_thr=12, n_pods=40)
+        rng = random.Random(3)
+        _populate(store, rng, n_thr=12, n_pods=40)
         plugin.run_pending_once()
         mesh = make_mesh(8, (4, 2))
+        # compile the shard_map programs BEFORE the race window, so the
+        # churn genuinely overlaps snapshot/tick work rather than one
+        # multi-second first-call compilation
+        plugin.device_manager.full_tick_sharded(mesh, on_equal=False)
         stable = {p.key for p in store.list_pods()}  # never deleted below
 
         errors = []
         results = []
+        started = threading.Event()
 
         def churner():
-            rng = random.Random(4)
+            started.wait(10)
             try:
-                for i in range(150):
+                for i in range(300):
                     store.create_pod(
                         make_pod(
                             f"churn{i}",
@@ -248,19 +253,29 @@ class TestFullTickSharded:
         t = threading.Thread(target=churner)
         t.start()
         try:
-            for _ in range(5):
+            started.set()
+            ticks = 0
+            while t.is_alive() or ticks < 3:  # guaranteed overlap while alive
                 out = plugin.device_manager.full_tick_sharded(mesh, on_equal=False)
                 results.append(out)
+                ticks += 1
+                if ticks > 50:
+                    break
         except Exception as e:  # noqa: BLE001
             errors.append(e)
         finally:
             t.join()
         assert not errors, errors
+        assert len(results) >= 3
         for out in results:
             for kind in ("throttle", "clusterthrottle"):
                 _, ok, rows, *_ = out[kind]
                 assert stable <= set(rows), "tick lost stable pods"
-                assert len(ok) >= len(rows)
+                # snapshot coherence: rows index into the verdict array,
+                # one row per pod (a torn snapshot could alias rows)
+                vals = list(rows.values())
+                assert max(vals) < len(ok)
+                assert len(set(vals)) == len(vals), "aliased mask rows"
 
     def test_plugin_surface_and_http(self, stack):
         store, plugin = stack
