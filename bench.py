@@ -1259,6 +1259,9 @@ def build_result() -> dict:
         "p99_ms": round(value_ms, 4),
         "platform": platform,
         "degraded": degraded,
+        # context for the thread-scaling numbers: all host-side work (GIL,
+        # controllers, the bench's own load generators) shares these cores
+        "host_cpus": os.cpu_count(),
         **detail,
     }
     if errors:
