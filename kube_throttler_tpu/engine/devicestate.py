@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
@@ -695,6 +696,11 @@ class DeviceStateManager:
         self._device_down_until = 0.0
         self._monotonic = None  # test injection point; defaults to time.monotonic
         self.fallback_counter = None  # CounterVec set by the plugin
+        # per-pod-object request-encode memo (see check_pod), keyed by
+        # id(pod) because Pod is unhashable (dict fields); a weakref
+        # finalizer evicts the entry when the pod is collected, and lookups
+        # verify identity (`ref() is pod`) against id reuse
+        self._encode_cache: Dict[int, tuple] = {}
 
         store.add_event_handler("Namespace", self._on_namespace)
         store.add_event_handler("Pod", self._on_pod)
@@ -841,6 +847,14 @@ class DeviceStateManager:
         )
         counted = count_in and pod.is_not_finished()
         with self._lock:
+            # evict the request-encode memo for BOTH event-object versions:
+            # updates normally arrive as new objects (new id), but a caller
+            # that mutated the stored object in place and re-updated it
+            # keeps the id — without this, check_pod would serve the stale
+            # encoded row
+            self._encode_cache.pop(id(pod), None)
+            if event.old_obj is not None:
+                self._encode_cache.pop(id(event.old_obj), None)
             for ks in (self.throttle, self.clusterthrottle):
                 ks.capture_pod_delta_begin(pod.key)
                 if event.type == EventType.DELETED:
@@ -1042,11 +1056,30 @@ class DeviceStateManager:
             with self._lock:
                 ks = self.throttle if kind == "throttle" else self.clusterthrottle
                 ks.ensure_capacity()
-                row_req = np.zeros((1, ks.R), dtype=np.int64)
-                row_present = np.zeros((1, ks.R), dtype=bool)
-                row_req, row_present = ks.encode_pod_requests_into(
-                    row_req, row_present, 0, pod
-                )
+                # request encode (Fraction arithmetic over containers) is
+                # identical for both kinds and across scheduler retries of
+                # the same stored object — memoized per pod OBJECT (a pod
+                # update is a new object; GC evicts via weakref finalizer)
+                cached = self._encode_cache.get(id(pod))
+                if cached is not None and cached[0]() is pod and cached[1] == ks.R:
+                    row_req, row_present = cached[2], cached[3]
+                else:
+                    row_req = np.zeros((1, ks.R), dtype=np.int64)
+                    row_present = np.zeros((1, ks.R), dtype=bool)
+                    row_req, row_present = ks.encode_pod_requests_into(
+                        row_req, row_present, 0, pod
+                    )
+                    row_req.setflags(write=False)
+                    row_present.setflags(write=False)
+                    key = id(pod)
+                    try:
+                        ref = weakref.ref(
+                            pod, lambda _, k=key: self._encode_cache.pop(k, None)
+                        )
+                    except TypeError:
+                        pass  # non-weakref-able stand-ins: skip caching
+                    else:
+                        self._encode_cache[key] = (ref, ks.R, row_req, row_present)
                 prow = ks.index.pod_row(pod.key)
                 if prow is not None:
                     mask_row = ks.index.mask[prow : prow + 1, :].copy()
