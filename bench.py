@@ -769,6 +769,26 @@ def bench_served_prefilter(plugin, label, groups=500, n=2000):
     return stats, rate1, rate4
 
 
+def bench_served_batch(plugin, label, iters=5):
+    """Bulk triage through the SERVED surface: plugin.pre_filter_batch
+    classifies every stored pod against both kinds' full state in one
+    coherent snapshot (two device dispatches). The per-pod cost amortizes
+    the dispatch across the whole store — the batched counterpart of the
+    per-decision served p99."""
+    out = plugin.pre_filter_batch()  # warm (compiles the dense kernels)
+    n = len(out["schedulable"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = plugin.pre_filter_batch()
+    dt = (time.perf_counter() - t0) / iters
+    pods_per_sec = n / dt if dt else 0.0
+    log(
+        f"[{label}] SERVED pre_filter_batch: {n} pods in {dt*1e3:.1f}ms "
+        f"({pods_per_sec:,.0f} pod-verdicts/sec, one snapshot per call)"
+    )
+    return {"pods": n, "secs": dt, "pods_per_sec": pods_per_sec}
+
+
 def bench_served_streaming(
     store, plugin, label, groups=500, duration=5.0, pace_hz=0.0
 ):
@@ -1128,6 +1148,10 @@ def main():
                 detail["served_decisions_per_sec_1t"] = round(rate1)
                 detail["served_decisions_per_sec_4t"] = round(rate4)
                 detail["served_thread_scaling"] = round(rate4 / max(rate1, 1e-9), 2)
+            b = safe("served:batch", bench_served_batch, plugin_s, "served")
+            if b:
+                detail["served_batch_pods_per_sec"] = round(b["pods_per_sec"])
+                detail["served_batch_ms"] = round(b["secs"] * 1e3, 2)
             s = safe(
                 "served:streaming", bench_served_streaming, store_s, plugin_s, "served"
             )

@@ -1072,14 +1072,19 @@ class DeviceStateManager:
                     row_req.setflags(write=False)
                     row_present.setflags(write=False)
                     key = id(pod)
+                    # the finalizer must capture only the dict, not self: a
+                    # lambda over `self` would chain pod → weakref → manager
+                    # and pin discarded managers (and their device state)
+                    # alive for as long as any checked pod object lives
+                    cache = self._encode_cache
                     try:
                         ref = weakref.ref(
-                            pod, lambda _, k=key: self._encode_cache.pop(k, None)
+                            pod, lambda _, k=key, c=cache: c.pop(k, None)
                         )
                     except TypeError:
                         pass  # non-weakref-able stand-ins: skip caching
                     else:
-                        self._encode_cache[key] = (ref, ks.R, row_req, row_present)
+                        cache[key] = (ref, ks.R, row_req, row_present)
                 prow = ks.index.pod_row(pod.key)
                 if prow is not None:
                     mask_row = ks.index.mask[prow : prow + 1, :].copy()
