@@ -48,14 +48,21 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
     if not target:
         raise ValueError("TargetSchedulerName must not be empty")
 
-    interval = config.get("reconcileTemporaryThresholdInterval", 0)
-    if isinstance(interval, str) and interval:
-        # accept Go duration-ish strings: "15s", "1m30s", "500ms"
-        interval = _parse_go_duration(interval)
-    elif isinstance(interval, (int, float)) and interval:
-        interval = timedelta(seconds=float(interval))
+    raw_interval = config.get("reconcileTemporaryThresholdInterval", 0)
+    if isinstance(raw_interval, str) and raw_interval:
+        # Go duration strings: "15s", "1m30s", "500ms" (strict grammar)
+        interval = _parse_go_duration(raw_interval)
+    elif isinstance(raw_interval, (int, float)) and raw_interval:
+        interval = timedelta(seconds=float(raw_interval))
     else:
         interval = timedelta(0)
+    if interval < timedelta(0):
+        # a negative interval would turn the resync backstop into a hot loop
+        # (workqueue.add_after fires immediately for secs <= 0)
+        raise ValueError(
+            "reconcileTemporaryThresholdInterval must not be negative: "
+            f"{raw_interval!r}"
+        )
     if interval == timedelta(0):
         interval = DEFAULT_RECONCILE_TEMPORARY_THRESHOLD_INTERVAL
 
@@ -73,12 +80,49 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
     )
 
 
+_GO_DURATION_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,  # U+00B5 micro sign
+    "μs": 1e-6,  # U+03BC greek mu
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+_GO_DURATION_TOKEN = None  # compiled lazily below
+
+
 def _parse_go_duration(s: str) -> timedelta:
+    """Strict Go ``time.ParseDuration`` grammar (reference validates args via
+    it, plugin_args.go:177-195): optional sign, then one or more
+    ``<decimal><unit>`` tokens consuming the WHOLE string. Trailing garbage
+    ("15sgarbage"), missing units ("15"), and empty input all raise — config
+    typos must fail loudly, not silently truncate.
+    """
     import re
 
-    total = 0.0
-    for value, unit in re.findall(r"([0-9.]+)(ms|s|m|h)", s):
-        total += float(value) * {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}[unit]
-    if total == 0:
-        raise ValueError(f"invalid duration: {s!r}")
-    return timedelta(seconds=total)
+    global _GO_DURATION_TOKEN
+    if _GO_DURATION_TOKEN is None:
+        units = "|".join(sorted(_GO_DURATION_UNITS, key=len, reverse=True))
+        _GO_DURATION_TOKEN = re.compile(
+            r"(\d+(?:\.\d*)?|\.\d+)(" + units + r")"
+        )
+
+    orig, sign = s, 1.0
+    if s[:1] in ("+", "-"):
+        sign = -1.0 if s[0] == "-" else 1.0
+        s = s[1:]
+    if s == "0":  # Go's special case: bare zero needs no unit
+        return timedelta(0)
+    if not s:
+        raise ValueError(f"invalid duration: {orig!r}")
+    total, pos = 0.0, 0
+    while pos < len(s):
+        m = _GO_DURATION_TOKEN.match(s, pos)
+        if m is None:
+            raise ValueError(f"invalid duration: {orig!r}")
+        total += float(m.group(1)) * _GO_DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    return timedelta(seconds=sign * total)
