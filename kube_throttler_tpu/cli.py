@@ -19,6 +19,7 @@ shape as deploy/config.yaml in the reference) or from a flat mapping.
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -263,14 +264,28 @@ def main(argv: Optional[list] = None) -> int:
         # *-data credentials materialize to memfds/tempfiles) and the
         # elector and the reflector session share the same RestConfig
         rest_config = parse_kubeconfig(plugin_args.kubeconfig)
+    elif os.environ.get("KUBERNETES_SERVICE_HOST") and args.nodes == 0:
+        # no kubeconfig but running inside a pod → remote mode via the
+        # ServiceAccount mount, the clientcmd fallback the reference hits
+        # through BuildConfigFromFlags("") (plugin.go:71)
+        from .client.transport import in_cluster_config
+
+        try:
+            rest_config = in_cluster_config()
+            print("using in-cluster ServiceAccount credentials", flush=True)
+        except ValueError as e:
+            # fatal, like the reference's BuildConfigFromFlags error path:
+            # silently serving admission from an empty standalone store
+            # inside a cluster would mask the broken SA mount
+            print(f"in-cluster config unavailable: {e}", file=sys.stderr, flush=True)
+            return 1
 
     elector = None
     if leader_elect:
-        if plugin_args.kubeconfig and not args.lock_file:
+        if rest_config is not None and not args.lock_file:
             # multi-host: a coordination.k8s.io Lease on the shared
             # apiserver — replicas on different hosts compete for it, like
             # the reference's embedded kube-scheduler leader election
-            import os as _os
             import socket
 
             from .client.transport import ApiClient
@@ -288,7 +303,7 @@ def main(argv: Optional[list] = None) -> int:
                 # starve leadership renewal into a spurious failover
                 ApiClient(rest_config, qps=None),
                 name=f"kube-throttler-tpu-{plugin_args.name}",
-                identity=f"{socket.gethostname()}-{_os.getpid()}",
+                identity=f"{socket.gethostname()}-{os.getpid()}",
                 on_lost=_leadership_lost,
             )
             print(
@@ -333,12 +348,10 @@ def main(argv: Optional[list] = None) -> int:
         session.start()  # blocks until every reflector listed once
     else:
         if args.data_dir:
-            import os as _os
-
             from .engine.journal import attach as attach_journal
 
-            _os.makedirs(args.data_dir, exist_ok=True)
-            journal_path = _os.path.join(args.data_dir, "store.journal")
+            os.makedirs(args.data_dir, exist_ok=True)
+            journal_path = os.path.join(args.data_dir, "store.journal")
             # attach BEFORE the plugin registers handlers: replay fills the
             # store silently; the plugin's cache-sync replay then delivers
             # the recovered objects to the device mirror and controllers

@@ -29,6 +29,7 @@ import json
 import re
 import threading
 import time
+import uuid
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
@@ -104,6 +105,18 @@ class MockApiServer:
         self._lease_rv = 0
         # v1 Events (Warning emission from remote daemons): (ns, name) → doc
         self._events: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # paginated-LIST continue tokens: token → (remaining items, list rv,
+        # deadline). The real apiserver serves continue reads from the
+        # snapshot the first page was cut at; the mock holds the remainder
+        # server-side, TTL'd + capped so abandoned paginations can't leak
+        # snapshots for the server's lifetime (this class also backs the
+        # standalone daemon's wire mode, not just tests).
+        self._continues: Dict[str, Tuple[List[Dict[str, Any]], int, float]] = {}
+        self.continue_ttl = 300.0  # ≈ the apiserver's etcd compaction window
+        self._continue_cap = 64
+        # observability for tests: largest single LIST response (items)
+        self.max_list_page_items = 0
+        self.list_requests = 0
         for kind in COLLECTION_PATHS:
             self.store.add_event_handler(kind, self._make_recorder(kind), replay=False)
 
@@ -182,7 +195,7 @@ class MockApiServer:
                 if query.get("watch", ["false"])[0] == "true":
                     server._serve_watch(self, kind, query)
                 else:
-                    server._serve_list(self, kind)
+                    server._serve_list(self, kind, query)
 
             def do_POST(self):
                 if not self._authorized():
@@ -244,32 +257,81 @@ class MockApiServer:
 
     # -- endpoint implementations -----------------------------------------
 
-    def _serve_list(self, handler, kind: str) -> None:
-        with self.store._lock:  # consistent snapshot: items + list rv
-            if kind == "Pod":
-                objs = self.store.list_pods()
-            elif kind == "Namespace":
-                objs = self.store.list_namespaces()
-            elif kind == "Throttle":
-                objs = self.store.list_throttles()
-            else:
-                objs = self.store.list_cluster_throttles()
-            items = [
-                self._obj_dict(
-                    kind, o, self.store.resource_version(kind, key_of(kind, o))
+    def _serve_list(self, handler, kind: str, query=None) -> None:
+        query = query or {}
+        try:
+            limit = int((query.get("limit") or ["0"])[0] or "0")
+        except ValueError:
+            limit = 0
+        token = (query.get("continue") or [""])[0]
+        now = time.monotonic()
+        with self._lock:  # prune abandoned paginations
+            for k in [k for k, (_, _, dl) in self._continues.items() if dl < now]:
+                del self._continues[k]
+        if token:
+            with self._lock:
+                entry = self._continues.pop(token, None)
+            if entry is None:
+                # expired/unknown continue token — the real apiserver 410s
+                # and the client falls back to a full relist
+                handler._send_json(
+                    410, {"message": "The provided continue parameter is too old", "code": 410}
                 )
-                for o in objs
-            ]
-            list_rv = self.store.latest_resource_version
+                return
+            items, list_rv, _ = entry
+        else:
+            with self.store._lock:  # consistent snapshot: items + list rv
+                if kind == "Pod":
+                    objs = self.store.list_pods()
+                elif kind == "Namespace":
+                    objs = self.store.list_namespaces()
+                elif kind == "Throttle":
+                    objs = self.store.list_throttles()
+                else:
+                    objs = self.store.list_cluster_throttles()
+                items = [
+                    self._obj_dict(
+                        kind, o, self.store.resource_version(kind, key_of(kind, o))
+                    )
+                    for o in objs
+                ]
+                list_rv = self.store.latest_resource_version
+        meta: Dict[str, Any] = {"resourceVersion": str(list_rv)}
+        if limit and len(items) > limit:
+            page, rest = items[:limit], items[limit:]
+            next_token = uuid.uuid4().hex
+            with self._lock:
+                while len(self._continues) >= self._continue_cap:
+                    # drop the oldest outstanding snapshot (dicts are
+                    # insertion-ordered); that pagination will 410 and relist
+                    del self._continues[next(iter(self._continues))]
+                self._continues[next_token] = (
+                    rest, list_rv, now + self.continue_ttl
+                )
+            meta["continue"] = next_token
+            meta["remainingItemCount"] = len(rest)
+        else:
+            page = items
+        with self._lock:
+            self.list_requests += 1
+            self.max_list_page_items = max(self.max_list_page_items, len(page))
         handler._send_json(
             200,
             {
                 "apiVersion": "v1" if kind in ("Pod", "Namespace") else f"{GROUP}/{VERSION}",
                 "kind": LIST_KINDS[kind],
-                "metadata": {"resourceVersion": str(list_rv)},
-                "items": items,
+                "metadata": meta,
+                "items": page,
             },
         )
+
+    def expire_continue_tokens(self) -> int:
+        """Test hook: drop all outstanding continue tokens so the next
+        continue read 410s (simulates the apiserver's token TTL)."""
+        with self._lock:
+            n = len(self._continues)
+            self._continues.clear()
+        return n
 
     def _write_watch_line(self, handler, doc: Dict[str, Any]) -> bool:
         data = json.dumps(doc).encode() + b"\n"

@@ -97,6 +97,10 @@ class RestConfig:
     ca_file: str = ""
     cert_file: str = ""  # client certificate (mTLS auth)
     key_file: str = ""
+    # bearer token re-read from disk per request (mtime-cached): in-cluster
+    # BoundServiceAccountTokens rotate ~hourly and a static string would
+    # expire mid-run (client-go re-reads the mount the same way)
+    token_file: str = ""
 
 
 # decoded kubeconfig credential material: memfd-backed on Linux (never
@@ -196,6 +200,44 @@ def parse_kubeconfig(path: str) -> RestConfig:
     )
 
 
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_config(sa_dir: str = _SA_DIR) -> RestConfig:
+    """rest.InClusterConfig analog: apiserver address from the
+    ``KUBERNETES_SERVICE_{HOST,PORT}`` env the kubelet injects, bearer
+    token + CA from the ServiceAccount mount. The reference reaches this
+    via ``BuildConfigFromFlags("")`` when no kubeconfig is configured
+    (plugin.go:71 → clientcmd fallback)."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if not host:
+        raise ValueError(
+            "not running in-cluster: KUBERNETES_SERVICE_HOST is unset"
+        )
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"  # IPv6 service host
+    token_file = os.path.join(sa_dir, "token")
+    ca_file = os.path.join(sa_dir, "ca.crt")
+    if not os.path.exists(token_file):
+        raise ValueError(f"in-cluster token missing at {token_file}")
+    if not os.path.exists(ca_file):
+        # never downgrade to an unverified connection while still sending
+        # the bearer token: warn and verify against system roots instead
+        # (client-go's ICC behavior for a partial SA mount)
+        logger.warning(
+            "in-cluster ca.crt missing at %s; verifying against system roots",
+            ca_file,
+        )
+        ca_file = ""
+    return RestConfig(
+        server=f"https://{host}:{port}",
+        token_file=token_file,
+        ca_file=ca_file,
+        verify_tls=True,
+    )
+
+
 class _TokenBucket:
     """Client-side write rate limiter — the analog of client-go's
     rest.Config QPS/Burst that the reference's generated clientset
@@ -252,9 +294,13 @@ class ApiClient:
         timeout: float = 10.0,
         qps: Optional[float] = 50.0,
         burst: int = 100,
+        page_size: Optional[int] = None,
     ):
         self.config = config
         self.timeout = timeout
+        self.page_size = (
+            self.DEFAULT_PAGE_SIZE if page_size is None else max(0, page_size)
+        )
         self._write_bucket = _TokenBucket(qps, burst) if qps else None
         split = urlsplit(config.server)
         if split.scheme not in ("http", "https"):
@@ -269,6 +315,7 @@ class ApiClient:
         # connect picks up rotated files and rebuilds only then
         self._ssl_ctx = None
         self._ssl_ctx_stamp = None
+        self._token_cache: Optional[Tuple[int, str]] = None
         if self._scheme == "https":
             self._ssl_ctx = self._build_ssl_ctx()
 
@@ -307,9 +354,28 @@ class ApiClient:
 
     def _headers(self) -> Dict[str, str]:
         headers = {"Accept": "application/json"}
-        if self.config.token:
-            headers["Authorization"] = f"Bearer {self.config.token}"
+        token = self.config.token
+        if self.config.token_file:
+            token = self._file_token() or token
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         return headers
+
+    def _file_token(self) -> str:
+        """Token from ``token_file``, re-read on mtime change (rotating
+        ServiceAccount mounts)."""
+        path = self.config.token_file
+        try:
+            stamp = os.stat(path).st_mtime_ns
+        except OSError:
+            return self._token_cache[1] if self._token_cache else ""
+        if self._token_cache is None or self._token_cache[0] != stamp:
+            try:
+                with open(path) as f:
+                    self._token_cache = (stamp, f.read().strip())
+            except OSError:
+                return self._token_cache[1] if self._token_cache else ""
+        return self._token_cache[1]
 
     def _request(
         self, method: str, path: str, body: Optional[Dict[str, Any]] = None
@@ -338,11 +404,49 @@ class ApiClient:
 
     # -- verbs -------------------------------------------------------------
 
-    def list(self, kind: str) -> Tuple[List[Dict[str, Any]], str]:
-        """LIST a collection → (item dicts, list resourceVersion)."""
-        doc = self._request("GET", COLLECTION_PATHS[kind])
-        rv = str((doc.get("metadata") or {}).get("resourceVersion", "0"))
-        return list(doc.get("items") or []), rv
+    # client-go's pager chunks relists at 500 items/page by default; at 100k
+    # pods an unbounded LIST is one giant response body on a single socket
+    # read (reference client layer takes ListOptions on every List/Watch —
+    # throttle.go:82-103)
+    DEFAULT_PAGE_SIZE = 500
+
+    def list_pages(
+        self, kind: str, page_size: Optional[int] = None
+    ) -> Iterator[Tuple[List[Dict[str, Any]], str]]:
+        """Chunked LIST: yield ``(page items, list resourceVersion)`` per
+        page, following ``metadata.continue`` tokens until exhausted.
+        ``page_size=0`` disables chunking (one unbounded page). A 410 on an
+        expired continue token surfaces as :class:`GoneError` — the caller
+        decides whether to fall back to an unpaginated full relist."""
+        limit = self.page_size if page_size is None else page_size
+        token = ""
+        while True:
+            params = {}
+            if limit:
+                params["limit"] = str(limit)
+            if token:
+                params["continue"] = token
+            path = COLLECTION_PATHS[kind]
+            if params:
+                path = f"{path}?{urlencode(params)}"
+            doc = self._request("GET", path)
+            meta = doc.get("metadata") or {}
+            yield list(doc.get("items") or []), str(meta.get("resourceVersion", "0"))
+            token = str(meta.get("continue") or "")
+            if not token:
+                return
+
+    def list(
+        self, kind: str, page_size: Optional[int] = None
+    ) -> Tuple[List[Dict[str, Any]], str]:
+        """LIST a collection → (item dicts, list resourceVersion). Paginates
+        internally; use :meth:`list_pages` to stream pages without
+        accumulating (the reflector's relist does)."""
+        items: List[Dict[str, Any]] = []
+        rv = "0"
+        for page, rv in self.list_pages(kind, page_size):
+            items.extend(page)
+        return items, rv
 
     # a real apiserver bookmarks roughly once a minute on a quiet cluster;
     # the server-side timeoutSeconds ends the stream gracefully well before
@@ -574,19 +678,48 @@ class Reflector:
     def _sync_list(self, items: List[Dict[str, Any]]) -> None:
         """Reconcile the cache with a full LIST: synthesize the minimal
         ADDED/MODIFIED/DELETED set (client-go's Replace)."""
-        desired = {}
-        for item in items:
-            obj = self._obj_from(item)
-            desired[key_of(self.kind, obj)] = obj
+        self._sync_pages(iter([(items, self.last_resource_version)]))
+
+    def _sync_pages(
+        self, pages: Iterator[Tuple[List[Dict[str, Any]], str]]
+    ) -> str:
+        """Streaming Replace: apply each LIST page to the cache as it
+        arrives, then delete whatever the relist didn't mention. Memory
+        high-water is one page of raw item dicts plus the seen-key set —
+        not the whole collection — so a 100k-pod cold start never holds
+        one giant response body."""
         current = self._current_keys()
+        seen: set = set()
+        rv = self.last_resource_version
+        for items, rv in pages:
+            for item in items:
+                obj = self._obj_from(item)
+                key = key_of(self.kind, obj)
+                seen.add(key)
+                if key not in current:
+                    self._create(obj)
+                elif current[key] != obj:
+                    self._upsert(obj)
         for key, obj in current.items():
-            if key not in desired:
+            if key not in seen:
                 self._delete(obj)
-        for key, obj in desired.items():
-            if key not in current:
-                self._create(obj)
-            elif current[key] != obj:
-                self._upsert(obj)
+        return rv
+
+    def _relist(self) -> str:
+        """Paginated relist; on a mid-pagination 410 (continue token
+        expired server-side) fall back to ONE unpaginated full LIST, the
+        same way client-go's pager does. Returns the list RV."""
+        self._count(lambda m: m.lists)
+        try:
+            return self._sync_pages(self.client.list_pages(self.kind))
+        except GoneError:
+            self._count(lambda m: m.gone)
+            logger.info(
+                "reflector %s: continue token expired mid-relist; "
+                "falling back to unpaginated LIST",
+                self.kind,
+            )
+            return self._sync_pages(self.client.list_pages(self.kind, 0))
 
     def _apply_event(self, event: Dict[str, Any]) -> None:
         etype = event.get("type")
@@ -615,10 +748,8 @@ class Reflector:
     def list_and_watch_once(self) -> None:
         """One LIST + one WATCH stream (until it ends). Split out for
         deterministic tests."""
-        self._count(lambda m: m.lists)
         self._count(lambda m: m.watches)
-        items, rv = self.client.list(self.kind)
-        self._sync_list(items)
+        rv = self._relist()
         self.last_resource_version = rv
         self._synced.set()
         for event in self.client.watch(self.kind, rv, stop=self._stop):
@@ -627,10 +758,7 @@ class Reflector:
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                self._count(lambda m: m.lists)
-                items, rv = self.client.list(self.kind)
-                self._sync_list(items)
-                self.last_resource_version = rv
+                self.last_resource_version = self._relist()
                 self._synced.set()
             except Exception:
                 if self._stop.is_set():

@@ -152,6 +152,46 @@ contexts:
         )
         assert parse_kubeconfig(str(path)).server == "http://h:1"
 
+    def test_in_cluster_config_from_sa_mount(self, tmp_path, monkeypatch):
+        from kube_throttler_tpu.client.transport import in_cluster_config
+
+        (tmp_path / "token").write_text("sa-token\n")
+        (tmp_path / "ca.crt").write_text("CERT")
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+        cfg = in_cluster_config(sa_dir=str(tmp_path))
+        assert cfg.server == "https://10.0.0.1:6443"
+        assert cfg.token_file == str(tmp_path / "token")
+        assert cfg.verify_tls
+
+    def test_in_cluster_config_requires_env_and_token(self, tmp_path, monkeypatch):
+        from kube_throttler_tpu.client.transport import in_cluster_config
+
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(ValueError, match="KUBERNETES_SERVICE_HOST"):
+            in_cluster_config(sa_dir=str(tmp_path))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        with pytest.raises(ValueError, match="token missing"):
+            in_cluster_config(sa_dir=str(tmp_path))
+
+    def test_token_file_rotation_picked_up(self, apiserver, tmp_path):
+        apiserver.token = "tok-2"
+        token_path = tmp_path / "token"
+        token_path.write_text("tok-1\n")
+        client = ApiClient(
+            RestConfig(server=apiserver.url, token_file=str(token_path))
+        )
+        with pytest.raises(Exception):  # 401 with the stale token
+            client.list("Pod")
+        import os as _os
+
+        token_path.write_text("tok-2\n")
+        # force a new mtime even on coarse-granularity filesystems
+        st = _os.stat(token_path)
+        _os.utime(token_path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        items, _ = client.list("Pod")  # rotated token honored mid-process
+        assert items == []
+
 
 class TestListWatch:
     def test_list_returns_items_and_rv(self, apiserver):
@@ -270,6 +310,83 @@ class TestListWatch:
         client_ok = ApiClient(RestConfig(server=apiserver.url, token="sekrit"))
         items, _ = client_ok.list("Pod")
         assert items == []
+
+
+class TestPaginatedList:
+    """Chunked LIST via limit/continue (client-go pager semantics; the
+    reference's client layer takes ListOptions on every List —
+    throttle.go:82-103)."""
+
+    def test_list_accumulates_across_pages(self, apiserver):
+        for i in range(25):
+            apiserver.store.create_namespace(Namespace(f"pg-{i:02d}"))
+        client = ApiClient(RestConfig(server=apiserver.url), page_size=10)
+        items, rv = client.list("Namespace")
+        # 25 namespaces + the fixture's "default"
+        assert len(items) == 26
+        assert int(rv) > 0
+        assert apiserver.max_list_page_items == 10  # never one giant body
+        assert apiserver.list_requests == 3
+
+    def test_list_pages_streams_with_constant_rv(self, apiserver):
+        for i in range(7):
+            apiserver.store.create_namespace(Namespace(f"st-{i}"))
+        client = ApiClient(RestConfig(server=apiserver.url))
+        pages = list(client.list_pages("Namespace", page_size=3))
+        assert [len(p) for p, _ in pages] == [3, 3, 2]
+        # every page reports the RV of the snapshot the first page was cut at
+        assert len({rv for _, rv in pages}) == 1
+
+    def test_expired_continue_token_410s(self, apiserver):
+        for i in range(6):
+            apiserver.store.create_namespace(Namespace(f"ex-{i}"))
+        client = ApiClient(RestConfig(server=apiserver.url))
+        pages = client.list_pages("Namespace", page_size=2)
+        next(pages)  # first page cut, token outstanding
+        assert apiserver.expire_continue_tokens() == 1
+        with pytest.raises(GoneError):
+            next(pages)
+
+    def test_relist_survives_token_expiry_via_full_list_fallback(self):
+        server = MockApiServer()
+        for i in range(10):
+            server.store.create_namespace(Namespace(f"fb-{i}"))
+        server.start()
+        try:
+            client = ApiClient(RestConfig(server=server.url), page_size=4)
+            sabotaged = client.list_pages
+
+            def expiring_pages(kind, page_size=None):
+                for page in sabotaged(kind, page_size):
+                    yield page
+                    server.expire_continue_tokens()  # token dies between pages
+
+            client.list_pages = expiring_pages
+            local = Store()
+            refl = Reflector(client, "Namespace", local)
+            refl._relist()  # paged relist 410s mid-way → unpaginated fallback
+            assert len(local.list_namespaces()) == 10
+            assert server.max_list_page_items == 10  # the fallback full LIST
+        finally:
+            server.stop()
+
+    def test_streaming_relist_bounded_pages_at_scale(self, apiserver):
+        # 5k objects through a 500-item pager: the reflector's memory
+        # high-water is one page + the seen-key set, and the server never
+        # serializes more than one page per response
+        n = 5_000
+        for i in range(n):
+            apiserver.store.create_namespace(Namespace(f"big-{i:05d}"))
+        client = ApiClient(RestConfig(server=apiserver.url))  # default 500/page
+        local = Store()
+        local.create_namespace(Namespace("stale-entry"))  # must be deleted
+        refl = Reflector(client, "Namespace", local)
+        rv = refl._relist()
+        assert int(rv) > 0
+        assert len(local.list_namespaces()) == n + 1  # n big + fixture default
+        assert local.get_namespace("stale-entry") is None
+        assert apiserver.max_list_page_items == 500
+        assert apiserver.list_requests == (n + 1) // 500 + 1
 
 
 class TestStatusWriter:
