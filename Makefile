@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress lint gen bench bench-quick walkthrough smoke serve clean native image
+.PHONY: test test-stress lint gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -38,6 +38,15 @@ smoke:           ## TPU kernel compatibility smoke on real hardware
 serve:           ## run the daemon against the sample config
 	$(PY) -m kube_throttler_tpu.cli serve --name kube-throttler \
 		--target-scheduler-name my-scheduler --port 10259
+
+dev-cluster:     ## spin a kind cluster + CRDs/RBAC (needs kind/kubectl)
+	hack/dev/up.sh
+
+dev-run:         ## run the daemon in remote mode against the kind cluster
+	hack/dev/run.sh
+
+dev-teardown:    ## delete the dev kind cluster
+	hack/dev/down.sh
 
 clean:
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Tear down the dev kind cluster (reference analog: integration-teardown).
+set -euo pipefail
+CLUSTER_NAME=${CLUSTER_NAME:-kube-throttler-tpu-dev}
+if kind get clusters 2>/dev/null | grep -qx "$CLUSTER_NAME"; then
+  kind delete cluster --name="$CLUSTER_NAME"
+fi

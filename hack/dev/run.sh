@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Run the daemon in REMOTE mode against the kind cluster from up.sh:
+# reflectors list+watch the real apiserver, status writes go to the
+# Throttle/ClusterThrottle status subresources, Warning events to v1
+# Events (reference analog: Makefile dev-run, Makefile:108-118).
+set -euo pipefail
+
+REPO_ROOT=$(cd "$(dirname "$0")/../.." && pwd)
+DEV_DIR="$REPO_ROOT/.dev"
+export KUBECONFIG="${KUBECONFIG:-$DEV_DIR/kubeconfig}"
+export SCHEDULER_NAME="${SCHEDULER_NAME:-my-scheduler}"
+export THROTTLER_NAME="${THROTTLER_NAME:-kube-throttler}"
+
+[ -f "$KUBECONFIG" ] || { echo "no kubeconfig at $KUBECONFIG — run hack/dev/up.sh first" >&2; exit 1; }
+
+mkdir -p "$DEV_DIR"
+envsubst < "$REPO_ROOT/hack/dev/scheduler-config.yaml.template" \
+  > "$DEV_DIR/scheduler-config.yaml"
+
+exec python -m kube_throttler_tpu.cli serve \
+  --config "$DEV_DIR/scheduler-config.yaml" \
+  --kubeconfig "$KUBECONFIG" \
+  --port "${PORT:-10259}" \
+  "$@"

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Spin a kind cluster and point the daemon's remote mode at it.
+# Requires: kind, kubectl, envsubst (none of which exist in the build
+# sandbox — this script is for operator laptops/CI; reference analog:
+# Makefile integration-setup, Makefile:130-142).
+#
+# Usage:
+#   hack/dev/up.sh            # create cluster + CRDs + RBAC, write .dev/
+#   hack/dev/run.sh           # run the daemon against it (remote mode)
+#   hack/dev/down.sh          # tear the cluster down
+set -euo pipefail
+
+CLUSTER_NAME=${CLUSTER_NAME:-kube-throttler-tpu-dev}
+NODE_IMAGE=${NODE_IMAGE:-kindest/node:v1.29.2}
+REPO_ROOT=$(cd "$(dirname "$0")/../.." && pwd)
+DEV_DIR="$REPO_ROOT/.dev"
+KUBECONFIG_PATH="$DEV_DIR/kubeconfig"
+
+mkdir -p "$DEV_DIR"
+
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER_NAME"; then
+  kind create cluster \
+    --name="$CLUSTER_NAME" \
+    --kubeconfig="$KUBECONFIG_PATH" \
+    --config="$REPO_ROOT/hack/dev/kind.conf" \
+    --image="$NODE_IMAGE"
+else
+  kind export kubeconfig --name="$CLUSTER_NAME" --kubeconfig="$KUBECONFIG_PATH"
+fi
+
+kubectl --kubeconfig="$KUBECONFIG_PATH" apply -f "$REPO_ROOT/deploy/crd.yaml"
+kubectl --kubeconfig="$KUBECONFIG_PATH" apply -f "$REPO_ROOT/deploy/namespace.yaml"
+kubectl --kubeconfig="$KUBECONFIG_PATH" apply -f "$REPO_ROOT/deploy/rbac.yaml"
+
+kubectl --kubeconfig="$KUBECONFIG_PATH" wait --timeout=120s \
+  --for=condition=Ready "node/${CLUSTER_NAME}-control-plane"
+
+echo "cluster ready; kubeconfig at $KUBECONFIG_PATH"
+echo "next: hack/dev/run.sh"
