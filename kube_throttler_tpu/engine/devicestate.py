@@ -39,7 +39,13 @@ from ..resourcelist import pod_request_resource_list
 from .index import SelectorIndex
 from .reservations import ReservedResourceAmounts
 from .store import Event, EventType, Store
-from ..ops.check import CHECK_NOT_AFFECTED, STATUS_NAMES, check_pods, check_pods_compact
+from ..ops.check import (
+    CHECK_NOT_AFFECTED,
+    STATUS_NAMES,
+    check_pods,
+    check_pods_compact,
+    check_pods_gather,
+)
 from ..ops.schema import DimRegistry, PodBatch, ThrottleState
 
 logger = logging.getLogger(__name__)
@@ -120,6 +126,13 @@ class _KindState:
         self._device_packed = None  # CheckPrecompPacked cache for check_pod
         self._device_pods: Optional[PodBatch] = None
         self._device_mask = None
+        # sparse companion of the mask for batch checks: int32[pcap, K]
+        # matched throttle cols per pod row (-1 pads), K a ladder rung of
+        # the max per-row match count. None when the dense kernel is the
+        # better batch shape (K within ~tcap/4) or not yet built.
+        self._cols_host: Optional[np.ndarray] = None
+        self._device_cols = None
+        self._cols_K = 0
         # rows/cols touched by single-object events since the last device
         # sync — applied as device-side scatters instead of a full re-upload
         self._dirty_pod_rows: set = set()
@@ -450,6 +463,7 @@ class _KindState:
                 req_present=jnp.asarray(self.pod_present),
             )
             self._device_mask = jnp.asarray(self.index.mask)
+            self._rebuild_cols()
             self.dirty_pods = False
             self._dirty_pod_rows.clear()
             return self._device_pods, self._device_mask
@@ -459,6 +473,7 @@ class _KindState:
             # throttle/namespace event invalidated the whole mask; the live
             # numpy mask already includes any pending row changes
             self._device_mask = jnp.asarray(self.index.mask)
+            self._rebuild_cols()
             mask_rebuilt = True
 
         if self._dirty_pod_rows:
@@ -475,8 +490,59 @@ class _KindState:
             )
             if not mask_rebuilt:
                 self._device_mask = self._device_mask.at[rows].set(self.index.mask[rows, :])
+                self._update_cols_rows(rows)
             self._dirty_pod_rows.clear()
         return self._device_pods, self._device_mask
+
+    def device_cols(self):
+        """Sparse cols int32[pcap,K] for ``check_pods_gather``, or None when
+        the dense mask is the better batch shape. Valid only immediately
+        after ``device_pods()`` under the same lock hold (shares its
+        invalidation bookkeeping)."""
+        return self._device_cols
+
+    def _cols_from_mask(self, mask: np.ndarray, K: int) -> np.ndarray:
+        """[P,T] bool → int32[P,K] matched cols per row, -1 padded (O(nnz))."""
+        P = mask.shape[0]
+        out = np.full((P, K), -1, dtype=np.int32)
+        rows, cols = np.nonzero(mask)  # row-major ⇒ rows sorted
+        if rows.size:
+            counts = mask.sum(axis=1)
+            starts = np.zeros(P + 1, dtype=np.int64)
+            np.cumsum(counts, out=starts[1:])
+            slot = np.arange(rows.size, dtype=np.int64) - starts[rows]
+            out[rows, slot] = cols
+        return out
+
+    def _rebuild_cols(self) -> None:
+        """Full sparse-cols rebuild from the live numpy mask. Chooses the
+        ladder-padded K from the max per-row match count; opts OUT of the
+        sparse path (sets None) when K stops being ≪ T — a near-dense mask
+        gathers most of the state anyway, at worse locality than the
+        broadcast kernel."""
+        mask = self.index.mask
+        nnz_max = int(mask.sum(axis=1).max()) if mask.size else 0
+        K = _next_pow2(max(nnz_max, 1), lo=4)
+        if K * 4 >= max(self.tcap, 16):
+            self._cols_host = None
+            self._device_cols = None
+            self._cols_K = 0
+            return
+        self._cols_host = self._cols_from_mask(mask, K)
+        self._device_cols = jnp.asarray(self._cols_host)
+        self._cols_K = K
+
+    def _update_cols_rows(self, rows: np.ndarray) -> None:
+        """Scatter-update the sparse cols for the given (pow2-padded) dirty
+        rows; escalates to a full rebuild if a row outgrew K."""
+        if self._cols_host is None:
+            return
+        sub = self.index.mask[rows, :]
+        if sub.size and int(sub.sum(axis=1).max()) > self._cols_K:
+            self._rebuild_cols()  # K ladder rung grew
+            return
+        self._cols_host[rows] = self._cols_from_mask(sub, self._cols_K)
+        self._device_cols = self._device_cols.at[rows].set(self._cols_host[rows])
 
     def refresh_mask(self) -> None:
         self._device_mask = None
@@ -818,6 +884,21 @@ class DeviceStateManager:
                     )
                 )
                 n += 1
+            # the sparse [P,K] batch-triage kernel at its live shape (the
+            # served pre_filter_batch path). Dense fallback is NOT warmed:
+            # it only activates on near-dense masks, where one [P,T,R]
+            # execution is exactly the multi-second dispatch prewarm must
+            # not issue on CPU.
+            with self._lock:
+                state = ks.device_state()
+                pods, _ = ks.device_pods()
+                cols = ks.device_cols()
+            if cols is not None:
+                _, ok = check_pods_gather(
+                    state, pods, cols, on_equal=False, step3_on_equal=step3
+                )
+                jax.device_get(ok)
+                n += 1
         if last is not None:
             jax.device_get(last[0])  # one blocking read drains the queue
         return n
@@ -1150,21 +1231,38 @@ class DeviceStateManager:
 
     def _grab_batch_handles(self, kind: str, on_equal: bool):
         """Under the caller's lock: one kind's immutable device handles +
-        decode table for a batch check."""
+        decode table for a batch check. ``cols`` is the sparse [P,K]
+        companion of the mask (None ⇒ dense kernel)."""
         ks = self.throttle if kind == "throttle" else self.clusterthrottle
         state = ks.device_state()
         pods, mask = ks.device_pods()
+        cols = ks.device_cols()
         step3 = True if kind == "throttle" else on_equal
-        return state, pods, mask, step3, dict(ks.index._pod_rows)
+        return state, pods, mask, cols, step3, dict(ks.index._pod_rows)
+
+    @staticmethod
+    def _dispatch_batch_check(state, pods, mask, cols, on_equal, step3):
+        """Gather kernel over [P,K] matched cols when the mask is sparse
+        (the normal cluster shape — each pod matches a handful of
+        throttles); dense [P,T] broadcast kernel otherwise."""
+        if cols is not None:
+            return check_pods_gather(
+                state, pods, cols, on_equal=on_equal, step3_on_equal=step3
+            )
+        return check_pods_compact(
+            state, pods, mask, on_equal=on_equal, step3_on_equal=step3
+        )
 
     def check_batch(self, kind: str, on_equal: bool = False):
         """All stored pods vs all stored throttles (bench / bulk admission).
         Returns (counts int32[P,4], schedulable bool[P], row→pod-key map).
         Handle grab under the lock; kernel dispatch outside (see check_pod)."""
         with self._lock:
-            state, pods, mask, step3, row_map = self._grab_batch_handles(kind, on_equal)
-        counts, schedulable = check_pods_compact(
-            state, pods, mask, on_equal=on_equal, step3_on_equal=step3
+            state, pods, mask, cols, step3, row_map = self._grab_batch_handles(
+                kind, on_equal
+            )
+        counts, schedulable = self._dispatch_batch_check(
+            state, pods, mask, cols, on_equal, step3
         )
         return counts, schedulable, row_map
 
@@ -1274,9 +1372,9 @@ class DeviceStateManager:
                 for kind in ("throttle", "clusterthrottle")
             }
         out = {}
-        for kind, (state, pods, mask, step3, row_map) in handles.items():
-            counts, schedulable = check_pods_compact(
-                state, pods, mask, on_equal=on_equal, step3_on_equal=step3
+        for kind, (state, pods, mask, cols, step3, row_map) in handles.items():
+            counts, schedulable = self._dispatch_batch_check(
+                state, pods, mask, cols, on_equal, step3
             )
             out[kind] = (counts, schedulable, row_map)
         return out

@@ -28,4 +28,5 @@ from .check import (  # noqa: F401
     STATUS_NAMES,
     check_pods,
     check_pods_compact,
+    check_pods_gather,
 )

@@ -19,13 +19,16 @@ pod-count flag, which always blocks.
 
 Shapes: throttle state [T]/[T,R], pods [P]/[P,R], selector mask [P,T].
 Everything broadcasts to [P,T,R] inside a single XLA fusion and reduces over
-R — no [P,T,R] intermediate is materialized at the default sizes. Two
+R — no [P,T,R] intermediate is materialized at the default sizes. Three
 output forms:
 
 - ``check_pods``          → int8[P,T] full classification (explain path,
   oracle diffing, reason-string formatting for blocked pods);
 - ``check_pods_compact``  → int32[P,4] per-pod class counts + bool[P]
-  schedulable (the scheduler hot path: 100k×10k never materializes [P,T]).
+  schedulable (dense batch form: 100k×10k never materializes [P,T]);
+- ``check_pods_gather``   → same outputs from int32[P,K] matched-cols lists
+  instead of a mask: computes P×K×R, the batch path the device manager
+  dispatches in production (real masks are sparse — K ≪ T).
 
 The two static booleans (kind asymmetry, caller onEqual) select among 4
 compiled variants; shapes are padded so object churn never recompiles.
@@ -59,6 +62,68 @@ def _cmp(u, t, on_equal: bool):
     return u >= t if on_equal else u > t
 
 
+def _classify_core(
+    pod_req, pod_present, pod_nonzero,
+    thr_cnt, thr_cnt_present, thr_req, thr_req_present,
+    st_cnt_throttled, st_req_flag_present, st_req_throttled,
+    au_cnt, au_cnt_present, au_req, au_req_present,
+    on_equal: bool, step3_on_equal: bool,
+):
+    """The 4-step ordered resolution on broadcast-compatible operands:
+    pod side [P,1(,R)], throttle side [1,T(,R)] (dense) or [P,K(,R)]
+    (gather). One body ⇒ the dense and sparse kernels cannot drift."""
+    # --- step 1: pod alone vs threshold (onEqual=False) -------------------
+    # pod count is always 1 and always present
+    exceeds_cnt = thr_cnt_present & (1 > thr_cnt)
+    exceeds_req = jnp.any(
+        thr_req_present & pod_present & (pod_req > thr_req) & (pod_req != 0), axis=-1
+    )
+    exceeds = exceeds_cnt | exceeds_req
+
+    # --- step 2: persisted throttled flags --------------------------------
+    st_active = st_cnt_throttled | jnp.any(
+        st_req_flag_present & st_req_throttled & pod_nonzero, axis=-1
+    )
+
+    # --- step 3: used + reserved saturation -------------------------------
+    sat_cnt = thr_cnt_present & au_cnt_present & _cmp(au_cnt, thr_cnt, step3_on_equal)
+    sat_req = jnp.any(
+        thr_req_present
+        & au_req_present
+        & _cmp(au_req, thr_req, step3_on_equal)
+        & pod_nonzero,
+        axis=-1,
+    )
+    saturated = sat_cnt | sat_req
+
+    # --- step 4: used + reserved + pod overflow ---------------------------
+    # pod contributes count 1 (always present) and its requests
+    tot_cnt = au_cnt + 1
+    tot_req = au_req + pod_req
+    tot_req_present = au_req_present | pod_present
+
+    over_cnt = thr_cnt_present & _cmp(tot_cnt, thr_cnt, on_equal)
+    over_req = jnp.any(
+        thr_req_present
+        & tot_req_present
+        & _cmp(tot_req, thr_req, on_equal)
+        & pod_nonzero,
+        axis=-1,
+    )
+    insufficient = over_cnt | over_req
+
+    # --- ordered resolution ----------------------------------------------
+    return jnp.where(
+        exceeds,
+        jnp.int8(CHECK_POD_EXCEEDS),
+        jnp.where(
+            st_active | saturated,
+            jnp.int8(CHECK_ACTIVE),
+            jnp.where(insufficient, jnp.int8(CHECK_INSUFFICIENT), jnp.int8(CHECK_NOT_THROTTLED)),
+        ),
+    )
+
+
 def _classify(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
               on_equal: bool, step3_on_equal: bool) -> jnp.ndarray:
     """Core classification → int8[P,T]. Static flags pick the variant."""
@@ -80,70 +145,17 @@ def _classify(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
     pod_present = pods.req_present[:, None, :]
     pod_nonzero = pod_present & (pod_req != 0)
 
-    thr_req = state.thr_req[None, :, :]
-    thr_req_present = state.thr_req_present[None, :, :]
-    thr_cnt = state.thr_cnt[None, :]
-    thr_cnt_present = state.thr_cnt_present[None, :]
-
-    # --- step 1: pod alone vs threshold (onEqual=False) -------------------
-    # pod count is always 1 and always present
-    exceeds_cnt = thr_cnt_present & (1 > thr_cnt)
-    exceeds_req = jnp.any(
-        thr_req_present & pod_present & (pod_req > thr_req) & (pod_req != 0), axis=-1
-    )
-    exceeds = exceeds_cnt | exceeds_req
-
-    # --- step 2: persisted throttled flags --------------------------------
-    st_active = state.st_cnt_throttled[None, :] | jnp.any(
-        state.st_req_flag_present[None, :, :]
-        & state.st_req_throttled[None, :, :]
-        & pod_nonzero,
-        axis=-1,
-    )
-
-    # --- step 3: used + reserved saturation -------------------------------
-    au_cnt = state.used_cnt + state.res_cnt
-    au_cnt_present = state.used_cnt_present | state.res_cnt_present
-    au_req = state.used_req + state.res_req
-    au_req_present = state.used_req_present | state.res_req_present
-
-    sat_cnt = thr_cnt_present & au_cnt_present[None, :] & _cmp(
-        au_cnt[None, :], thr_cnt, step3_on_equal
-    )
-    sat_req = jnp.any(
-        thr_req_present
-        & au_req_present[None, :, :]
-        & _cmp(au_req[None, :, :], thr_req, step3_on_equal)
-        & pod_nonzero,
-        axis=-1,
-    )
-    saturated = sat_cnt | sat_req
-
-    # --- step 4: used + reserved + pod overflow ---------------------------
-    # pod contributes count 1 (always present) and its requests
-    tot_cnt = au_cnt[None, :] + 1
-    tot_req = au_req[None, :, :] + pod_req
-    tot_req_present = au_req_present[None, :, :] | pod_present
-
-    over_cnt = thr_cnt_present & _cmp(tot_cnt, thr_cnt, on_equal)
-    over_req = jnp.any(
-        thr_req_present
-        & tot_req_present
-        & _cmp(tot_req, thr_req, on_equal)
-        & pod_nonzero,
-        axis=-1,
-    )
-    insufficient = over_cnt | over_req
-
-    # --- ordered resolution ----------------------------------------------
-    result = jnp.where(
-        exceeds,
-        jnp.int8(CHECK_POD_EXCEEDS),
-        jnp.where(
-            st_active | saturated,
-            jnp.int8(CHECK_ACTIVE),
-            jnp.where(insufficient, jnp.int8(CHECK_INSUFFICIENT), jnp.int8(CHECK_NOT_THROTTLED)),
-        ),
+    result = _classify_core(
+        pod_req, pod_present, pod_nonzero,
+        state.thr_cnt[None, :], state.thr_cnt_present[None, :],
+        state.thr_req[None, :, :], state.thr_req_present[None, :, :],
+        state.st_cnt_throttled[None, :],
+        state.st_req_flag_present[None, :, :], state.st_req_throttled[None, :, :],
+        (state.used_cnt + state.res_cnt)[None, :],
+        (state.used_cnt_present | state.res_cnt_present)[None, :],
+        (state.used_req + state.res_req)[None, :, :],
+        (state.used_req_present | state.res_req_present)[None, :, :],
+        on_equal, step3_on_equal,
     )
     affected = mask & state.valid[None, :] & pods.valid[:, None]
     return jnp.where(affected, result, jnp.int8(CHECK_NOT_AFFECTED))
@@ -178,6 +190,52 @@ def check_step(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray):
     """Un-jitted forward step (PreFilter defaults: onEqual=False, Throttle
     kind) for embedding under an outer jit — returns (counts, schedulable)."""
     return _compact(state, pods, mask, False, True)
+
+
+@partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
+def check_pods_gather(state: ThrottleState, pods: PodBatch, cols: jnp.ndarray,
+                      on_equal: bool = False, step3_on_equal: bool = True):
+    """Sparse batch check: ``cols`` int32[P,K] lists each pod's matched
+    throttle columns (-1 pads empty slots). Gathers the K throttle rows per
+    pod and runs the same 4-step resolution as ``check_pods_compact`` over
+    [P,K,R] instead of [P,T,R] — on real clusters each pod matches a
+    handful of throttles, so K ≪ T and the batch drops ~T/K× in both FLOPs
+    and memory traffic (and needs no [P,T] mask on device at all).
+
+    Returns ``(counts int32[P,4], schedulable bool[P])``, identical to
+    ``check_pods_compact`` given a cols/mask pair describing the same
+    matches (parity-tested)."""
+    if state.thr_req.shape[1] != pods.req.shape[1]:
+        raise ValueError(
+            f"resource-dim mismatch: throttle state has R={state.thr_req.shape[1]} "
+            f"but pod batch has R={pods.req.shape[1]}; the dim registry grew — "
+            "re-encode both against the same capacity"
+        )
+    if cols.ndim != 2 or cols.shape[0] != pods.req.shape[0]:
+        raise ValueError(
+            f"cols shape {cols.shape} != (P={pods.req.shape[0]}, K)"
+        )
+    c = jnp.maximum(cols, 0)  # [P,K]; padded slots gather col 0 then mask out
+    slot = (cols >= 0) & state.valid[c] & pods.valid[:, None]
+
+    pod_req = pods.req[:, None, :]
+    pod_present = pods.req_present[:, None, :]
+    pod_nonzero = pod_present & (pod_req != 0)
+
+    result = _classify_core(
+        pod_req, pod_present, pod_nonzero,
+        state.thr_cnt[c], state.thr_cnt_present[c],
+        state.thr_req[c], state.thr_req_present[c],
+        state.st_cnt_throttled[c],
+        state.st_req_flag_present[c], state.st_req_throttled[c],
+        (state.used_cnt + state.res_cnt)[c],
+        (state.used_cnt_present | state.res_cnt_present)[c],
+        (state.used_req + state.res_req)[c],
+        (state.used_req_present | state.res_req_present)[c],
+        on_equal, step3_on_equal,
+    )
+    statuses = jnp.where(slot, result, jnp.int8(CHECK_NOT_AFFECTED))
+    return statuses_to_compact(statuses)
 
 
 @partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))

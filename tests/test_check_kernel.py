@@ -160,3 +160,67 @@ def test_padding_rows_are_not_affected():
     assert (got[1:, :] == CHECK_NOT_AFFECTED).all()
     assert (got[:, 1:] == CHECK_NOT_AFFECTED).all()
     assert got[0, 0] != CHECK_NOT_AFFECTED
+
+
+def _cols_of_mask(mask: np.ndarray, K: int) -> np.ndarray:
+    """[P,T] bool → int32[P,K] matched cols, -1 padded (test-local twin of
+    _KindState._cols_from_mask)."""
+    P = mask.shape[0]
+    out = np.full((P, K), -1, dtype=np.int32)
+    for i in range(P):
+        cols = np.nonzero(mask[i])[0]
+        out[i, : cols.size] = cols
+    return out
+
+
+@pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
+@pytest.mark.parametrize("on_equal", [False, True])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_gather_matches_compact(kind, on_equal, seed):
+    """check_pods_gather over [P,K] matched cols must equal
+    check_pods_compact over the equivalent [P,T] mask — counts AND gate."""
+    from kube_throttler_tpu.ops import check_pods_gather
+
+    rng = random.Random(seed)
+    throttles, reserved, pods = _build_objects(rng, n_throttles=17, n_pods=23, kind=kind)
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims, reserved=reserved)
+    batch = encode_pods(pods, dims)
+    # sparse-ish random mask incl. empty rows and one full row
+    mask = np.asarray(
+        rng.choices([True, False], weights=[1, 4], k=len(pods) * len(throttles))
+    ).reshape(len(pods), len(throttles))
+    mask[0, :] = False
+    mask[1, :] = True
+    cols = _cols_of_mask(mask, K=int(mask.sum(axis=1).max()))
+
+    step3 = True if kind == "throttle" else on_equal
+    want_counts, want_ok = check_pods_compact(
+        state, batch, mask, on_equal=on_equal, step3_on_equal=step3
+    )
+    got_counts, got_ok = check_pods_gather(
+        state, batch, cols, on_equal=on_equal, step3_on_equal=step3
+    )
+    np.testing.assert_array_equal(np.asarray(got_counts), np.asarray(want_counts))
+    np.testing.assert_array_equal(np.asarray(got_ok), np.asarray(want_ok))
+
+
+def test_gather_ignores_padding_and_invalid_cols():
+    """-1 pad slots and cols pointing at invalid (freed) throttle slots must
+    contribute nothing."""
+    from kube_throttler_tpu.ops import check_pods_gather
+
+    throttles = [Throttle(name="t0", spec=ThrottleSpec(threshold=ResourceAmount.of(pod=1)))]
+    pods = [make_pod("p0", requests={"cpu": "1"})]
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims, capacity=8)
+    batch = encode_pods(pods, dims, capacity=4)
+    # slot 0 → the real throttle; slot 1 → padding col 5 (invalid); rest -1
+    cols = np.full((4, 4), -1, dtype=np.int32)
+    cols[0, 0] = 0
+    cols[0, 1] = 5
+    counts, ok = check_pods_gather(state, batch, cols)
+    counts = np.asarray(counts)
+    assert counts[0].sum() == 1  # only the valid throttle counted
+    assert counts[1:].sum() == 0  # invalid pod rows contribute nothing
+    assert not bool(np.asarray(ok)[0]) or counts[0, 0] == 1

@@ -516,3 +516,80 @@ def test_recording_event_recorder_aggregates_and_caps():
         r.eventf("ns/p", "Warning", "FailedScheduling", "Scheduling", f"msg-{i}")
     assert len(r.events) == 3  # capped, oldest evicted
     assert len(r.counts) == 3
+
+
+def test_sparse_cols_k_growth_through_dirty_row_path():
+    """A pod relabel that multiplies its match count must escalate the
+    sparse [P,K] cols ladder (K rung growth) through the dirty-row update,
+    and the batch verdict must keep matching a fresh manager's."""
+    from dataclasses import replace as dc_replace
+
+    import numpy as np
+
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ResourceAmount,
+        Throttle,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+        ThrottleSpec,
+    )
+    from kube_throttler_tpu.engine.devicestate import DeviceStateManager
+    from kube_throttler_tpu.engine.store import Store
+
+    def throttle(name, labels):
+        return Throttle(
+            name=name,
+            spec=ThrottleSpec(
+                throttler_name="kube-throttler",
+                threshold=ResourceAmount.of(requests={"cpu": "100m"}),
+                selector=ThrottleSelector(
+                    selector_terms=(
+                        ThrottleSelectorTerm(LabelSelector(match_labels=labels)),
+                    )
+                ),
+            ),
+        )
+
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    mgr = DeviceStateManager(store, "kube-throttler", "my-scheduler")
+    # 100 fillers (unique labels, match nothing) push tcap high enough that
+    # the sparse path engages (at tiny tcap the dense fallback is correct)
+    for i in range(100):
+        store.create_throttle(throttle(f"t-fill{i}", {"fill": f"f{i}"}))
+    for i in range(8):
+        store.create_throttle(throttle(f"t-group{i}", {"grp": "a"}))
+    store.create_throttle(throttle("t-solo", {"solo": "y"}))
+
+    pod = make_pod("p0", labels={"solo": "y"}, requests={"cpu": "200m"},
+                   node_name="n1")
+    store.create_pod(pod)
+    counts, _, rows = mgr.check_batch("throttle")
+    assert int(np.asarray(counts)[rows["default/p0"]].sum()) == 1
+    ks = mgr.throttle
+    assert ks._cols_host is not None  # sparse path active
+    k_before = ks._cols_K
+
+    # relabel: now ALSO matches the 8 group throttles — nnz 9 > the K rung
+    store.update_pod(
+        dc_replace(pod, labels={"solo": "y", "grp": "a"})
+    )
+    counts, _, rows = mgr.check_batch("throttle")
+    assert int(np.asarray(counts)[rows["default/p0"]].sum()) == 9
+    assert ks._cols_host is not None and ks._cols_K > k_before  # rung grew
+
+    fresh = DeviceStateManager(store, "kube-throttler", "my-scheduler")
+    fcounts, _, frows = fresh.check_batch("throttle")
+    np.testing.assert_array_equal(
+        np.asarray(counts)[rows["default/p0"]],
+        np.asarray(fcounts)[frows["default/p0"]],
+    )
+    for kind_name, handler in (
+        ("Namespace", fresh._on_namespace),
+        ("Pod", fresh._on_pod),
+        ("Throttle", fresh._on_throttle),
+        ("ClusterThrottle", fresh._on_cluster_throttle),
+    ):
+        store.remove_event_handler(kind_name, handler)
