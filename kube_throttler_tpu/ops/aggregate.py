@@ -185,6 +185,28 @@ def rebase_cols(
     )
 
 
+@partial(jax.jit, static_argnames=("use_dots",))
+def _aggregate_cols_impl(pods, mask, counted, cols, use_dots):
+    m = mask[:, cols] & (counted & pods.valid)[:, None]  # bool[P,K]
+    return _aggregate_core(pods, m, use_dots)
+
+
+def aggregate_cols(
+    pods: PodBatch,
+    mask: jnp.ndarray,  # bool[P,T]
+    counted: jnp.ndarray,  # bool[P]
+    cols: jnp.ndarray,  # int32[K] — columns to recompute (pad freely)
+):
+    """Used-aggregates of K specific columns, RETURNED rather than scattered
+    (``rebase_cols`` minus the device-resident write): the hybrid reconcile
+    data plane computes rebases on device — the masked [P,K] reduction is
+    the parallel part — and lands them in the HOST aggregate arrays, which
+    serve every reconcile read without a device round trip."""
+    return _aggregate_cols_impl(
+        pods, mask, counted, cols, use_dots=jax.default_backend() == "cpu"
+    )
+
+
 @jax.jit
 def throttled_flags(
     thr_cnt: jnp.ndarray,
