@@ -775,16 +775,28 @@ def bench_served_batch(plugin, label, iters=5):
     coherent snapshot (two device dispatches). The per-pod cost amortizes
     the dispatch across the whole store — the batched counterpart of the
     per-decision served p99."""
-    out = plugin.pre_filter_batch()  # warm (compiles the dense kernels)
+    out = plugin.pre_filter_batch()  # warm (compiles the batch kernels)
     n = len(out["schedulable"])
+    before = {
+        ph: (plugin.tracer.snapshot(ph) or {"sum": 0.0, "count": 0})
+        for ph in ("batch_dispatch", "batch_merge")
+    }
     t0 = time.perf_counter()
     for _ in range(iters):
         out = plugin.pre_filter_batch()
     dt = (time.perf_counter() - t0) / iters
     pods_per_sec = n / dt if dt else 0.0
+    phases = {}
+    for ph, b in before.items():
+        s = plugin.tracer.snapshot(ph)
+        if s and s["count"] > b["count"]:
+            phases[ph] = (s["sum"] - b["sum"]) / (s["count"] - b["count"])
+    split = ", ".join(f"{ph}={v*1e3:.1f}ms" for ph, v in phases.items())
     log(
         f"[{label}] SERVED pre_filter_batch: {n} pods in {dt*1e3:.1f}ms "
-        f"({pods_per_sec:,.0f} pod-verdicts/sec, one snapshot per call)"
+        f"({pods_per_sec:,.0f} pod-verdicts/sec, one snapshot per call; "
+        f"phase split: {split or 'n/a'} — dispatch is the sparse [P,K] "
+        f"gather kernel, merge is the AND across kinds + ns routing)"
     )
     return {"pods": n, "secs": dt, "pods_per_sec": pods_per_sec}
 

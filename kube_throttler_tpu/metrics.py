@@ -230,6 +230,7 @@ class _KindRecorder:
         # the registry's pre-exposition hook (see record())
         self._pending: Dict[Tuple[str, ...], object] = {}
         self._pending_lock = threading.Lock()
+        self._flush_lock = threading.Lock()
         registry.register_pre_expose(self._flush)
         self.spec_counts = mk(
             f"{k}_spec_threshold_resourceCounts",
@@ -299,17 +300,18 @@ class _KindRecorder:
             self._pending[base] = thr
 
     def _flush(self) -> None:
-        # pop AND write under the lock: with the pop alone guarded, two
-        # concurrent scrapes could interleave so the earlier snapshot's
-        # writes land last, pinning gauges at a stale value until the next
-        # status change (scrape-time writes are a handful of set_keys, so
-        # holding the lock across them is cheap)
-        with self._pending_lock:
-            items = list(self._pending.items())
-            self._pending.clear()
-            self._flush_locked(items)
+        # two locks: _pending_lock guards only the buffer swap so the hot
+        # record() path never waits behind gauge writes (a post-sweep flush
+        # can be T×~7 set_keys), while _flush_lock serializes whole flushes
+        # so two concurrent scrapes cannot interleave writes and pin gauges
+        # at an older snapshot
+        with self._flush_lock:
+            with self._pending_lock:
+                items = list(self._pending.items())
+                self._pending.clear()
+            self._write_items(items)
 
-    def _flush_locked(self, items) -> None:
+    def _write_items(self, items) -> None:
         for base, thr in items:
             self._record_counts(self.spec_counts, base, thr.spec.threshold.resource_counts)
             self._record_requests(self.spec_requests, base, thr.spec.threshold)
