@@ -1165,11 +1165,36 @@ def main():
                 detail["served_batch_pods_per_sec"] = round(b["pods_per_sec"])
                 detail["served_batch_ms"] = round(b["secs"] * 1e3, 2)
             s = safe(
-                "served:streaming", bench_served_streaming, store_s, plugin_s, "served"
+                "served:streaming",
+                bench_served_streaming,
+                store_s,
+                plugin_s,
+                "served",
+                # the max-rate tail needs a longer window than the paced
+                # run: p99 over a 5s window is ~10 drain cycles and lands
+                # anywhere within this 1-CPU host's ~2x scheduling noise;
+                # 10s halves the spread
+                duration=10.0,
             )
             if s:
                 detail["cfg5_served_events_per_sec"] = round(s["events_per_sec"])
                 detail["cfg5_maxrate_lag_p99_ms"] = round(s["lag_p99_ms"], 2)
+            # lag at a SUSTAINED 2.5k ev/s (VERDICT r3 task 2's "≥2k ev/s"
+            # framing): max rate is open-loop saturation where lag is
+            # definitionally backlog-bound; this measures the tail with the
+            # pipeline loaded but not drowning
+            s25 = safe(
+                "served:streaming-2500",
+                bench_served_streaming,
+                store_s,
+                plugin_s,
+                "served",
+                pace_hz=2500.0,
+                duration=10.0,
+            )
+            if s25:
+                detail["cfg5_2500hz_events_per_sec"] = round(s25["events_per_sec"])
+                detail["cfg5_2500hz_lag_p99_ms"] = round(s25["lag_p99_ms"], 2)
             # steady-state status-write lag at the BASELINE 1k/s target load
             s2 = safe(
                 "served:streaming-paced",
