@@ -93,6 +93,70 @@ class ControllerBase:
     def enqueue_after(self, key: str, duration: timedelta) -> None:
         self.workqueue.add_after(key, duration)
 
+    # ------------------------------------------------- batched-drain commit
+
+    def _commit_reconcile_plans(self, plans, now, errors) -> None:
+        """Phases 2+3 of a batched reconcile drain, shared by both kinds'
+        controllers (they differ only in writer methods and key forms).
+
+        ``plans`` is ``[(queue_key, thr, new_thr | None, unreserve_pods)]``
+        from the controller's compute phase. With a batch-capable status
+        writer (the in-memory Store), every changed status lands in ONE
+        store-lock hold — at drain saturation, per-key writes contend with
+        the event-ingest threads for that lock hundreds of times per drain
+        — and the post-write work runs afterwards (the used-vs-reserved
+        double-count window is the few ms the batch write takes). Without
+        one (remote mode, one HTTP PUT per object regardless), write and
+        post-write work stay INTERLEAVED per key so the double-count
+        window stays one PUT wide, exactly like the pre-batch code — a
+        drain of slow PUTs must not delay key #1's unreserve to the end.
+
+        Controllers provide ``_write_status(thr)``,
+        ``_batch_write_statuses(thrs) -> {store_key: obj|Exception} | None``
+        (None ⇒ unsupported), and ``_store_key(thr)``.
+        """
+        changed = {key: new for key, _, new, _ in plans if new is not None}
+        batched = (
+            self._batch_write_statuses(list(changed.values())) if changed else {}
+        )
+        if batched is None:  # no batch writer: interleave per key
+            for key, thr, new_thr, unreserve_pods in plans:
+                try:
+                    if new_thr is not None:
+                        self._write_status(new_thr)
+                    self._post_write(key, thr, new_thr, unreserve_pods, now)
+                except Exception as e:  # noqa: BLE001 — requeued per key
+                    errors[key] = e
+            return
+        store_to_queue = {self._store_key(new): key for key, new in changed.items()}
+        write_errors = {
+            store_to_queue.get(k, k): r
+            for k, r in batched.items()
+            if isinstance(r, Exception)
+        }
+        for key, thr, new_thr, unreserve_pods in plans:
+            if key in write_errors:
+                errors[key] = write_errors[key]
+                continue
+            try:
+                self._post_write(key, thr, new_thr, unreserve_pods, now)
+            except Exception as e:  # noqa: BLE001 — requeued per key
+                errors[key] = e
+
+    def _post_write(self, key, thr, new_thr, unreserve_pods, now) -> None:
+        """Per-key work that must follow the status write: metrics record,
+        unreserve-on-observe (throttle_controller.go:135-155 — the device
+        path's set is snapshot-coherent with the aggregate; unreserve is a
+        no-op for non-reserved pods), and the next override-boundary
+        wakeup."""
+        if self.metrics_recorder is not None:
+            self.metrics_recorder.record(new_thr if new_thr is not None else thr)
+        for p in unreserve_pods:
+            self.unreserve_on_throttle(p, thr)
+        next_in = thr.spec.next_override_happens_in(now)
+        if next_in is not None:
+            self.enqueue_after(key, next_in)
+
     def _resync(self) -> None:
         """Re-enqueue every live key, then re-arm the next tick. Errors in
         ``list_keys_func`` skip one tick but never kill the cadence."""

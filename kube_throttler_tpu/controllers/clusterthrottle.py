@@ -164,33 +164,49 @@ class ClusterThrottleController(ControllerBase):
                 [t.key for t in thrs.values()],
                 reserved,
             )
+        # three-phase drain, mirroring ThrottleController.reconcile_batch:
+        # compute → one batched status write → per-key post-write work
+        plans = []  # (key, thr, new_thr | None, unreserve_list)
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
                     used, unreserve_pods = used_map[thr.key]
-                    self._finish_reconcile(key, thr, used, now, None, None, unreserve_pods)
                 else:
                     non_terminated, terminated = self.affected_pods(thr)
                     used = ResourceAmount()
                     for p in non_terminated:
                         used = used.add(resource_amount_of_pod(p))
-                    self._finish_reconcile(
-                        key, thr, used, now, non_terminated, terminated, None
-                    )
+                    unreserve_pods = non_terminated + terminated
+                new_status = self._planned_status(thr, used, now)
+                new_thr = (
+                    thr.with_status(new_status)
+                    if new_status != thr.status
+                    else None
+                )
+                plans.append((key, thr, new_thr, unreserve_pods))
             except Exception as e:
                 errors[key] = e
+        self._commit_reconcile_plans(plans, now, errors)
         return errors
 
-    def _finish_reconcile(
-        self,
-        key: str,
-        thr: ClusterThrottle,
-        used: ResourceAmount,
-        now,
-        non_terminated: Optional[List[Pod]],
-        terminated: Optional[List[Pod]],
-        unreserve_pods: Optional[List[Pod]] = None,
-    ) -> None:
+    def _write_status(self, thr: ClusterThrottle) -> None:
+        self.status_writer.update_cluster_throttle_status(thr)
+
+    def _batch_write_statuses(self, thrs):
+        batch = getattr(
+            self.status_writer, "update_cluster_throttle_statuses", None
+        )
+        return None if batch is None else batch(thrs)
+
+    @staticmethod
+    def _store_key(thr: ClusterThrottle) -> str:
+        # the store keys ClusterThrottles by bare name; the workqueue key
+        # is mapped back by the base commit helper
+        return thr.name
+
+    def _planned_status(
+        self, thr: ClusterThrottle, used: ResourceAmount, now
+    ) -> ThrottleStatus:
         calculated = thr.spec.calculate_threshold(now)
         new_calculated = thr.status.calculated_threshold
         if (
@@ -198,35 +214,11 @@ class ClusterThrottleController(ControllerBase):
             or thr.status.calculated_threshold.messages != calculated.messages
         ):
             new_calculated = calculated
-
         throttled = new_calculated.threshold.is_throttled(used, True)
-        new_status = ThrottleStatus(
+        return ThrottleStatus(
             calculated_threshold=new_calculated, throttled=throttled, used=used
         )
 
-        def unreserve_affected() -> None:
-            # see ThrottleController._finish_reconcile: the device-path set
-            # is snapshot-coherent with the aggregate
-            if non_terminated is not None:
-                for p in non_terminated + terminated:
-                    self.unreserve_on_throttle(p, thr)
-            else:
-                for p in unreserve_pods:
-                    self.unreserve_on_throttle(p, thr)
-
-        if new_status != thr.status:
-            self.status_writer.update_cluster_throttle_status(thr.with_status(new_status))
-            if self.metrics_recorder is not None:
-                self.metrics_recorder.record(thr.with_status(new_status))
-            unreserve_affected()
-        else:
-            if self.metrics_recorder is not None:
-                self.metrics_recorder.record(thr)
-            unreserve_affected()
-
-        next_in = thr.spec.next_override_happens_in(now)
-        if next_in is not None:
-            self.enqueue_after(key, next_in)
 
     # ----------------------------------------------------------- collections
 

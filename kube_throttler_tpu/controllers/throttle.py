@@ -129,10 +129,15 @@ class ThrottleController(ControllerBase):
             raise errors[key]
 
     def reconcile_batch(self, keys: List[str]) -> Dict[str, Exception]:
-        """Reconcile a drained batch of keys: with a device manager, ONE
-        flush+gather of the device used-aggregates serves every key (the
-        streaming data plane — no per-throttle pod scan); per-key status
-        writes are individually fenced. Returns failures for requeue."""
+        """Reconcile a drained batch of keys in three phases: with a device
+        manager, ONE flush+gather of the used-aggregates serves every key
+        (the streaming data plane — no per-throttle pod scan); all changed
+        statuses then land in ONE batched store write (a per-key write
+        contends with the event-ingest threads for the store lock hundreds
+        of times per drain at saturation); finally the per-key post-write
+        work (metrics, unreserve-on-observe, override wakeups) runs for the
+        keys whose write — if any — succeeded. Returns failures for
+        requeue."""
         now = self.clock.now()
         thrs: Dict[str, Throttle] = {}
         for key in dict.fromkeys(keys):
@@ -154,33 +159,44 @@ class ThrottleController(ControllerBase):
             used_map = dm.guarded(
                 "reconcile", dm.aggregate_used_for, self.KIND, list(thrs), reserved
             )
+        # phase 1: pure status computation + the unreserve sets
+        plans = []  # (key, thr, new_thr | None, unreserve_list)
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
                     used, unreserve_pods = used_map[key]
-                    self._finish_reconcile(key, thr, used, now, None, None, unreserve_pods)
                 else:
                     non_terminated, terminated = self.affected_pods(thr)
                     used = ResourceAmount()
                     for p in non_terminated:
                         used = used.add(resource_amount_of_pod(p))
-                    self._finish_reconcile(
-                        key, thr, used, now, non_terminated, terminated, None
-                    )
+                    unreserve_pods = non_terminated + terminated
+                new_status = self._planned_status(thr, used, now)
+                new_thr = (
+                    thr.with_status(new_status)
+                    if new_status != thr.status
+                    else None
+                )
+                plans.append((key, thr, new_thr, unreserve_pods))
             except Exception as e:
                 errors[key] = e
+        # phases 2+3: batched write + post-write work (base helper; remote
+        # mode interleaves per key so the double-count window stays one PUT)
+        self._commit_reconcile_plans(plans, now, errors)
         return errors
 
-    def _finish_reconcile(
-        self,
-        key: str,
-        thr: Throttle,
-        used: ResourceAmount,
-        now,
-        non_terminated: Optional[List[Pod]],
-        terminated: Optional[List[Pod]],
-        unreserve_pods: Optional[List[Pod]] = None,
-    ) -> None:
+    def _write_status(self, thr: Throttle) -> None:
+        self.status_writer.update_throttle_status(thr)
+
+    def _batch_write_statuses(self, thrs):
+        batch = getattr(self.status_writer, "update_throttle_statuses", None)
+        return None if batch is None else batch(thrs)
+
+    @staticmethod
+    def _store_key(thr: Throttle) -> str:
+        return thr.key
+
+    def _planned_status(self, thr: Throttle, used: ResourceAmount, now) -> ThrottleStatus:
         calculated = thr.spec.calculate_threshold(now)
         new_calculated = thr.status.calculated_threshold
         if (
@@ -191,38 +207,10 @@ class ThrottleController(ControllerBase):
             # otherwise every reconcile would differ by timestamp alone
             # (throttle_controller.go:123-132)
             new_calculated = calculated
-
         throttled = new_calculated.threshold.is_throttled(used, True)
-        new_status = ThrottleStatus(
+        return ThrottleStatus(
             calculated_threshold=new_calculated, throttled=throttled, used=used
         )
-
-        def unreserve_affected() -> None:
-            # after the status write, observed pods are safe to un-reserve;
-            # terminated pods too (throttle_controller.go:135-155). The
-            # device path's set (reserved ∩ shouldCountIn ∩ matched) was
-            # computed under the SAME snapshot as the aggregate — unreserve
-            # is a no-op for non-reserved pods, so the sets are equivalent.
-            if non_terminated is not None:
-                for p in non_terminated + terminated:
-                    self.unreserve_on_throttle(p, thr)
-            else:
-                for p in unreserve_pods:
-                    self.unreserve_on_throttle(p, thr)
-
-        if new_status != thr.status:
-            self.status_writer.update_throttle_status(thr.with_status(new_status))
-            if self.metrics_recorder is not None:
-                self.metrics_recorder.record(thr.with_status(new_status))
-            unreserve_affected()
-        else:
-            if self.metrics_recorder is not None:
-                self.metrics_recorder.record(thr)
-            unreserve_affected()
-
-        next_in = thr.spec.next_override_happens_in(now)
-        if next_in is not None:
-            self.enqueue_after(key, next_in)
 
     # ----------------------------------------------------------- collections
 

@@ -280,6 +280,42 @@ class Store:
             self._dispatch(Event(EventType.MODIFIED, "Throttle", updated, old_obj=current))
         return updated
 
+    def _update_statuses_locked(self, kind: str, thrs) -> Dict[str, object]:
+        """Batched UpdateStatus under ONE lock hold: at reconcile-drain
+        saturation, per-key writes made every status contend with the
+        event-ingest threads for this lock ~hundreds of times per drain;
+        one hold writes the whole drain's worth. Handlers still dispatch
+        per event inside the hold, preserving resourceVersion order.
+        Returns {key: updated object | Exception} — per-key failures don't
+        fail the batch."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            for thr in thrs:
+                key = _key_of(kind, thr)
+                try:
+                    current = self._objects[kind].get(key)
+                    if current is None:
+                        raise NotFoundError(f"{kind} {key!r} not found")
+                    updated = current.with_status(thr.status)
+                    self._rv += 1
+                    self._objects[kind][key] = updated
+                    self._versions[kind][key] = self._rv
+                    self._dispatch(
+                        Event(EventType.MODIFIED, kind, updated, old_obj=current)
+                    )
+                    out[key] = updated
+                except Exception as e:  # noqa: BLE001 — reported per key
+                    out[key] = e
+        return out
+
+    def update_throttle_statuses(self, thrs) -> Dict[str, object]:
+        """Batch form of update_throttle_status (no optimistic-concurrency
+        arg: the reconcile loop re-reads on requeue anyway)."""
+        return self._update_statuses_locked("Throttle", thrs)
+
+    def update_cluster_throttle_statuses(self, thrs) -> Dict[str, object]:
+        return self._update_statuses_locked("ClusterThrottle", thrs)
+
     def update_cluster_throttle_status(
         self, thr: ClusterThrottle, expected_version: Optional[int] = None
     ) -> ClusterThrottle:
