@@ -379,29 +379,46 @@ class _KindState:
         self._amount_into_row(amount, "res_cnt", "res_cnt_present", "res_req", "res_req_present", col)
         self._note_thr_col(col, before)
 
+    def pod_request_entries(self, pod: Pod) -> List[Tuple[int, int]]:
+        """(dim index, milli value) pairs for a pod's effective requests —
+        the registry-dependent half of the row encode. Valid for any
+        consumer sharing this instance's ``dims``."""
+        return [
+            (self.dims.index_of(name), to_milli(q))
+            for name, q in pod_request_resource_list(pod).items()
+        ]
+
     def encode_pod_requests_into(
-        self, req: np.ndarray, present: np.ndarray, i: int, pod: Pod
+        self, req: np.ndarray, present: np.ndarray, i: int, pod: Pod,
+        entries: Optional[List[Tuple[int, int]]] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Canonical pod-request row encoding (shared by the mirror rows and
         ad-hoc single-pod batches). Returns possibly-regrown arrays."""
         req[i, :] = 0
         present[i, :] = False
-        for name, q in pod_request_resource_list(pod).items():
-            j = self.dims.index_of(name)
+        if entries is None:
+            entries = self.pod_request_entries(pod)
+        for j, milli in entries:
             if j >= req.shape[1]:
                 self.ensure_capacity()
                 req = np.pad(req, ((0, 0), (0, self.R - req.shape[1])))
                 present = np.pad(present, ((0, 0), (0, self.R - present.shape[1])))
-            req[i, j] = to_milli(q)
+            req[i, j] = milli
             present[i, j] = True
         return req, present
 
-    def set_pod_row(self, pod: Pod, counted: bool = False, count_in: bool = False) -> None:
+    def set_pod_row(
+        self,
+        pod: Pod,
+        counted: bool = False,
+        count_in: bool = False,
+        entries: Optional[List[Tuple[int, int]]] = None,
+    ) -> None:
         row = self.index.upsert_pod(pod)
         before = (self.pcap, self.R)
         self.ensure_capacity()
         self.pod_req, self.pod_present = self.encode_pod_requests_into(
-            self.pod_req, self.pod_present, row, pod
+            self.pod_req, self.pod_present, row, pod, entries=entries
         )
         self.pod_valid[row] = True
         self.count_in[row] = count_in
@@ -1005,12 +1022,27 @@ class DeviceStateManager:
             self._encode_cache.pop(id(pod), None)
             if event.old_obj is not None:
                 self._encode_cache.pop(id(event.old_obj), None)
+            # computed ONCE against the manager's registry — the SAME
+            # object both kinds encode against (they are constructed with
+            # self.dims), so the shared-entry handoff is structural, not a
+            # docstring promise. Previously the Fraction arithmetic + dim
+            # interning ran twice per event, once per kind.
+            entries = (
+                None
+                if event.type == EventType.DELETED
+                else [
+                    (self.dims.index_of(name), to_milli(q))
+                    for name, q in pod_request_resource_list(pod).items()
+                ]
+            )
             for ks in (self.throttle, self.clusterthrottle):
                 ks.capture_pod_delta_begin(pod.key)
                 if event.type == EventType.DELETED:
                     ks.remove_pod_row(pod.key)
                 else:
-                    ks.set_pod_row(pod, counted=counted, count_in=count_in)
+                    ks.set_pod_row(
+                        pod, counted=counted, count_in=count_in, entries=entries
+                    )
                 ks.capture_pod_delta_end(pod.key)
                 # no refresh_mask: a pod event only changes its own mask row,
                 # which the incremental row scatter ships

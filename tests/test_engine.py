@@ -593,3 +593,125 @@ def test_sparse_cols_k_growth_through_dirty_row_path():
         ("ClusterThrottle", fresh._on_cluster_throttle),
     ):
         store.remove_event_handler(kind_name, handler)
+
+
+def test_store_batched_status_write_mixed_results():
+    """One lock-hold batch write: successes update + dispatch MODIFIED with
+    old_obj; a missing key reports NotFoundError in-place without failing
+    the rest."""
+    from kube_throttler_tpu.api.pod import Namespace
+    from kube_throttler_tpu.api.types import (
+        ResourceAmount,
+        Throttle,
+        ThrottleSpec,
+        ThrottleStatus,
+    )
+    from kube_throttler_tpu.engine.store import NotFoundError, Store
+
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    for name in ("a", "b"):
+        store.create_throttle(
+            Throttle(
+                name=name,
+                spec=ThrottleSpec(
+                    throttler_name="kt", threshold=ResourceAmount.of(pod=3)
+                ),
+            )
+        )
+    events = []
+    store.add_event_handler("Throttle", lambda e: events.append(e), replay=False)
+
+    def with_used(name, pods):
+        thr = store.get_throttle("default", name) if name != "ghost" else Throttle(
+            name="ghost",
+            spec=ThrottleSpec(throttler_name="kt", threshold=ResourceAmount.of(pod=3)),
+        )
+        return thr.with_status(
+            ThrottleStatus(
+                calculated_threshold=thr.status.calculated_threshold,
+                throttled=thr.status.throttled,
+                used=ResourceAmount.of(pod=pods),
+            )
+        )
+
+    out = store.update_throttle_statuses(
+        [with_used("a", 1), with_used("ghost", 9), with_used("b", 2)]
+    )
+    assert isinstance(out["default/ghost"], NotFoundError)
+    assert out["default/a"].status.used.resource_counts == 1
+    assert out["default/b"].status.used.resource_counts == 2
+    mods = [e for e in events if e.type.name == "MODIFIED"]
+    assert len(mods) == 2
+    assert all(e.old_obj is not None for e in mods)
+    # rv strictly increases across the batch
+    assert store.resource_version("Throttle", "default/a") < store.resource_version(
+        "Throttle", "default/b"
+    )
+
+
+def test_drain_requeues_only_failed_status_writes():
+    """A per-key write failure inside the batched drain lands in the error
+    map (→ rate-limited requeue) while the rest of the drain completes."""
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ResourceAmount,
+        Throttle,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+        ThrottleSpec,
+    )
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    plugin = KubeThrottler(
+        decode_plugin_args({"name": "kt", "targetSchedulerName": "my-scheduler"}),
+        store,
+        use_device=False,
+        start_workers=False,
+    )
+    for i in range(4):
+        store.create_throttle(
+            Throttle(
+                name=f"t{i}",
+                spec=ThrottleSpec(
+                    throttler_name="kt",
+                    threshold=ResourceAmount.of(pod=5),
+                    selector=ThrottleSelector(
+                        selector_terms=(
+                            ThrottleSelectorTerm(
+                                LabelSelector(match_labels={"g": f"g{i}"})
+                            ),
+                        )
+                    ),
+                ),
+            )
+        )
+    for i in range(4):
+        pod = make_pod(f"p{i}", labels={"g": f"g{i}"}, node_name="n1")
+        pod.status.phase = "Running"
+        store.create_pod(pod)
+
+    ctr = plugin.throttle_ctr
+    orig = store.update_throttle_statuses
+
+    def poisoned(thrs):
+        out = orig([t for t in thrs if t.name != "t2"])
+        for t in thrs:
+            if t.name == "t2":
+                out["default/t2"] = RuntimeError("boom")
+        return out
+
+    store.update_throttle_statuses = poisoned
+    errors = ctr.reconcile_batch([f"default/t{i}" for i in range(4)])
+    assert set(errors) == {"default/t2"}
+    assert isinstance(errors["default/t2"], RuntimeError)
+    # the others' statuses landed
+    for i in (0, 1, 3):
+        assert (
+            store.get_throttle("default", f"t{i}").status.used.resource_counts == 1
+        )
+    assert store.get_throttle("default", "t2").status.used.resource_counts is None
