@@ -667,10 +667,15 @@ class TestRemoteModeEndToEnd:
                 _bound(make_pod("p1", labels={"grp": "a"}, requests={"cpu": "800m"}))
             )
             # ... flows to the local cache, reconciles, and the status write
-            # lands on the REMOTE apiserver (used=800m, throttled=False)
+            # lands on the REMOTE apiserver (used=800m, throttled=False).
+            # 20s: a status-write Conflict (racing our own spec edit below
+            # on a loaded host) takes a requeue-backoff + reflector-echo
+            # round trip to converge — observed flaking at 10s under full
+            # CPU contention
             assert _wait(
                 lambda: remote.get_throttle("default", "t1").status.used.resource_counts
-                == 1
+                == 1,
+                timeout=20.0,
             )
             assert _wait(
                 lambda: local.get_throttle("default", "t1").status.used.resource_counts
@@ -697,7 +702,8 @@ class TestRemoteModeEndToEnd:
             assert _wait(
                 lambda: plugin.pre_filter(
                     make_pod("p2", labels={"grp": "a"}, requests={"cpu": "300m"})
-                ).is_success()
+                ).is_success(),
+                timeout=20.0,
             )
         finally:
             plugin.stop()
