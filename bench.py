@@ -1152,6 +1152,10 @@ def main():
         )
         if stack:
             store_s, plugin_s = stack
+            # cfg5_*/served_* numbers are NOT comparable across scales: at
+            # the full config every churn event dirties ~40 throttle keys
+            # (20 per group per kind) vs 4 at the quick scale
+            detail["served_scale"] = [100_000 // scale, 10_000 // scale]
             r = safe("served:prefilter", bench_served_prefilter, plugin_s, "served")
             if r:
                 served_stats, rate1, rate4 = r
