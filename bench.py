@@ -801,6 +801,84 @@ def bench_served_batch(plugin, label, iters=5):
     return {"pods": n, "secs": dt, "pods_per_sec": pods_per_sec}
 
 
+def _lag_tracker():
+    """(pending, lock, lags, handler): handler pops a key's oldest pending
+    timestamp on its MODIFIED event and records the lag sample."""
+    import threading as _threading
+
+    from kube_throttler_tpu.engine.store import EventType
+
+    pending: dict = {}
+    lock = _threading.Lock()
+    lags: list = []
+
+    def on_write(event):
+        if event.type != EventType.MODIFIED:
+            return
+        now = time.perf_counter()
+        with lock:
+            t0 = pending.pop(event.obj.key, None)
+        if t0 is not None:
+            lags.append(now - t0)
+
+    return pending, lock, lags, on_write
+
+
+def _group_keys_of(store):
+    group_keys: dict = {}
+    for thr in store.list_throttles():
+        g = thr.spec.selector.selector_terms[0].pod_selector.match_labels["grp"]
+        group_keys.setdefault(g, []).append(thr.key)
+    return group_keys
+
+
+def _drive_pod_churn(store, group_keys, pending, pend_lock, rng, duration, pace_hz):
+    """The cfg5 churn generator, SHARED by the in-process and remote-wire
+    serving benches so their lag numbers stay comparable: paced pod
+    updates that are REAL state changes every time — the cpu value always
+    differs from the last written one (seeded from the pod's actual stored
+    request, so even a pod's first update cannot be a no-op that leaves a
+    stale pending timestamp poisoning later lag samples). Every event
+    pre-registers its group's throttle keys in ``pending`` for the
+    event→status-commit pairing. Returns (n_events, fire-window seconds)."""
+    from dataclasses import replace as _replace
+
+    from kube_throttler_tpu.api.pod import make_pod
+    from kube_throttler_tpu.resourcelist import pod_request_resource_list
+
+    pods = store.list_pods()
+    cur_cpu: dict = {}  # pod name → last cpu we wrote
+    n_events = 0
+    t_start = time.perf_counter()
+    deadline = t_start + duration
+    while time.perf_counter() < deadline:
+        if pace_hz:
+            next_at = t_start + n_events / pace_hz
+            delay = next_at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        pod = pods[rng.randrange(len(pods))]
+        g = pod.labels["grp"]
+        prev = cur_cpu.get(pod.name)
+        if prev is None:  # seed from the pod's actual stored request
+            stored = pod_request_resource_list(pod).get("cpu")
+            prev = int(stored * 1000) if stored else 0
+        new_cpu = rng.randrange(1, 8) * 100
+        if new_cpu == prev:
+            new_cpu = new_cpu % 700 + 100
+        cur_cpu[pod.name] = new_cpu
+        updated = make_pod(pod.name, labels=pod.labels, requests={"cpu": f"{new_cpu}m"})
+        updated = _replace(updated, spec=_replace(updated.spec, node_name="node-1"))
+        updated.status.phase = "Running"
+        now = time.perf_counter()
+        with pend_lock:
+            for key in group_keys.get(g, ()):
+                pending.setdefault(key, now)
+        store.update_pod(updated)
+        n_events += 1
+    return n_events, time.perf_counter() - t_start
+
+
 def bench_served_streaming(
     store, plugin, label, groups=500, duration=5.0, pace_hz=0.0
 ):
@@ -823,70 +901,15 @@ def bench_served_streaming(
 
     rng = random.Random(1)
     # key → time of the first event not yet reflected in a status write
-    pending: dict = {}
-    pend_lock = _threading.Lock()
-    lags: list = []
-    group_keys: dict = {}
-    for thr in store.list_throttles():
-        g = thr.spec.selector.selector_terms[0].pod_selector.match_labels["grp"]
-        group_keys.setdefault(g, []).append(thr.key)
-
-    def on_throttle_write(event):
-        if event.type != EventType.MODIFIED:
-            return
-        now = time.perf_counter()
-        with pend_lock:
-            t0 = pending.pop(event.obj.key, None)
-        if t0 is not None:
-            lags.append(now - t0)
-
+    pending, pend_lock, lags, on_throttle_write = _lag_tracker()
+    group_keys = _group_keys_of(store)
     store.add_event_handler("Throttle", on_throttle_write, replay=False)
     plugin.start()
     try:
-        pods = store.list_pods()
-        cur_cpu: dict = {}  # pod name → last cpu we wrote (lag accounting)
-        n_events = 0
-        t_start = time.perf_counter()
-        deadline = t_start + duration
-        while time.perf_counter() < deadline:
-            if pace_hz:
-                next_at = t_start + n_events / pace_hz
-                delay = next_at - time.perf_counter()
-                if delay > 0:
-                    time.sleep(delay)
-            pod = pods[rng.randrange(len(pods))]
-            g = pod.labels["grp"]
-            # a REAL state change every time: pick a cpu value different
-            # from the last one written, so every event flips some
-            # throttle's used and the pending→write lag pairing is sound
-            # (a no-op event would leave a stale pending timestamp that
-            # poisons the next genuine write's lag sample)
-            prev = cur_cpu.get(pod.name)
-            if prev is None:  # seed from the pod's actual stored request
-                from kube_throttler_tpu.resourcelist import pod_request_resource_list
-
-                stored = pod_request_resource_list(pod).get("cpu")
-                prev = int(stored * 1000) if stored else 0
-            new_cpu = rng.randrange(1, 8) * 100
-            if new_cpu == prev:
-                new_cpu = new_cpu % 700 + 100
-            cur_cpu[pod.name] = new_cpu
-            updated = make_pod(
-                pod.name,
-                labels=pod.labels,
-                requests={"cpu": f"{new_cpu}m"},
-            )
-            updated = _replace(
-                updated, spec=_replace(updated.spec, node_name="node-1")
-            )
-            updated.status.phase = "Running"
-            now = time.perf_counter()
-            with pend_lock:
-                for key in group_keys.get(g, ()):
-                    pending.setdefault(key, now)
-            store.update_pod(updated)
-            n_events += 1
-        t_fired = time.perf_counter() - t_start
+        n_events, t_fired = _drive_pod_churn(
+            store, group_keys, pending, pend_lock, rng, duration, pace_hz
+        )
+        t_start = time.perf_counter() - t_fired
         # drain: wait for both workqueues to empty and writes to land
         for ctr in (plugin.throttle_ctr, plugin.cluster_throttle_ctr):
             while len(ctr.workqueue) > 0:
@@ -919,6 +942,102 @@ def bench_served_streaming(
         f"{t_fired:.2f}s); event->status-commit lag p50 "
         f"{result['lag_p50_ms']:.1f}ms / p99 {result['lag_p99_ms']:.1f}ms "
         f"over {len(lags)} status writes (target: 1k events/sec)"
+    )
+    return result
+
+
+def bench_remote_pipeline(label, P=2000, T=200, groups=100, duration=6.0, pace_hz=500.0):
+    """cfg5 through the WIRE: pod churn lands on a (mock) apiserver, flows
+    over real HTTP list+watch into the reflector-fed local cache, the
+    controllers reconcile, and the status PUTs land back on the remote
+    status subresource — the full remote-mode daemon loop
+    (plugin.go:71-130 + throttle_controller.go:170 UpdateStatus). Lag is
+    measured remote-commit to remote-commit: from the pod event at the
+    apiserver to the throttle status write arriving back there. Rate
+    limiting is disabled (qps=None) so this measures pipeline capacity,
+    not the token bucket (the reference's client-go default of 50 QPS
+    would bind ~50 writes/s)."""
+    import random
+    from dataclasses import replace as _replace
+
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.client.mockserver import MockApiServer
+    from kube_throttler_tpu.client.transport import RemoteSession, RestConfig
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    rng = random.Random(0)
+    server = MockApiServer(bookmark_interval=1.0)
+    remote = server.store
+    remote.create_namespace(Namespace("default"))
+    for i in range(T):
+        remote.create_throttle(_served_throttle(i, groups))
+    for i in range(P):
+        pod = make_pod(
+            f"p{i}",
+            labels={"grp": f"g{rng.randrange(groups)}"},
+            requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+        )
+        pod = _replace(pod, spec=_replace(pod.spec, node_name="node-1"))
+        pod.status.phase = "Running"
+        remote.create_pod(pod)
+    server.start()
+
+    local = Store()
+    session = RemoteSession(RestConfig(server=server.url), local, qps=None)
+    plugin = None
+    # lag is remote-commit→remote-commit: the tracker watches the REMOTE
+    # store's Throttle MODIFIEDs (the arriving status PUTs)
+    pending, pend_lock, lags, on_remote_status = _lag_tracker()
+    group_keys = _group_keys_of(remote)
+    try:
+        session.start(sync_timeout=30)
+        plugin = KubeThrottler(
+            decode_plugin_args(
+                {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+            ),
+            local,
+            use_device=True,
+            start_workers=True,
+            status_writer=session.status_writer,
+        )
+        # initial statuses converge before the measured window (every group
+        # has pods, so every throttle ends with a materialized used count)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(
+                t.status.used.resource_counts is not None
+                for t in remote.list_throttles()
+            ):
+                break
+            time.sleep(0.25)
+        remote.add_event_handler("Throttle", on_remote_status, replay=False)
+        n_events, t_fired = _drive_pod_churn(
+            remote, group_keys, pending, pend_lock, rng, duration, pace_hz
+        )
+        # drain tail: give in-flight writes a bounded window to land
+        time.sleep(min(3.0, duration / 2))
+    finally:
+        if plugin is not None:
+            plugin.stop()
+        session.stop()
+        server.stop()
+
+    # [0.0] sentinel when nothing landed (status_writes=0 disambiguates):
+    # NaN would propagate into the one-line report and break strict JSON
+    lag_arr = np.asarray(lags) if lags else np.asarray([0.0])
+    result = {
+        "events_per_sec": n_events / t_fired,  # rate during the fire window
+        "lag_p50_ms": float(np.percentile(lag_arr, 50)) * 1e3,
+        "lag_p99_ms": float(np.percentile(lag_arr, 99)) * 1e3,
+        "status_writes": len(lags),
+    }
+    log(
+        f"[{label}] cfg5 REMOTE WIRE ({P} pods x {T} throttles, paced "
+        f"{pace_hz:,.0f}/s): {n_events} events -> {result['events_per_sec']:,.0f}/s; "
+        f"remote-commit lag p50 {result['lag_p50_ms']:.1f}ms / p99 "
+        f"{result['lag_p99_ms']:.1f}ms over {len(lags)} status PUTs "
+        "(watch -> reflector -> reconcile -> HTTP status subresource)"
     )
     return result
 
@@ -1199,6 +1318,14 @@ def main():
             if s25:
                 detail["cfg5_2500hz_events_per_sec"] = round(s25["events_per_sec"])
                 detail["cfg5_2500hz_lag_p99_ms"] = round(s25["lag_p99_ms"], 2)
+            # the REMOTE wire loop (watch → reflector → reconcile → HTTP
+            # status PUT), small fixed scale — wire overhead dominates and
+            # the number answers "does remote mode keep up", not "how big"
+            rw = safe("served:remote-wire", bench_remote_pipeline, "served")
+            if rw:
+                detail["cfg5_remote_events_per_sec"] = round(rw["events_per_sec"])
+                detail["cfg5_remote_lag_p50_ms"] = round(rw["lag_p50_ms"], 2)
+                detail["cfg5_remote_lag_p99_ms"] = round(rw["lag_p99_ms"], 2)
             # steady-state status-write lag at the BASELINE 1k/s target load
             s2 = safe(
                 "served:streaming-paced",

@@ -150,6 +150,11 @@ class MockApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # response headers and body go out as separate sends; with
+            # Nagle on, keep-alive clients wait out a ~40ms delayed ACK
+            # per request (measured) — the real apiserver serves with
+            # TCP_NODELAY too (Go net/http default)
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # quiet
                 pass

@@ -41,7 +41,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from http.client import HTTPConnection, HTTPSConnection
+from http.client import HTTPConnection, HTTPException, HTTPSConnection
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
 
@@ -316,6 +316,7 @@ class ApiClient:
         self._ssl_ctx = None
         self._ssl_ctx_stamp = None
         self._token_cache: Optional[Tuple[int, str]] = None
+        self._conn_local = threading.local()  # keep-alive conn per thread
         if self._scheme == "https":
             self._ssl_ctx = self._build_ssl_ctx()
 
@@ -347,10 +348,27 @@ class ApiClient:
         if self._scheme == "https":
             if self._ssl_ctx_stamp != self._cred_stamp():
                 self._ssl_ctx = self._build_ssl_ctx()  # credentials rotated
-            return HTTPSConnection(
+            conn = HTTPSConnection(
                 self._host, self._port, timeout=timeout, context=self._ssl_ctx
             )
-        return HTTPConnection(self._host, self._port, timeout=timeout)
+        else:
+            conn = HTTPConnection(self._host, self._port, timeout=timeout)
+        # http.client writes headers and body as separate sends; with Nagle
+        # on, the body segment waits out the peer's delayed ACK (~40ms per
+        # request on a reused keep-alive connection — measured). client-go
+        # disables Nagle the same way (net/http DisableKeepAlives=false +
+        # TCP_NODELAY default in Go's net.TCPConn).
+        conn.connect()
+        try:
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except (OSError, AttributeError):
+            pass  # non-TCP transports (tests with mocks) have no sockopt
+        # the credential stamp THIS connection handshaked under: rotation
+        # checks must be per-connection, not against the shared context
+        # stamp (another thread's reconnect refreshes that, which would
+        # let a stale-credential connection pass the check forever)
+        conn._kt_cred_stamp = self._cred_stamp()
+        return conn
 
     def _headers(self) -> Dict[str, str]:
         headers = {"Accept": "application/json"}
@@ -380,27 +398,58 @@ class ApiClient:
     def _request(
         self, method: str, path: str, body: Optional[Dict[str, Any]] = None
     ) -> Dict[str, Any]:
-        conn = self._connect(self.timeout)
+        """One REST round trip over a per-thread KEEP-ALIVE connection.
+
+        client-go multiplexes everything over reused connections; opening a
+        fresh TCP (+TLS) connection per status PUT dominated the remote
+        write path. The cached connection is retried ONCE on a fresh one
+        when it fails — a reused keep-alive socket the server closed
+        between requests is indistinguishable from a network error, and
+        the single retry is the standard stale-socket pattern. Credential
+        rotation invalidates the cache (the SSL context is stamped)."""
+        headers = self._headers()
+        payload = None
+        if body is not None:
+            payload = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        cached = getattr(self._conn_local, "conn", None)
+        if cached is not None and self._scheme == "https":
+            if getattr(cached, "_kt_cred_stamp", None) != self._cred_stamp():
+                cached.close()
+                cached = None  # rotated credentials: next connect rebuilds
+        conn, reused = cached, cached is not None
         try:
-            headers = self._headers()
-            payload = None
-            if body is not None:
-                payload = json.dumps(body).encode()
-                headers["Content-Type"] = "application/json"
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status == 409:
-                raise ConflictError(path)
-            if resp.status == 404:
-                raise NotFoundError(path)
-            if resp.status == 410:
-                raise GoneError(data.decode(errors="replace")[:200])
-            if resp.status >= 400:
-                raise ApiError(resp.status, data.decode(errors="replace")[:200])
-            return json.loads(data) if data else {}
-        finally:
-            conn.close()
+            while True:
+                if conn is None:
+                    conn = self._connect(self.timeout)
+                    reused = False
+                try:
+                    conn.request(method, path, body=payload, headers=headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    break
+                except (HTTPException, OSError, ssl.SSLError):
+                    conn.close()
+                    conn = None
+                    if not reused:
+                        raise  # a fresh connection failing is a real error
+            if resp.will_close:
+                conn.close()
+                self._conn_local.conn = None
+            else:
+                self._conn_local.conn = conn
+        except BaseException:
+            self._conn_local.conn = None
+            raise
+        if resp.status == 409:
+            raise ConflictError(path)
+        if resp.status == 404:
+            raise NotFoundError(path)
+        if resp.status == 410:
+            raise GoneError(data.decode(errors="replace")[:200])
+        if resp.status >= 400:
+            raise ApiError(resp.status, data.decode(errors="replace")[:200])
+        return json.loads(data) if data else {}
 
     # -- verbs -------------------------------------------------------------
 
