@@ -428,9 +428,15 @@ class ApiClient:
                     resp = conn.getresponse()
                     data = resp.read()
                     break
-                except (HTTPException, OSError, ssl.SSLError):
+                except (HTTPException, OSError, ssl.SSLError) as e:
                     conn.close()
                     conn = None
+                    if isinstance(e, (socket.timeout, TimeoutError)):
+                        # a timeout is NOT a stale keep-alive socket: the
+                        # server may still be processing the (possibly
+                        # non-idempotent) request — re-sending could
+                        # double-apply it and blocks up to 2× timeout
+                        raise
                     if not reused:
                         raise  # a fresh connection failing is a real error
             if resp.will_close:

@@ -1,0 +1,19 @@
+"""Regression guard for the padded realistic-shape parallel path: the
+driver's dryrun exercises P=8192×T=512; this in-tree version runs the same
+assertions (no mid-run recompile, 2D == ring == 1-device dense oracle,
+non-degenerate verdicts) at a CI-sized shape so a regression is caught by
+`pytest` and not only at round end."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_realistic_shape_parallel_agreement():
+    import __graft_entry__ as ge
+
+    # no-op under pytest (conftest already forces the 8-device CPU mesh),
+    # but keeps the test runnable standalone on hosts with fewer devices
+    ge._force_device_count(8)
+    ge._dryrun_realistic(8, P=1024, T=128)
