@@ -128,6 +128,22 @@ def main():
     out = rebase_cols(used_cnt, used_req, contrib, batch, mask, counted, cols_pad)
     jax.block_until_ready(out)
     print("rebase_cols ok")
+    from kube_throttler_tpu.ops.aggregate import aggregate_cols
+
+    out = aggregate_cols(batch, mask, counted, cols_pad)
+    jax.block_until_ready(out)
+    print("aggregate_cols ok")
+
+    # the sparse [P,K] gather check — the production batch-triage kernel
+    from kube_throttler_tpu.ops.check import check_pods_gather
+
+    gcols = np.full((mask.shape[0], 4), -1, dtype=np.int32)
+    for i in range(mask.shape[0]):
+        nz = np.nonzero(mask[i])[0][:4]
+        gcols[i, : nz.size] = nz
+    counts_g, ok_g = check_pods_gather(state, batch, gcols)
+    jax.block_until_ready((counts_g, ok_g))
+    print("check_pods_gather ok")
 
     # the Pallas mosaic sweep (TPU backends only): block-padded shapes,
     # precomputed residual form, compared against check_pods on the same
