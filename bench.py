@@ -801,6 +801,23 @@ def bench_served_batch(plugin, label, iters=5):
     return {"pods": n, "secs": dt, "pods_per_sec": pods_per_sec}
 
 
+def bench_served_tick(plugin, label):
+    """The fused reconcile+PreFilter sweep (`plugin.full_tick_sharded`, the
+    POST /v1/tick surface) on one device: override-resolved thresholds,
+    used re-aggregation, throttled flags, and the full [P,T] admission
+    classification for BOTH kinds from one coherent snapshot. The
+    freshest-possible whole-cluster verdict in a single device program."""
+    plugin.full_tick_sharded(1)  # warm/compile
+    t0 = time.perf_counter()
+    out = plugin.full_tick_sharded(1)
+    dt = time.perf_counter() - t0
+    log(
+        f"[{label}] SERVED full tick (1 device): {len(out['schedulable'])} pods "
+        f"x both kinds, fused reconcile+classify in {dt*1e3:.0f}ms"
+    )
+    return dt
+
+
 def _lag_tracker():
     """(pending, lock, lags, handler): handler pops a key's oldest pending
     timestamp on its MODIFIED event and records the lag sample."""
@@ -1287,6 +1304,9 @@ def main():
             if b:
                 detail["served_batch_pods_per_sec"] = round(b["pods_per_sec"])
                 detail["served_batch_ms"] = round(b["secs"] * 1e3, 2)
+            tick = safe("served:tick", bench_served_tick, plugin_s, "served")
+            if tick:
+                detail["served_tick_ms"] = round(tick * 1e3)
             s = safe(
                 "served:streaming",
                 bench_served_streaming,
