@@ -848,6 +848,10 @@ class DeviceStateManager:
         self._device_down_until = 0.0
         self._monotonic = None  # test injection point; defaults to time.monotonic
         self.fallback_counter = None  # CounterVec set by the plugin
+        # {kind: ReservedResourceAmounts} wired by the plugin once the
+        # controllers exist: lets _on_any_throttle replay standing
+        # reservations onto freshly allocated columns (see there)
+        self.reservation_sources: Dict[str, ReservedResourceAmounts] = {}
         # per-pod-object request-encode memo (see check_pod), keyed by
         # id(pod) because Pod is unhashable (dict fields); a weakref
         # finalizer evicts the entry when the pod is collected, and lookups
@@ -1075,9 +1079,26 @@ class DeviceStateManager:
                 and event.old_obj.spec.selector == thr.spec.selector
                 and ks.index.throttle_col(thr.key) is not None
             )
+            fresh_col = ks.index.throttle_col(thr.key) is None
             col = ks.set_throttle_row(
                 thr, selector_changed=selector_changed, old=event.old_obj
             )
+            if fresh_col:
+                # reservations OUTLIVE the throttle object (the reference's
+                # cache is keyed by name and never cleared on deletion —
+                # reserved_resource_amounts.go has no delete hook), but a
+                # re-created throttle — or a throttlerName handover back,
+                # which arrives as MODIFIED — gets a FRESH zeroed column
+                # here. Replay the standing reserved amount or the device
+                # check under-counts reserved until the next
+                # reserve/unreserve touches the key (differential soak
+                # seed 20: device said not-throttled where the host oracle
+                # said insufficient). Only on fresh columns, so status
+                # echoes pay nothing.
+                cache = self.reservation_sources.get(ks.kind)
+                if cache is not None:
+                    amount, _ = cache.reserved_resource_amount(thr.key)
+                    ks.set_reserved_row(thr.key, amount)
             if selector_changed:
                 ks.mark_col_rebase(col)
                 ks.refresh_mask()

@@ -117,6 +117,12 @@ class KubeThrottler:
                 "(decisions/reconciles served host-side meanwhile)",
                 ["surface"],
             )
+            # reservation replay onto freshly allocated device columns
+            # (throttle re-creation / throttlerName handover) reads these
+            self.device_manager.reservation_sources = {
+                "throttle": self.throttle_ctr.cache,
+                "clusterthrottle": self.cluster_throttle_ctr.cache,
+            }
         self.throttle_ctr.tracer = self.tracer
         self.cluster_throttle_ctr.tracer = self.tracer
         if start_workers:

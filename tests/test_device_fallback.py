@@ -285,3 +285,70 @@ class TestReconcileFallback:
             "kube_throttler_device_fallback_total", "", ["surface"]
         )
         assert counter.collect()[("reconcile",)] >= 1.0
+
+
+def test_reservation_survives_throttle_recreation_on_device():
+    """Reservations outlive the throttle object (the reference cache is
+    keyed by name and never cleared on deletion): after delete + re-create,
+    the device mirror's reserved row must be replayed from the cache, or
+    the device check under-counts until the next reserve/unreserve (found
+    by differential soak seed 20)."""
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ResourceAmount,
+        Throttle,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+        ThrottleSpec,
+    )
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    def throttle():
+        return Throttle(
+            name="t1",
+            spec=ThrottleSpec(
+                throttler_name="kube-throttler",
+                threshold=ResourceAmount.of(pod=2),
+                selector=ThrottleSelector(
+                    selector_terms=(
+                        ThrottleSelectorTerm(LabelSelector(match_labels={"g": "a"})),
+                    )
+                ),
+            ),
+        )
+
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    plugin = KubeThrottler(
+        decode_plugin_args(
+            {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+        ),
+        store,
+        use_device=True,
+        start_workers=False,
+    )
+    store.create_throttle(throttle())
+    plugin.run_pending_once()
+
+    # two reservations fill the pod=2 threshold
+    for name in ("r1", "r2"):
+        assert plugin.reserve(make_pod(name, labels={"g": "a"})).is_success()
+
+    probe = make_pod("probe", labels={"g": "a"})
+    assert not plugin.pre_filter(probe).is_success()  # 2 reserved + 1 > 2
+
+    # delete + re-create the throttle: reservations must still bind
+    store.delete_throttle("default", "t1")
+    store.create_throttle(throttle())
+    plugin.run_pending_once()
+
+    # 2 reserved ≥ pod=2 with the Throttle kind's hardcoded step-3
+    # onEqual=True → active (throttle_types.go:143)
+    verdict = plugin.pre_filter(probe)
+    assert not verdict.is_success(), verdict.reasons
+    assert "throttle[active]=default/t1" in verdict.reasons
+    # host oracle agrees cell-for-cell
+    active, insufficient, _, _ = plugin.throttle_ctr.check_throttled(probe, False)
+    assert active and not insufficient

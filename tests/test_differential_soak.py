@@ -128,7 +128,9 @@ def _status_dict(thr):
     }
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3])
+#  seed 20 found the reservation-outlives-throttle-recreation divergence
+#  (device under-counted reserved after delete+recreate) — keep it pinned
+@pytest.mark.parametrize("seed", [1, 2, 3, 20])
 def test_device_and_host_stacks_agree_under_random_churn(seed):
     rng = random.Random(seed)
     (store_d, plug_d, clock_d), (store_h, plug_h, clock_h) = _stack(True), _stack(False)
