@@ -13,6 +13,7 @@ overrides straddling the fake clock, reservations, deletes).
 
 from __future__ import annotations
 
+import os
 import random
 from dataclasses import replace
 from datetime import datetime, timedelta, timezone
@@ -299,3 +300,17 @@ def test_device_and_host_stacks_agree_under_random_churn(seed):
             checkpoint()
 
     checkpoint()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("KT_SOAK_SEEDS"),
+    reason="set KT_SOAK_SEEDS=lo:hi for the wide randomized soak",
+)
+def test_wide_soak_seed_range():
+    """Opt-in wide soak (KT_SOAK_SEEDS=4:200 validated this round; seed 20
+    found the reservation-outlives-recreation divergence). Each seed is an
+    independent 120-step churn differential between the device and host
+    stacks."""
+    lo, hi = (int(x) for x in os.environ["KT_SOAK_SEEDS"].split(":"))
+    for seed in range(lo, hi):
+        test_device_and_host_stacks_agree_under_random_churn(seed)
