@@ -131,6 +131,12 @@ class _KindState:
         self._cols_host: Optional[np.ndarray] = None
         self._device_cols = None
         self._cols_K = 0
+        # column/namespace invalidation pending a cols rebuild (the device
+        # mask itself rebuilds lazily; see device_pods)
+        self._cols_stale = False
+        # pod rows whose device-mask rows lag the host mask (applied when a
+        # mask consumer next asks for it)
+        self._mask_dirty_rows: set = set()
         # rows/cols touched by single-object events since the last device
         # sync — applied as device-side scatters instead of a full re-upload
         self._dirty_pod_rows: set = set()
@@ -500,7 +506,17 @@ class _KindState:
             self._device_packed = pack_check_state(precompute_check_state(state))
         return self._device_packed
 
-    def device_pods(self) -> Tuple[PodBatch, jnp.ndarray]:
+    def device_pods(self, need_mask: bool = True) -> Tuple[PodBatch, Optional[jnp.ndarray]]:
+        """Device pod arrays + (optionally) the [P,T] device mask.
+
+        The mask is maintained LAZILY with its own dirty-row set: the
+        sparse-gather batch path never reads it, so a triage call must not
+        pay the full [P,T] re-upload a throttle/namespace invalidation
+        queued up (2.1 GB at 100k×10k — per batch call, through a TPU
+        tunnel, for a tensor the kernel ignores). Pass ``need_mask=False``
+        to skip it; consumers that DO read it (aggregate rebases, the
+        dense fallback, the sharded tick, prewarm) get it refreshed on
+        demand. Returns mask ``None`` when skipped."""
         self.ensure_capacity()
         if (
             self.dirty_pods
@@ -512,36 +528,55 @@ class _KindState:
                 req=jnp.asarray(self.pod_req),
                 req_present=jnp.asarray(self.pod_present),
             )
-            self._device_mask = jnp.asarray(self.index.mask)
             self._rebuild_cols()
+            self._cols_stale = False
             self.dirty_pods = False
             self._dirty_pod_rows.clear()
-            return self._device_pods, self._device_mask
-
-        mask_rebuilt = False
-        if self._device_mask is None or self._device_mask.shape != self.index.mask.shape:
-            # throttle/namespace event invalidated the whole mask; the live
-            # numpy mask already includes any pending row changes
+            self._device_mask = None  # rebuilt from the live numpy on demand
+            self._mask_dirty_rows.clear()
+        else:
+            cols_rebuilt = False
+            if self._cols_stale:
+                # throttle/namespace event invalidated columns: the [P,K]
+                # cols derive from the HOST mask, so rebuild them now (the
+                # device mask itself can wait for a consumer)
+                self._rebuild_cols()
+                self._cols_stale = False
+                cols_rebuilt = True  # already includes any dirty rows
+            if self._dirty_pod_rows:
+                # single-pod events: ship only the touched rows (device-side
+                # scatter instead of a full [P,R] host→device transfer);
+                # pow2-padded like the throttle-col scatter (compile
+                # stability). The mask rows are deferred into
+                # _mask_dirty_rows until a mask consumer shows up.
+                rows = _pad_pow2(np.fromiter(self._dirty_pod_rows, dtype=np.int64))
+                self._device_pods = PodBatch(
+                    valid=self._device_pods.valid.at[rows].set(self.pod_valid[rows]),
+                    req=self._device_pods.req.at[rows].set(self.pod_req[rows]),
+                    req_present=self._device_pods.req_present.at[rows].set(
+                        self.pod_present[rows]
+                    ),
+                )
+                if not cols_rebuilt:  # the full rebuild read the live mask
+                    self._update_cols_rows(rows)
+                self._mask_dirty_rows.update(self._dirty_pod_rows)
+                self._dirty_pod_rows.clear()
+        if not need_mask:
+            return self._device_pods, None
+        if (
+            self._device_mask is None
+            or self._device_mask.shape != self.index.mask.shape
+            or len(self._mask_dirty_rows) > self.row_scatter_max
+        ):
+            # the live numpy mask already includes every pending row change
             self._device_mask = jnp.asarray(self.index.mask)
-            self._rebuild_cols()
-            mask_rebuilt = True
-
-        if self._dirty_pod_rows:
-            # single-pod events: ship only the touched rows (device-side
-            # scatter instead of a full [P,R]/[P,T] host→device transfer);
-            # pow2-padded like the throttle-col scatter (compile stability)
-            rows = _pad_pow2(np.fromiter(self._dirty_pod_rows, dtype=np.int64))
-            self._device_pods = PodBatch(
-                valid=self._device_pods.valid.at[rows].set(self.pod_valid[rows]),
-                req=self._device_pods.req.at[rows].set(self.pod_req[rows]),
-                req_present=self._device_pods.req_present.at[rows].set(
-                    self.pod_present[rows]
-                ),
+            self._mask_dirty_rows.clear()
+        elif self._mask_dirty_rows:
+            rows = _pad_pow2(np.fromiter(self._mask_dirty_rows, dtype=np.int64))
+            self._device_mask = self._device_mask.at[rows].set(
+                self.index.mask[rows, :]
             )
-            if not mask_rebuilt:
-                self._device_mask = self._device_mask.at[rows].set(self.index.mask[rows, :])
-                self._update_cols_rows(rows)
-            self._dirty_pod_rows.clear()
+            self._mask_dirty_rows.clear()
         return self._device_pods, self._device_mask
 
     def device_cols(self):
@@ -596,6 +631,8 @@ class _KindState:
 
     def refresh_mask(self) -> None:
         self._device_mask = None
+        self._mask_dirty_rows.clear()  # subsumed by the full rebuild
+        self._cols_stale = True  # [P,K] cols derive from the (host) mask
 
     # -- live used-aggregation (the reconcile data plane) ------------------
 
@@ -1373,8 +1410,12 @@ class DeviceStateManager:
         companion of the mask (None ⇒ dense kernel)."""
         ks = self.throttle if kind == "throttle" else self.clusterthrottle
         state = ks.device_state()
-        pods, mask = ks.device_pods()
+        # the gather path never reads the [P,T] device mask — skip its
+        # refresh; only the dense fallback (cols None) pays for it
+        pods, mask = ks.device_pods(need_mask=False)
         cols = ks.device_cols()
+        if cols is None:
+            pods, mask = ks.device_pods(need_mask=True)
         step3 = True if kind == "throttle" else on_equal
         return state, pods, mask, cols, step3, dict(ks.index._pod_rows)
 
