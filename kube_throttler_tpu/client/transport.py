@@ -437,6 +437,14 @@ class ApiClient:
                         # non-idempotent) request — re-sending could
                         # double-apply it and blocks up to 2× timeout
                         raise
+                    if method not in ("GET", "PUT", "DELETE", "HEAD"):
+                        # non-idempotent (POST: event create, lease acquire):
+                        # a reset AFTER the server processed the request is
+                        # indistinguishable from a stale socket, and a
+                        # resend double-applies. Same policy as Go net/http,
+                        # which only retries idempotent methods (or when no
+                        # request bytes were written).
+                        raise
                     if not reused:
                         raise  # a fresh connection failing is a real error
             if resp.will_close:

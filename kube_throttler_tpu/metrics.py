@@ -54,6 +54,8 @@ class GaugeVec:
             return self._values.get(key)
 
     def collect(self) -> Dict[Tuple[str, ...], float]:
+        """Raw values snapshot. Families fed by deferred recorders are
+        stale until ``Registry.flush()`` (or ``exposition()``) runs."""
         with self._lock:
             return dict(self._values)
 
@@ -134,6 +136,19 @@ class Registry:
         with self._lock:
             self._pre_expose.append(fn)
 
+    def flush(self) -> None:
+        """Run the deferred recorders' flush hooks without rendering.
+
+        Gauge families fed by deferred recorders (ThrottleMetricsRecorder
+        et al. buffer per-label snapshots and flush at scrape) are only
+        current after a flush: a consumer reading ``GaugeVec.collect()``
+        directly — tests, in-process introspection — must call this first
+        (``exposition()`` does it implicitly)."""
+        with self._lock:
+            hooks = list(self._pre_expose)
+        for fn in hooks:
+            fn()
+
     def gauge_vec(self, name: str, help_text: str, label_names: Sequence[str]) -> GaugeVec:
         with self._lock:
             if name in self._gauges:
@@ -165,11 +180,8 @@ class Registry:
             return h
 
     def exposition(self) -> str:
-        """Prometheus text format."""
-        with self._lock:
-            hooks = list(self._pre_expose)
-        for fn in hooks:
-            fn()
+        """Prometheus text format (flushes deferred recorders first)."""
+        self.flush()
 
         def esc(v: str) -> str:
             # label-value escaping per the exposition format: \ " and newline

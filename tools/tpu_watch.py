@@ -1,0 +1,129 @@
+"""Persistent TPU-availability watcher: capture the bench the moment the tunnel is up.
+
+The tunnel to the real TPU chip has been down for entire working sessions
+(rounds 2-4 each ended with a degraded CPU-only BENCH). This watcher runs
+from session start: it probes the backend in a throwaway subprocess every
+PROBE_INTERVAL seconds and, the FIRST time the probe succeeds, immediately
+runs the full benchmark on the live backend and writes the resulting JSON
+line to BENCH_TPU_<utcstamp>.json (plus BENCH_TPU_LATEST.json). One
+successful capture ends the watch; a deadline (default 11h) bounds it.
+
+While the bench is running it holds a marker file (/tmp/tpu_bench_running)
+so interactive measurement work on this 1-core host knows not to trust
+concurrent timings.
+
+Usage: python tools/tpu_watch.py [--deadline-s N] [--interval-s N] [--quick]
+Exit code: 0 = TPU bench captured, 1 = deadline expired with no backend.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MARKER = "/tmp/tpu_bench_running"
+
+
+def log(msg: str) -> None:
+    ts = datetime.now(timezone.utc).strftime("%H:%M:%S")
+    print(f"[tpu-watch {ts}] {msg}", flush=True)
+
+
+def probe_once(timeout: float = 60.0) -> bool:
+    """True when a throwaway subprocess can init the ambient (TPU) backend
+    AND it is not just the CPU fallback platform."""
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r})\n"
+        "from kube_throttler_tpu.utils.platform import honor_jax_platforms_env\n"
+        "honor_jax_platforms_env()\n"
+        "import jax\n"
+        "d = jax.devices()\n"
+        "assert d and d[0].platform != 'cpu', f'cpu-only: {d}'\n"
+        "print(d[0].platform)\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return False
+    if r.returncode == 0:
+        log(f"probe OK: platform={r.stdout.decode().strip()}")
+        return True
+    return False
+
+
+def run_bench(quick: bool) -> int:
+    stamp = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    out_path = os.path.join(REPO, f"BENCH_TPU_{stamp}.json")
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+    if quick:
+        cmd.append("--quick")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # Full-scale on TPU should fit well inside this; the bench's own
+    # watchdog emits best-so-far JSON if a config wedges.
+    env.setdefault("KT_BENCH_DEADLINE_S", "3600")
+    log(f"backend is up — running bench -> {out_path}")
+    open(MARKER, "w").write(stamp)
+    try:
+        with open(out_path + ".log", "w") as logf:
+            r = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=logf, timeout=4200, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log("bench subprocess timed out (4200s)")
+        return 1
+    finally:
+        try:
+            os.unlink(MARKER)
+        except OSError:
+            pass
+    # bench.py prints exactly one JSON line on stdout (watchdog or main
+    # path); validate before declaring the one-shot watch done — a stray
+    # warning/traceback line must not end an 11h watch with garbage
+    lines = r.stdout.decode(errors="replace").strip().splitlines()
+    payload = None
+    for cand in reversed(lines):
+        try:
+            json.loads(cand)
+            payload = cand
+            break
+        except ValueError:
+            continue
+    if payload is None:
+        log(f"bench produced no JSON line (rc={r.returncode}); see {out_path}.log")
+        return 1
+    with open(out_path, "w") as f:
+        f.write(payload + "\n")
+    with open(os.path.join(REPO, "BENCH_TPU_LATEST.json"), "w") as f:
+        f.write(payload + "\n")
+    log(f"captured: {payload[:300]}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-s", type=float, default=11 * 3600)
+    ap.add_argument("--interval-s", type=float, default=180.0)
+    ap.add_argument("--quick", action="store_true", help="run bench --quick instead of full scale")
+    args = ap.parse_args()
+
+    deadline = time.monotonic() + args.deadline_s
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        if probe_once():
+            if run_bench(args.quick) == 0:
+                log("TPU bench captured; watcher done")
+                return 0
+            log("bench failed despite live probe; will re-probe")
+        elif attempt % 10 == 1:
+            log(f"probe {attempt}: backend down")
+        time.sleep(args.interval_s)
+    log("deadline expired; backend never came up")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
