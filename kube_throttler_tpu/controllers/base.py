@@ -90,6 +90,9 @@ class ControllerBase:
     def enqueue(self, key: str) -> None:
         self.workqueue.add(key)
 
+    def enqueue_all(self, keys) -> None:
+        self.workqueue.add_all(keys)
+
     def enqueue_after(self, key: str, duration: timedelta) -> None:
         self.workqueue.add_after(key, duration)
 

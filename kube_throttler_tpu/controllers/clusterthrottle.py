@@ -414,11 +414,21 @@ class ClusterThrottleController(ControllerBase):
             pod = event.obj
             if not self.should_count_in(pod):
                 return
-            for key in self._affected_keys_or_log(pod):
-                self.enqueue(key)
+            self.enqueue_all(self._affected_keys_or_log(pod))
         elif event.type == EventType.MODIFIED:
             old_pod, new_pod = event.old_obj, event.obj
             if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
+                return
+            if (
+                old_pod is not None
+                and old_pod.labels == new_pod.labels
+                and old_pod.namespace == new_pod.namespace
+            ):
+                # selector matching reads only labels + namespace, so the
+                # affected set cannot have moved — one lookup, no move
+                # bookkeeping (the dominant churn shape: requests/status
+                # updates at full scale)
+                self.enqueue_all(self._affected_keys_or_log(new_pod))
                 return
             try:
                 old_keys = set(self.affected_cluster_throttle_keys(old_pod))
@@ -433,8 +443,7 @@ class ClusterThrottleController(ControllerBase):
                 if self.device_manager is not None:
                     for key in moved_from | moved_to:
                         self.device_manager.on_reservation_change(self.KIND, key, self.cache)
-            for key in old_keys | new_keys:
-                self.enqueue(key)
+            self.enqueue_all(old_keys | new_keys)
         else:  # DELETED
             pod = event.obj
             if not self.should_count_in(pod):
@@ -444,8 +453,7 @@ class ClusterThrottleController(ControllerBase):
                     self.unreserve(pod)
                 except Exception:
                     logger.exception("failed to unreserve deleted pod %s", pod.key)
-            for key in self._affected_keys_or_log(pod):
-                self.enqueue(key)
+            self.enqueue_all(self._affected_keys_or_log(pod))
 
     def _affected_keys_or_log(self, pod: Pod) -> List[str]:
         try:

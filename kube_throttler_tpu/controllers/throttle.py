@@ -353,11 +353,21 @@ class ThrottleController(ControllerBase):
             pod = event.obj
             if not self.should_count_in(pod):
                 return
-            for key in self.affected_throttle_keys(pod):
-                self.enqueue(key)
+            self.enqueue_all(self.affected_throttle_keys(pod))
         elif event.type == EventType.MODIFIED:
             old_pod, new_pod = event.old_obj, event.obj
             if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
+                return
+            if (
+                old_pod is not None
+                and old_pod.labels == new_pod.labels
+                and old_pod.namespace == new_pod.namespace
+            ):
+                # selector matching reads only labels + namespace, so the
+                # affected set cannot have moved — one lookup, no move
+                # bookkeeping (the dominant churn shape: requests/status
+                # updates at full scale)
+                self.enqueue_all(self.affected_throttle_keys(new_pod))
                 return
             old_keys = set(self.affected_throttle_keys(old_pod))
             new_keys = set(self.affected_throttle_keys(new_pod))
@@ -370,8 +380,7 @@ class ThrottleController(ControllerBase):
                 if self.device_manager is not None:
                     for key in moved_from | moved_to:
                         self.device_manager.on_reservation_change(self.KIND, key, self.cache)
-            for key in old_keys | new_keys:
-                self.enqueue(key)
+            self.enqueue_all(old_keys | new_keys)
         else:  # DELETED
             pod = event.obj
             if not self.should_count_in(pod):
@@ -383,5 +392,4 @@ class ThrottleController(ControllerBase):
                     self.unreserve(pod)
                 except Exception:
                     logger.exception("failed to unreserve deleted pod %s", pod.key)
-            for key in self.affected_throttle_keys(pod):
-                self.enqueue(key)
+            self.enqueue_all(self.affected_throttle_keys(pod))

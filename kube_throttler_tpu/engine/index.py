@@ -134,6 +134,10 @@ class SelectorIndex:
         # throttles
         self._thr_cols: Dict[str, int] = {}
         self._col_thrs: Dict[int, AnyThrottle] = {}
+        # col -> key mirror of _col_thrs: affected-throttle lookups are the
+        # per-event ingest hot path (20+ matched cols per pod at full
+        # scale), and thr.key re-derives the "ns/name" string per call
+        self._col_keys: Dict[int, str] = {}
         self._free_cols: List[int] = []
         self._tcap = throttle_capacity
         self._thr_valid = np.zeros(self._tcap, dtype=bool)
@@ -257,6 +261,7 @@ class SelectorIndex:
                         self._grow_throttles()
                 self._thr_cols[key] = col
             self._col_thrs[col] = thr
+            self._col_keys[col] = key
             self._thr_valid[col] = True
             self._row_prev = None  # compiled columns changed
             if self._native is not None:
@@ -295,6 +300,7 @@ class SelectorIndex:
             if col is None:
                 return
             self._col_thrs.pop(col, None)
+            self._col_keys.pop(col, None)
             self._thr_valid[col] = False
             self._row_prev = None  # compiled columns changed
             self.mask[:, col] = False
@@ -581,11 +587,14 @@ class SelectorIndex:
         O(K) via the col→object map — an inverted {col: key} dict built
         per call would be O(T) and dominated full-scale event ingest."""
         with self._lock:
+            if not self._col_thrs:
+                return []
             row = self._pod_rows.get(pod_key)
             if row is None:
                 return []
             cols = np.nonzero(self.mask[row, : self._tcap])[0]
-            return [self._col_thrs[int(c)].key for c in cols if int(c) in self._col_thrs]
+            ck = self._col_keys
+            return [ck[c] for c in cols.tolist() if c in ck]
 
     def affected_throttle_keys_for(self, pod: Pod) -> List[str]:
         """affectedThrottles for an ARBITRARY pod object.
@@ -596,6 +605,8 @@ class SelectorIndex:
         row is evaluated fresh against every compiled column, without
         mutating the index."""
         with self._lock:
+            if not self._col_thrs:
+                return []
             row = self._pod_rows.get(pod.key)
             if row is not None and self._row_pods.get(row) is pod:
                 cols = np.nonzero(self.mask[row, : self._tcap])[0]
@@ -607,7 +618,8 @@ class SelectorIndex:
                     cols = np.nonzero(prev[2] & self._thr_valid[: prev[2].shape[0]])[0]
                 else:
                     cols = np.nonzero(self.match_row_cached(pod) & self._thr_valid)[0]
-            return [self._col_thrs[int(c)].key for c in cols if int(c) in self._col_thrs]
+            ck = self._col_keys
+            return [ck[c] for c in cols.tolist() if c in ck]
 
     def matched_pod_keys(self, throttle_key: str) -> List[str]:
         """Pod keys matching a throttle (affectedPods' selector part)."""
