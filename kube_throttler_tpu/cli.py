@@ -370,7 +370,9 @@ def main(argv: Optional[list] = None) -> int:
         ),
         use_device=not args.no_device,
         start_workers=True,
-        status_writer=session.status_writer if session is not None else None,
+        # the ASYNC committer: batch submit + per-key newest-wins coalescing
+        # + concurrent PUT workers (transport.AsyncStatusCommitter)
+        status_writer=session.status_committer if session is not None else None,
         metrics_registry=metrics_registry,
     )
     if plugin.device_manager is not None:

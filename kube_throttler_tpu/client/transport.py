@@ -911,6 +911,204 @@ class RemoteStatusWriter:
         self._put("ClusterThrottle", thr)
         return thr
 
+    def refresh_version(self, kind: str, obj) -> None:
+        """GET the live object and adopt its resourceVersion — the 409
+        recovery read (client-go's RetryOnConflict re-read)."""
+        if isinstance(obj, Throttle):
+            path = (
+                f"/apis/{GROUP}/{VERSION}/namespaces/{obj.namespace}"
+                f"/throttles/{obj.name}"
+            )
+        else:
+            path = f"/apis/{GROUP}/{VERSION}/clusterthrottles/{obj.name}"
+        doc = self.client.get(path)
+        rv = str((doc.get("metadata") or {}).get("resourceVersion", ""))
+        if rv:
+            self.versions.set(kind, key_of(kind, obj), rv)
+
+
+class AsyncStatusCommitter:
+    """Concurrent per-key-coalescing status commits for remote mode.
+
+    The reference PUTs each status synchronously inside its reconcile
+    (throttle_controller.go:157-173 UpdateStatus via the typed clientset,
+    throttle.go:152-167); over a real wire that serializes the whole drain
+    behind ~1ms HTTP round trips and caps the event pipeline at the
+    single-connection PUT rate. This committer decouples reconcile from
+    publication:
+
+    - ``submit`` stores the NEWEST planned object per key (newest-wins: a
+      hot throttle re-reconciled 10× between wire commits costs ONE PUT);
+    - N workers drain the key slots concurrently over their own keep-alive
+      connections (ApiClient is per-thread-connection already);
+    - PER-KEY ORDERING is structural, not locked: a key hashes to exactly
+      one worker shard, and a shard slot only ever holds the newest object,
+      so two PUTs for one key can neither race nor reorder;
+    - 409 conflicts re-read the live resourceVersion and retry (bounded);
+      transient transport errors retry with backoff; a key that exhausts
+      retries is dropped with a counter bump — the controller's resync
+      re-plans it (crash-only stance: the next reconcile regenerates any
+      dropped publication from local truth).
+
+    The daemon's serving truth (host aggregates + reservations) is local;
+    the PUT is publication. Reconcile therefore proceeds (unreserve-on-
+    observe, wakeups) as soon as the newest status is QUEUED — the local
+    aggregate snapshot the status was computed from is already coherent —
+    matching the batched local-store commit semantics rather than the
+    reference's write-then-continue."""
+
+    def __init__(self, writer: "RemoteStatusWriter", workers: int = 4,
+                 metrics_registry=None, max_retries: int = 4):
+        self._writer = writer
+        self._n = max(1, int(workers))
+        self._shards: list = [{} for _ in range(self._n)]
+        self._conds = [threading.Condition() for _ in range(self._n)]
+        self._busy = [False] * self._n
+        self._threads: list = []
+        self._stopped = False
+        self._max_retries = max_retries
+        self._commits = None
+        if metrics_registry is not None:
+            self._commits = metrics_registry.counter_vec(
+                "kube_throttler_remote_status_commit_total",
+                "remote status PUT outcomes by kind and result",
+                ["kind", "result"],
+            )
+
+    # -- writer-compatible surface (status_writer duck type) --------------
+
+    def update_throttle_status(self, thr: Throttle, expected_version=None) -> Throttle:
+        self._submit("Throttle", thr, thr.key)
+        return thr
+
+    def update_cluster_throttle_status(
+        self, thr: ClusterThrottle, expected_version=None
+    ) -> ClusterThrottle:
+        self._submit("ClusterThrottle", thr, thr.name)
+        return thr
+
+    def update_throttle_statuses(self, thrs) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for thr in thrs:
+            self._submit("Throttle", thr, thr.key)
+            out[thr.key] = thr
+        return out
+
+    def update_cluster_throttle_statuses(self, thrs) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for thr in thrs:
+            self._submit("ClusterThrottle", thr, thr.name)
+            out[thr.name] = thr
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stopped = False
+        for i in range(self._n):
+            t = threading.Thread(
+                target=self._run, args=(i,), name=f"status-commit-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self.flush(timeout)
+        self._stopped = True
+        for cond in self._conds:
+            with cond:
+                cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self._threads = []
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until every queued status has been PUT (or timeout).
+        True when fully drained."""
+        deadline = time.monotonic() + timeout
+        for i, cond in enumerate(self._conds):
+            with cond:
+                while (self._shards[i] or self._busy[i]) and time.monotonic() < deadline:
+                    cond.wait(0.05)
+                if self._shards[i] or self._busy[i]:
+                    return False
+        return True
+
+    def pending(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    # -- internals --------------------------------------------------------
+
+    def _submit(self, kind: str, obj, key: str) -> None:
+        i = hash(key) % self._n
+        cond = self._conds[i]
+        with cond:
+            self._shards[i][key] = (kind, obj)
+            cond.notify_all()
+
+    def _count(self, kind: str, result: str) -> None:
+        if self._commits is not None:
+            self._commits.inc({"kind": kind, "result": result})
+
+    def _run(self, i: int) -> None:
+        cond, shard = self._conds[i], self._shards[i]
+        while True:
+            with cond:
+                while not shard and not self._stopped:
+                    cond.wait(0.2)
+                if self._stopped and not shard:
+                    return
+                items = list(shard.items())
+                shard.clear()
+                self._busy[i] = True
+            try:
+                for _key, (kind, obj) in items:
+                    self._put_with_retry(kind, obj)
+            finally:
+                with cond:
+                    self._busy[i] = False
+                    cond.notify_all()  # wake flush()
+
+    def _put_with_retry(self, kind: str, obj) -> None:
+        delay = 0.01
+        for attempt in range(self._max_retries + 1):
+            try:
+                self._writer._put(kind, obj)
+                self._count(kind, "ok")
+                return
+            except NotFoundError:
+                # the object was deleted while its status sat queued —
+                # permanent; retrying would head-of-line block the shard
+                self._count(kind, "not_found")
+                return
+            except ConflictError:
+                self._count(kind, "conflict")
+                try:
+                    self._writer.refresh_version(kind, obj)
+                except Exception:
+                    pass  # retry PUTs with the stale RV; bounded anyway
+                if self._stopped:
+                    break
+                # client-go's RetryOnConflict backs off too: under a
+                # persistent conflict (two writers fighting) immediate
+                # GET+PUT pairs multiply apiserver load exactly when it is
+                # already contended
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
+            except Exception:
+                self._count(kind, "retry")
+                if self._stopped:
+                    break
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
+        self._count(kind, "dropped")
+        logger.warning(
+            "dropping status publication for %s %s after %d attempts "
+            "(resync will re-plan it)", kind, key_of(kind, obj), self._max_retries + 1
+        )
+
 
 class RemoteEventRecorder:
     """Event recorder that emits v1 Events to the apiserver — the
@@ -1070,6 +1268,14 @@ class RemoteSession:
             for kind in self.KINDS
         }
         self.status_writer = RemoteStatusWriter(self.client, self.versions)
+        # the committer is what controllers should use as their
+        # status_writer: same duck type plus batch + coalescing + N
+        # concurrent PUT workers (the raw writer stays for direct callers)
+        self.status_committer = AsyncStatusCommitter(
+            self.status_writer,
+            workers=int(os.environ.get("KT_STATUS_PUT_WORKERS", "4")),
+            metrics_registry=metrics_registry,
+        )
         self.event_recorder = RemoteEventRecorder(self.client)
 
     @classmethod
@@ -1084,8 +1290,10 @@ class RemoteSession:
             self.reflectors[kind].start()
             if not self.reflectors[kind].wait_for_sync(sync_timeout):
                 raise TimeoutError(f"reflector {kind} did not sync")
+        self.status_committer.start()
 
     def stop(self) -> None:
+        self.status_committer.stop()
         self.event_recorder.close()
         for refl in self.reflectors.values():
             refl.stop()

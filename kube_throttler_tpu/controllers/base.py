@@ -93,6 +93,19 @@ class ControllerBase:
     def enqueue_all(self, keys) -> None:
         self.workqueue.add_all(keys)
 
+    @staticmethod
+    def _selector_inputs_unchanged(old_pod, new_pod) -> bool:
+        """Selector matching reads only labels + namespace, so an unchanged
+        pair means the affected-throttle set cannot have moved — pod
+        MODIFIED handlers take a single-lookup fast path with no
+        reservation-move bookkeeping (the dominant churn shape:
+        requests/status-only updates)."""
+        return (
+            old_pod is not None
+            and old_pod.labels == new_pod.labels
+            and old_pod.namespace == new_pod.namespace
+        )
+
     def enqueue_after(self, key: str, duration: timedelta) -> None:
         self.workqueue.add_after(key, duration)
 

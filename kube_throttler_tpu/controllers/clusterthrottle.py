@@ -419,15 +419,7 @@ class ClusterThrottleController(ControllerBase):
             old_pod, new_pod = event.old_obj, event.obj
             if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
                 return
-            if (
-                old_pod is not None
-                and old_pod.labels == new_pod.labels
-                and old_pod.namespace == new_pod.namespace
-            ):
-                # selector matching reads only labels + namespace, so the
-                # affected set cannot have moved — one lookup, no move
-                # bookkeeping (the dominant churn shape: requests/status
-                # updates at full scale)
+            if self._selector_inputs_unchanged(old_pod, new_pod):
                 self.enqueue_all(self._affected_keys_or_log(new_pod))
                 return
             try:

@@ -744,3 +744,114 @@ class TestClientConnectionConfig:
             errs.append,
         )
         assert errs and "numeric" in errs[0]
+
+
+class TestAsyncStatusCommitter:
+    """AsyncStatusCommitter: coalescing, per-key ordering, conflict retry,
+    drop-after-retries (transport.py; the remote-mode analog of the
+    reference's synchronous UpdateStatus, throttle_controller.go:157-173)."""
+
+    class _FakeWriter:
+        """RemoteStatusWriter stand-in recording _put calls; can be armed
+        to raise per-call."""
+
+        def __init__(self):
+            import threading
+
+            self.calls = []  # (kind, key, status.used counts)
+            self.fail_plan = {}  # key -> list of exceptions to raise first
+            self.lock = threading.Lock()
+
+        def _put(self, kind, obj):
+            from kube_throttler_tpu.engine.store import key_of
+
+            key = key_of(kind, obj)
+            with self.lock:
+                plan = self.fail_plan.get(key)
+                if plan:
+                    raise plan.pop(0)
+                self.calls.append((kind, key, obj))
+
+        def refresh_version(self, kind, obj):
+            pass
+
+    def _mk(self, **kw):
+        from kube_throttler_tpu.client.transport import AsyncStatusCommitter
+
+        w = self._FakeWriter()
+        c = AsyncStatusCommitter(w, **kw)
+        return w, c
+
+    def _thr(self, name, pods):
+        from kube_throttler_tpu.api import ResourceAmount, Throttle, ThrottleSpec
+        from kube_throttler_tpu.api.types import ThrottleStatus
+
+        return Throttle(
+            name=name,
+            namespace="default",
+            spec=ThrottleSpec(throttler_name="kt"),
+            status=ThrottleStatus(used=ResourceAmount.of(pod=pods)),
+        )
+
+    def test_newest_wins_coalescing(self):
+        w, c = self._mk(workers=1)
+        # submit 50 versions of one key BEFORE starting the worker: exactly
+        # one PUT must go out, carrying the newest status
+        for i in range(50):
+            c.update_throttle_status(self._thr("a", pods=i))
+        c.start()
+        assert c.flush(5.0)
+        c.stop()
+        assert len(w.calls) == 1
+        assert w.calls[0][2].status.used.resource_counts == 49
+
+    def test_batch_interface_returns_all_keys(self):
+        w, c = self._mk(workers=2)
+        thrs = [self._thr(f"t{i}", pods=i) for i in range(8)]
+        out = c.update_throttle_statuses(thrs)
+        assert set(out) == {t.key for t in thrs}
+        c.start()
+        assert c.flush(5.0)
+        c.stop()
+        assert {k for (_, k, _) in w.calls} == {t.key for t in thrs}
+
+    def test_per_key_ordering_single_worker_per_key(self):
+        # keys hash to fixed shards: interleave two keys' submissions and
+        # verify each key's PUT sequence is monotone in submission order
+        w, c = self._mk(workers=4)
+        c.start()
+        for i in range(30):
+            c.update_throttle_status(self._thr("x", pods=i))
+            c.update_throttle_status(self._thr("y", pods=i))
+        assert c.flush(5.0)
+        c.stop()
+        for key in ("default/x", "default/y"):
+            seq = [o.status.used.resource_counts for (_, k, o) in w.calls if k == key]
+            assert seq == sorted(seq), seq
+            assert seq[-1] == 29  # newest landed last
+
+    def test_conflict_retries_then_lands(self):
+        from kube_throttler_tpu.engine.store import ConflictError
+        from kube_throttler_tpu.metrics import Registry
+
+        reg = Registry()
+        w, c = self._mk(workers=1, metrics_registry=reg)
+        w.fail_plan["default/a"] = [ConflictError("a"), ConflictError("a")]
+        c.start()
+        c.update_throttle_status(self._thr("a", pods=7))
+        assert c.flush(5.0)
+        c.stop()
+        assert len(w.calls) == 1
+        assert w.calls[0][2].status.used.resource_counts == 7
+        counts = c._commits.collect()
+        assert counts[("Throttle", "conflict")] == 2.0
+        assert counts[("Throttle", "ok")] == 1.0
+
+    def test_drop_after_retry_budget(self):
+        w, c = self._mk(workers=1, max_retries=2)
+        w.fail_plan["default/a"] = [RuntimeError("boom")] * 10
+        c.start()
+        c.update_throttle_status(self._thr("a", pods=1))
+        assert c.flush(10.0)
+        c.stop()
+        assert w.calls == []  # dropped; resync re-plans it
