@@ -1517,14 +1517,15 @@ class DeviceStateManager:
         from datetime import datetime, timezone
 
         from ..ops.overrides import _datetime_to_ns, encode_override_schedule
-        from ..parallel.sharded import sharded_full_update
+        from ..parallel.sharded import full_update_step_gather, sharded_full_update
 
         dp, tp = (mesh.shape["pods"], mesh.shape["throttles"])
+        single = dp == 1 and tp == 1
         now_ns = jnp.asarray(
             _datetime_to_ns(now or datetime.now(timezone.utc)), dtype=jnp.int64
         )
         snaps = {}
-        with self._lock:
+        with self.tracer.trace("tick_snapshot"), self._lock:
             for kind in ("throttle", "clusterthrottle"):
                 ks = self._kind(kind)
                 ks.ensure_capacity()
@@ -1534,13 +1535,23 @@ class DeviceStateManager:
                         f"({ks.pcap},{ks.tcap}); capacities are ladder rungs "
                         "(multiples of 8), so use power-of-two mesh axes"
                     )
-                pods, mask = ks.device_pods()
+                # 1×1 mesh: prefer the sparse [P,K] cols companion — the
+                # tick then needs no [P,T] tensor at all (the dense mask
+                # upload alone is ~2.1GB at 100k×10k). Multi-chip keeps the
+                # dense tiled layout (shard_map shards the mask).
+                cols = None
+                if single:
+                    pods, mask = ks.device_pods(need_mask=False)
+                    cols = ks.device_cols()
+                if cols is None:
+                    pods, mask = ks.device_pods()
                 specs = [None] * ks.tcap
                 for col, thr in ks.index._col_thrs.items():
                     specs[col] = thr.spec
                 snaps[kind] = dict(
                     pods=pods,
                     mask=mask,
+                    cols=cols,
                     counted=ks._device_counted(),
                     res=(
                         ks.res_cnt.copy(), ks.res_cnt_present.copy(),
@@ -1555,33 +1566,45 @@ class DeviceStateManager:
         out = {}
         for kind, snap in snaps.items():
             # encode outside the lock: O(T) host work over spec objects
-            max_o = max(
-                (len(s.temporary_threshold_overrides) for s in snap["specs"] if s),
-                default=0,
-            )
-            sched = encode_override_schedule(
-                snap["specs"],
-                self.dims,
-                throttle_capacity=snap["tcap"],
-                override_capacity=_next_pow2(max_o, lo=1),
-            )
-            step3 = True if kind == "throttle" else on_equal
-            key = (mesh, on_equal, step3)
-            step = self._sharded_steps.get(key)
-            if step is None:
-                step = self._sharded_steps[key] = sharded_full_update(
-                    mesh, on_equal=on_equal, step3_on_equal=step3
+            with self.tracer.trace("tick_encode"):
+                max_o = max(
+                    (len(s.temporary_threshold_overrides) for s in snap["specs"] if s),
+                    default=0,
                 )
+                sched = encode_override_schedule(
+                    snap["specs"],
+                    self.dims,
+                    throttle_capacity=snap["tcap"],
+                    override_capacity=_next_pow2(max_o, lo=1),
+                )
+            step3 = True if kind == "throttle" else on_equal
             res_cnt, res_cnt_p, res_req, res_req_p = snap["res"]
-            counts, schedulable, used_cnt, used_req, _, _ = step(
-                sched, snap["pods"], snap["mask"], snap["counted"],
-                res_cnt, res_cnt_p, res_req, res_req_p,
-                snap["thr_valid"], now_ns,
-            )
-            out[kind] = (
-                np.asarray(counts), np.asarray(schedulable), snap["row_map"],
-                np.asarray(used_cnt), np.asarray(used_req), snap["col_map"],
-            )
+            with self.tracer.trace("tick_device"):
+                if snap["cols"] is not None:
+                    counts, schedulable, used_cnt, used_req, _, _ = (
+                        full_update_step_gather(
+                            sched, snap["pods"], snap["cols"], snap["counted"],
+                            res_cnt, res_cnt_p, res_req, res_req_p,
+                            snap["thr_valid"], now_ns,
+                            on_equal=on_equal, step3_on_equal=step3,
+                        )
+                    )
+                else:
+                    key = (mesh, on_equal, step3)
+                    step = self._sharded_steps.get(key)
+                    if step is None:
+                        step = self._sharded_steps[key] = sharded_full_update(
+                            mesh, on_equal=on_equal, step3_on_equal=step3
+                        )
+                    counts, schedulable, used_cnt, used_req, _, _ = step(
+                        sched, snap["pods"], snap["mask"], snap["counted"],
+                        res_cnt, res_cnt_p, res_req, res_req_p,
+                        snap["thr_valid"], now_ns,
+                    )
+                out[kind] = (
+                    np.asarray(counts), np.asarray(schedulable), snap["row_map"],
+                    np.asarray(used_cnt), np.asarray(used_req), snap["col_map"],
+                )
         return out
 
     def check_batch_all(self, on_equal: bool = False):

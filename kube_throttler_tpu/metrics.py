@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import math
 import threading
+from bisect import bisect_left as _bucket_index  # smallest i: buckets[i] >= v
 from fractions import Fraction
 from functools import lru_cache
 from typing import Dict, Optional, Sequence, Tuple, Union
@@ -97,16 +98,20 @@ class HistogramVec:
         self._series: Dict[Tuple[str, ...], list] = {}
 
     def observe(self, labels: Dict[str, str], value: float) -> None:
-        key = tuple(labels[n] for n in self.label_names)
+        self.observe_key(tuple(labels[n] for n in self.label_names), value)
+
+    def observe_key(self, key: Tuple[str, ...], value: float) -> None:
+        """Hot-path observe for a precomputed label tuple. Buckets store
+        RAW (non-cumulative) counts — one bisect instead of a walk over
+        every boundary; collect() cumsums at scrape time (observes
+        outnumber scrapes by ~1e6 on the serving path)."""
+        i = _bucket_index(self.buckets, value)
         with self._lock:
             s = self._series.get(key)
             if s is None:
-                s = [[0] * len(self.buckets), 0.0, 0]
+                s = [[0] * (len(self.buckets) + 1), 0.0, 0]
                 self._series[key] = s
-            counts, _, _ = s
-            for i, le in enumerate(self.buckets):
-                if value <= le:
-                    counts[i] += 1
+            s[0][i] += 1
             s[1] += value
             s[2] += 1
 
@@ -118,8 +123,17 @@ class HistogramVec:
             return (s[1], s[2]) if s else None
 
     def collect(self) -> Dict[Tuple[str, ...], tuple]:
+        """Series snapshot with CUMULATIVE bucket counts (the prometheus
+        exposition shape; storage is raw per-bucket — see observe_key)."""
+        out = {}
         with self._lock:
-            return {k: (list(s[0]), s[1], s[2]) for k, s in self._series.items()}
+            for k, s in self._series.items():
+                cum, running = [], 0
+                for c in s[0][: len(self.buckets)]:
+                    running += c
+                    cum.append(running)
+                out[k] = (cum, s[1], s[2])
+        return out
 
 
 class Registry:

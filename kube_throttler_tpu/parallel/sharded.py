@@ -114,6 +114,91 @@ def full_update_step(
     return counts, schedulable, used_cnt, used_req, st_cnt, st_req
 
 
+@partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
+def full_update_step_gather(
+    sched: OverrideSchedule,
+    pods: PodBatch,
+    cols: jnp.ndarray,  # int32[P,K] matched throttle cols per pod, -1 pads
+    counted: jnp.ndarray,  # bool[P]
+    res_cnt: jnp.ndarray,
+    res_cnt_present: jnp.ndarray,
+    res_req: jnp.ndarray,
+    res_req_present: jnp.ndarray,
+    thr_valid: jnp.ndarray,  # bool[T]
+    now_ns: jnp.ndarray,
+    *,
+    on_equal: bool = False,
+    step3_on_equal: bool = True,
+):
+    """The SPARSE single-device tick: same fused reconcile+classify as
+    ``full_update_step`` but driven by the [P,K] matched-cols companion
+    instead of the dense [P,T] mask — O(P·K·R) work and no [P,T] tensor
+    anywhere (neither compute nor transfer). On real clusters K ≪ T, so
+    this is the single-chip serving shape; the dense shard_map variant
+    remains the multi-chip path (its tiles need the mask layout).
+
+    used-aggregation becomes an exact int64 scatter-add over the flat
+    [P·K] (col, contribution) pairs (padded/uncounted slots route to an
+    out-of-range index and drop); classification is ``check_pods_gather``
+    against the freshly derived state. Returns the same tuple as
+    ``full_update_step``: (counts int32[P,4], schedulable bool[P],
+    used_cnt int64[T], used_req int64[T,R], st_cnt bool[T],
+    st_req bool[T,R])."""
+    from ..ops.check import check_pods_gather
+
+    T = thr_valid.shape[0]
+    P_, K = cols.shape
+    R = pods.req.shape[1]
+
+    thr_cnt, thr_cnt_present, thr_req, thr_req_present = calculate_thresholds(
+        sched, now_ns
+    )
+
+    slot = (cols >= 0) & (counted & pods.valid)[:, None]  # [P,K]
+    tgt = jnp.where(slot, cols, T).reshape(-1)  # T = out of range ⇒ dropped
+    used_cnt = jnp.zeros(T, dtype=jnp.int64).at[tgt].add(1, mode="drop")
+    req_rows = jnp.broadcast_to(pods.req[:, None, :], (P_, K, R)).reshape(P_ * K, R)
+    pres_rows = jnp.broadcast_to(
+        pods.req_present[:, None, :], (P_, K, R)
+    ).reshape(P_ * K, R)
+    used_req = jnp.zeros((T, R), dtype=jnp.int64).at[tgt].add(req_rows, mode="drop")
+    contrib = (
+        jnp.zeros((T, R), dtype=jnp.int32)
+        .at[tgt]
+        .add(pres_rows.astype(jnp.int32), mode="drop")
+    )
+    used_cnt_present = used_cnt > 0
+    used_req_present = contrib > 0
+
+    st_cnt, st_req, st_req_flag_present = throttled_flags(
+        thr_cnt, thr_cnt_present, thr_req, thr_req_present,
+        used_cnt, used_cnt_present, used_req, used_req_present,
+    )
+
+    state = ThrottleState(
+        valid=thr_valid,
+        thr_cnt=thr_cnt,
+        thr_cnt_present=thr_cnt_present,
+        thr_req=thr_req,
+        thr_req_present=thr_req_present,
+        used_cnt=used_cnt,
+        used_cnt_present=used_cnt_present,
+        used_req=used_req,
+        used_req_present=used_req_present,
+        res_cnt=res_cnt,
+        res_cnt_present=res_cnt_present,
+        res_req=res_req,
+        res_req_present=res_req_present,
+        st_cnt_throttled=st_cnt,
+        st_req_throttled=st_req,
+        st_req_flag_present=st_req_flag_present,
+    )
+    counts, schedulable = check_pods_gather(
+        state, pods, cols, on_equal=on_equal, step3_on_equal=step3_on_equal
+    )
+    return counts, schedulable, used_cnt, used_req, st_cnt, st_req
+
+
 def sharded_apply_deltas(mesh: Mesh):
     """Streaming reconcile (BASELINE cfg5) over a throttle-sharded mesh.
 

@@ -75,16 +75,11 @@ class PhaseTracer:
             ["phase"],
         )
 
-    @contextlib.contextmanager
-    def trace(self, phase: str) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            elapsed = time.perf_counter() - start
-            self._hist.observe({"phase": phase}, elapsed)
-            if v_enabled(5):
-                vlog(5, "phase %s took %.6fs", phase, elapsed)
+    def trace(self, phase: str) -> "_Trace":
+        # a slotted context object, not @contextmanager: the generator
+        # protocol costs ~3µs per enter/exit and the serving hot path
+        # crosses 6+ trace scopes per decision
+        return _Trace(self._hist, phase)
 
     def observe(self, phase: str, seconds: float) -> None:
         self._hist.observe({"phase": phase}, seconds)
@@ -98,8 +93,31 @@ class PhaseTracer:
         return {"sum": total, "count": count, "mean": total / count if count else 0.0}
 
 
+class _Trace:
+    """Slotted timing scope: observes phase duration into the histogram
+    family on exit (plus V(5) logging when enabled)."""
+
+    __slots__ = ("_hist", "_phase", "_start")
+
+    def __init__(self, hist, phase: str) -> None:
+        self._hist = hist
+        self._phase = phase
+
+    def __enter__(self) -> None:
+        self._start = time.perf_counter()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        elapsed = time.perf_counter() - self._start
+        self._hist.observe_key((self._phase,), elapsed)
+        if v_enabled(5):
+            vlog(5, "phase %s took %.6fs", self._phase, elapsed)
+
+
 class _NoopHist:
     def observe(self, labels, value) -> None:
+        pass
+
+    def observe_key(self, key, value) -> None:
         pass
 
     def snapshot(self, labels):

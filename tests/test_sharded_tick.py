@@ -164,6 +164,48 @@ class TestFullTickSharded:
                 np.asarray(ok_t)[idx], np.asarray(ok_d)[idx]
             )
 
+    def test_sparse_single_device_matches_sharded_mesh(self, stack):
+        """The 1×1-mesh tick routes through the sparse [P,K] gather step
+        (full_update_step_gather — no [P,T] tensor at all); its counts,
+        verdicts, and recomputed used must match the dense 8-device
+        shard_map program cell-for-cell."""
+        store, plugin = stack
+        # sized for sparse eligibility: ~12 matches/pod pads to the K=16
+        # rung, which needs tcap ≥ 128 (the K*4 < tcap ladder policy)
+        _populate(store, random.Random(2), n_thr=96, n_pods=200, groups=8)
+        plugin.run_pending_once()
+        dm = plugin.device_manager
+
+        t1 = dm.full_tick_sharded(make_mesh(1, (1, 1)))
+        # the scenario must actually exercise the sparse path: enough
+        # throttles that the [P,K] companion is the chosen batch shape
+        with dm._lock:
+            dm.throttle.device_pods(need_mask=False)
+            assert dm.throttle.device_cols() is not None, (
+                "test state too small: cols ladder opted out, sparse tick "
+                "not exercised"
+            )
+        t8 = dm.full_tick_sharded(make_mesh(8, (4, 2)))
+
+        for kind in ("throttle", "clusterthrottle"):
+            counts_1, ok_1, rows_1, used_cnt_1, used_req_1, cols_1 = t1[kind]
+            counts_8, ok_8, rows_8, used_cnt_8, used_req_8, cols_8 = t8[kind]
+            assert rows_1 == rows_8
+            rows = sorted(rows_1.values())
+            np.testing.assert_array_equal(
+                np.asarray(counts_1)[rows], np.asarray(counts_8)[rows]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(ok_1)[rows], np.asarray(ok_8)[rows]
+            )
+            cols = sorted(cols_1)
+            np.testing.assert_array_equal(
+                np.asarray(used_cnt_1)[cols], np.asarray(used_cnt_8)[cols]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(used_req_1)[cols], np.asarray(used_req_8)[cols]
+            )
+
     def test_active_override_resolved_on_device(self, stack):
         """An active temporary override must shape the tick's thresholds:
         spec cpu=100m would throttle the 200m pod, but the active override
