@@ -324,17 +324,7 @@ class ClusterThrottleController(ControllerBase):
                 raise NotFoundError(f"namespace {pod.namespace!r} not found")
             results = dm.guarded("check", dm.check_pod, pod, self.KIND, is_throttled_on_equal)
             if results is not None:
-                active, insufficient, exceeds, affected = [], [], [], []
-                for key, status in results.items():
-                    thr = self._get_cluster_throttle(key.lstrip("/"))
-                    affected.append(thr)
-                    if status == "active":
-                        active.append(thr)
-                    elif status == "insufficient":
-                        insufficient.append(thr)
-                    elif status == "pod-requests-exceeds-threshold":
-                        exceeds.append(thr)
-                return active, insufficient, exceeds, affected
+                return self.classify_from_map(results)
         throttles = self.affected_cluster_throttles(pod)
         active: List[ClusterThrottle] = []
         insufficient: List[ClusterThrottle] = []
@@ -349,6 +339,21 @@ class ClusterThrottleController(ControllerBase):
             elif status == "pod-requests-exceeds-threshold":
                 exceeds.append(thr)
         return active, insufficient, exceeds, throttles
+
+    def classify_from_map(self, results: Dict[str, str]):
+        """See ThrottleController.classify_from_map (cluster keys carry no
+        namespace prefix)."""
+        active, insufficient, exceeds, affected = [], [], [], []
+        for key, status in results.items():
+            thr = self._get_cluster_throttle(key.lstrip("/"))
+            affected.append(thr)
+            if status == "active":
+                active.append(thr)
+            elif status == "insufficient":
+                insufficient.append(thr)
+            elif status == "pod-requests-exceeds-threshold":
+                exceeds.append(thr)
+        return active, insufficient, exceeds, affected
 
     # ---------------------------------------------------------- event wiring
 

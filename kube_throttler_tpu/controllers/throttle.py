@@ -301,18 +301,7 @@ class ThrottleController(ControllerBase):
         if dm is not None:
             results = dm.guarded("check", dm.check_pod, pod, self.KIND, is_throttled_on_equal)
             if results is not None:
-                active, insufficient, exceeds, affected = [], [], [], []
-                for key, status in results.items():
-                    namespace, _, name = key.partition("/")
-                    thr = self._get_throttle(namespace, name)
-                    affected.append(thr)
-                    if status == "active":
-                        active.append(thr)
-                    elif status == "insufficient":
-                        insufficient.append(thr)
-                    elif status == "pod-requests-exceeds-threshold":
-                        exceeds.append(thr)
-                return active, insufficient, exceeds, affected
+                return self.classify_from_map(results)
         throttles = self.affected_throttles(pod)
         active: List[Throttle] = []
         insufficient: List[Throttle] = []
@@ -327,6 +316,24 @@ class ThrottleController(ControllerBase):
             elif status == "pod-requests-exceeds-threshold":
                 exceeds.append(thr)
         return active, insufficient, exceeds, throttles
+
+    def classify_from_map(self, results: Dict[str, str]):
+        """Device classification map {throttle_key: status} → the
+        check_throttled 4-tuple. Shared by the per-pod device path and the
+        micro-batching pre_filter front-end (one fused dispatch produces
+        many pods' maps; each composes reasons through this same code)."""
+        active, insufficient, exceeds, affected = [], [], [], []
+        for key, status in results.items():
+            namespace, _, name = key.partition("/")
+            thr = self._get_throttle(namespace, name)
+            affected.append(thr)
+            if status == "active":
+                active.append(thr)
+            elif status == "insufficient":
+                insufficient.append(thr)
+            elif status == "pod-requests-exceeds-threshold":
+                exceeds.append(thr)
+        return active, insufficient, exceeds, affected
 
     # ---------------------------------------------------------- event wiring
 

@@ -1339,6 +1339,39 @@ class DeviceStateManager:
 
     # -- queries ----------------------------------------------------------
 
+    def _encoded_row(self, ks: _KindState, pod: Pod):
+        """Request encode (Fraction arithmetic over containers) for one pod
+        → ([1,R] int64, [1,R] bool). Identical for both kinds and across
+        scheduler retries of the same stored object — memoized per pod
+        OBJECT (a pod update is a new object; GC evicts via weakref
+        finalizer). Caller holds the main lock. Shared by check_pod and
+        check_pods_multi: the encode is the dominant per-pod host cost
+        (~25µs of Fraction math), so an unmemoized batch path would erase
+        the fused dispatch's win."""
+        cached = self._encode_cache.get(id(pod))
+        if cached is not None and cached[0]() is pod and cached[1] == ks.R:
+            return cached[2], cached[3]
+        row_req = np.zeros((1, ks.R), dtype=np.int64)
+        row_present = np.zeros((1, ks.R), dtype=bool)
+        row_req, row_present = ks.encode_pod_requests_into(
+            row_req, row_present, 0, pod
+        )
+        row_req.setflags(write=False)
+        row_present.setflags(write=False)
+        key = id(pod)
+        # the finalizer must capture only the dict, not self: a lambda over
+        # `self` would chain pod → weakref → manager and pin discarded
+        # managers (and their device state) alive for as long as any
+        # checked pod object lives
+        cache = self._encode_cache
+        try:
+            ref = weakref.ref(pod, lambda _, k=key, c=cache: c.pop(k, None))
+        except TypeError:
+            pass  # non-weakref-able stand-ins: skip caching
+        else:
+            cache[key] = (ref, ks.R, row_req, row_present)
+        return row_req, row_present
+
     def check_pod(self, pod: Pod, kind: str, on_equal: bool = False) -> Dict[str, str]:
         """Single-pod check → {throttle_key: status_name} over affected
         throttles. The device kernel sees a 1-row pod batch + its mask row.
@@ -1359,35 +1392,7 @@ class DeviceStateManager:
             with self._lock:
                 ks = self.throttle if kind == "throttle" else self.clusterthrottle
                 ks.ensure_capacity()
-                # request encode (Fraction arithmetic over containers) is
-                # identical for both kinds and across scheduler retries of
-                # the same stored object — memoized per pod OBJECT (a pod
-                # update is a new object; GC evicts via weakref finalizer)
-                cached = self._encode_cache.get(id(pod))
-                if cached is not None and cached[0]() is pod and cached[1] == ks.R:
-                    row_req, row_present = cached[2], cached[3]
-                else:
-                    row_req = np.zeros((1, ks.R), dtype=np.int64)
-                    row_present = np.zeros((1, ks.R), dtype=bool)
-                    row_req, row_present = ks.encode_pod_requests_into(
-                        row_req, row_present, 0, pod
-                    )
-                    row_req.setflags(write=False)
-                    row_present.setflags(write=False)
-                    key = id(pod)
-                    # the finalizer must capture only the dict, not self: a
-                    # lambda over `self` would chain pod → weakref → manager
-                    # and pin discarded managers (and their device state)
-                    # alive for as long as any checked pod object lives
-                    cache = self._encode_cache
-                    try:
-                        ref = weakref.ref(
-                            pod, lambda _, k=key, c=cache: c.pop(k, None)
-                        )
-                    except TypeError:
-                        pass  # non-weakref-able stand-ins: skip caching
-                    else:
-                        cache[key] = (ref, ks.R, row_req, row_present)
+                row_req, row_present = self._encoded_row(ks, pod)
                 prow = ks.index.pod_row(pod.key)
                 if prow is not None:
                     mask_row = ks.index.mask[prow : prow + 1, :].copy()
@@ -1450,6 +1455,77 @@ class DeviceStateManager:
                 if out[col] != CHECK_NOT_AFFECTED:
                     result[key] = STATUS_NAMES[int(out[col])]
             return result
+
+    def check_pods_multi(
+        self, pod_list: Sequence[Pod], kind: str, on_equal: bool = False
+    ) -> List[Dict[str, str]]:
+        """Several DISTINCT pods classified in ONE fused device dispatch —
+        the micro-batching front-end's kernel call. Same per-pod result
+        shape as ``check_pod`` ({throttle_key: status_name}), but the
+        dispatch+sync cost (the dominant slice of a 1-pod check) is paid
+        once for the whole batch. Shapes bucket on (B, K) ladder rungs.
+
+        Host-side snapshot under the lock (encode + mask rows + state
+        handles), dispatch and decode outside — same locking discipline as
+        check_pod."""
+        from ..ops.check import check_pods_gather_statuses
+
+        if not pod_list:
+            return []
+        with self._lock:
+            ks = self.throttle if kind == "throttle" else self.clusterthrottle
+            ks.ensure_capacity()
+            R, tcap = ks.R, ks.tcap
+            rows, colss = [], []
+            for pod in pod_list:
+                row_req, row_present = self._encoded_row(ks, pod)
+                prow = ks.index.pod_row(pod.key)
+                if prow is not None:
+                    cols = np.nonzero(ks.index.mask[prow, :tcap])[0]
+                else:
+                    with ks.index._lock:  # noqa: SLF001 — same-package access
+                        rowm = ks.index.match_row_cached(pod) & ks.index._thr_valid
+                    cols = np.nonzero(rowm[:tcap])[0]
+                rows.append((row_req, row_present))
+                colss.append(cols.astype(np.int32))
+            state = ks.device_state()
+            col_keys = dict(ks.index._col_keys)
+
+        B = len(pod_list)
+        Bp = _next_pow2(B, lo=4)
+        K = _next_pow2(max((c.size for c in colss), default=1) or 1, lo=4)
+        req = np.zeros((Bp, R), dtype=np.int64)
+        present = np.zeros((Bp, R), dtype=bool)
+        valid = np.zeros(Bp, dtype=bool)
+        cols_arr = np.full((Bp, K), -1, dtype=np.int32)
+        for i, ((rq, rp), cc) in enumerate(zip(rows, colss)):
+            req[i] = rq[0]
+            present[i] = rp[0]
+            valid[i] = True
+            cols_arr[i, : cc.size] = cc
+        # numpy args go straight into the jitted call: jit's argument path
+        # converts them ~an order of magnitude cheaper than explicit
+        # jnp.asarray device_puts (measured 361µs vs 39µs per call here)
+        batch = PodBatch(valid=valid, req=req, req_present=present)
+        step3 = True if kind == "throttle" else on_equal
+        out = np.asarray(
+            check_pods_gather_statuses(
+                state, batch, cols_arr,
+                on_equal=on_equal, step3_on_equal=step3,
+            )
+        )
+        results: List[Dict[str, str]] = []
+        for i in range(B):
+            res: Dict[str, str] = {}
+            cc = colss[i]
+            for slot in range(cc.size):
+                status = int(out[i, slot])
+                if status != CHECK_NOT_AFFECTED:
+                    key = col_keys.get(int(cc[slot]))
+                    if key is not None:
+                        res[key] = STATUS_NAMES[status]
+            results.append(res)
+        return results
 
     def _grab_batch_handles(self, kind: str, on_equal: bool):
         """Under the caller's lock: one kind's immutable device handles +

@@ -215,6 +215,14 @@ def check_pods_gather(state: ThrottleState, pods: PodBatch, cols: jnp.ndarray,
         raise ValueError(
             f"cols shape {cols.shape} != (P={pods.req.shape[0]}, K)"
         )
+    return statuses_to_compact(
+        _gather_statuses(state, pods, cols, on_equal, step3_on_equal)
+    )
+
+
+def _gather_statuses(state, pods, cols, on_equal, step3_on_equal):
+    """Shared body of the sparse gather kernels: int8[P,K] per-slot
+    statuses (CHECK_NOT_AFFECTED for padded/invalid slots)."""
     c = jnp.maximum(cols, 0)  # [P,K]; padded slots gather col 0 then mask out
     slot = (cols >= 0) & state.valid[c] & pods.valid[:, None]
 
@@ -234,8 +242,19 @@ def check_pods_gather(state: ThrottleState, pods: PodBatch, cols: jnp.ndarray,
         (state.used_req_present | state.res_req_present)[c],
         on_equal, step3_on_equal,
     )
-    statuses = jnp.where(slot, result, jnp.int8(CHECK_NOT_AFFECTED))
-    return statuses_to_compact(statuses)
+    return jnp.where(slot, result, jnp.int8(CHECK_NOT_AFFECTED))
+
+
+@partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
+def check_pods_gather_statuses(
+    state: ThrottleState, pods: PodBatch, cols: jnp.ndarray,
+    on_equal: bool = False, step3_on_equal: bool = True,
+):
+    """``check_pods_gather`` returning the raw int8[P,K] per-slot statuses
+    instead of compact counts — the micro-batching pre_filter front-end
+    needs each pod's per-throttle classification to build reference reason
+    strings (plugin.go:182-214), not just the verdict."""
+    return _gather_statuses(state, pods, cols, on_equal, step3_on_equal)
 
 
 @partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))

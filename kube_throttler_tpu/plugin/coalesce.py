@@ -1,0 +1,134 @@
+"""Micro-batching pre_filter front-end (leader-follower coalescing).
+
+Concurrent ``pre_filter`` callers each pay a full device dispatch+sync for
+a 1-pod kernel — the dominant slice of the per-decision latency (~30-40µs
+on CPU, more through a TPU tunnel), and the reason thread-scaling of the
+naive path flatlines (VERDICT r3/r4: 4 threads ≤ 1 thread). The reference
+has no analog: its PreFilter is pure in-memory Go (plugin.go:148-215) and
+scales with goroutines; ours pays a kernel dispatch, so the fix is to
+AMORTIZE it.
+
+Leader-follower batching (the classic group-commit shape): the first
+caller in an empty window becomes the leader, sleeps ``window_s`` to let
+concurrent followers enqueue, then issues ONE fused [B,K] gather dispatch
+per kind (``DeviceStateManager.check_pods_multi``) for the whole batch and
+distributes per-pod classification maps. Every pod's Status is then
+composed through exactly the same controller/reason code as the direct
+path (``classify_from_map`` → ``_compose_prefilter_status``), so semantics
+— reason strings, ordering, Warning events — are identical.
+
+Sizing guidance: the default ``window_s=0`` is NATURAL batching — the
+leader takes whatever queued while the previous leader's dispatch ran, so
+no timer latency is ever added and a lone caller pays exactly the direct
+path's cost. A positive window trades added latency for bigger batches
+(useful when callers arrive in bursts sparser than a dispatch width);
+keep it well under the BASELINE <1ms p99 target. ``max_batch`` bounds the
+fused shape (B pads to ladder rungs, so compiled-shape count stays
+logarithmic). For BULK triage of the whole stored pod set, use
+``plugin.pre_filter_batch`` — that is the official scaling surface for
+sweep-shaped loads; the coalescer serves interactive scheduler traffic.
+
+Measured verdict (single-core CPU host, r5 bench): coalescing LOSES there
+— ~0.4× of 1-thread direct — because each follower pays two context
+switches (~150µs under load on one core) to save a ~40µs CPU dispatch,
+while naive GIL-serialized threads pay no coordination at all. The
+crossover needs (a) dispatch cost ≫ wakeup cost — true through a TPU
+tunnel, where a dispatch is ~ms — or (b) real cores for followers to
+wait on. Deployments on the TPU serving path should enable it; pure-CPU
+single-core deployments should not. The bench records both numbers
+(served_decisions_per_sec_4t vs _4t_coalesced) so the tradeoff is visible
+per platform.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..api.pod import Pod
+from .framework import Status, StatusCode
+
+
+class _Entry:
+    __slots__ = ("pod", "event", "status")
+
+    def __init__(self, pod: Pod) -> None:
+        self.pod = pod
+        self.event = threading.Event()
+        self.status: Optional[Status] = None
+
+
+class PreFilterCoalescer:
+    def __init__(self, plugin, window_s: float = 0.0, max_batch: int = 64):
+        self._plugin = plugin
+        self._window = window_s
+        self._max_batch = max_batch
+        self._lock = threading.Lock()
+        self._queue: List[_Entry] = []
+        self._leader_active = False
+
+    def pre_filter(self, pod: Pod) -> Status:
+        dm = self._plugin.device_manager
+        if dm is None:
+            return self._plugin.pre_filter(pod)
+        entry = _Entry(pod)
+        with self._lock:
+            self._queue.append(entry)
+            lead = not self._leader_active
+            if lead:
+                self._leader_active = True
+        if not lead:
+            entry.event.wait()
+            # a follower whose batch overflowed max_batch is re-led below
+            if entry.status is not None:
+                return entry.status
+            return self._plugin.pre_filter(pod)
+        if self._window > 0:
+            time.sleep(self._window)  # collect followers (yields the GIL too)
+        with self._lock:
+            batch = self._queue[: self._max_batch]
+            overflow = self._queue[self._max_batch :]
+            self._queue = []
+            self._leader_active = False
+        try:
+            self._classify_batch(batch)
+        finally:
+            for e in batch:
+                if e.status is None:
+                    e.status = None  # falls back in the waiter
+                e.event.set()
+            for e in overflow:
+                # overflow entries re-run individually (rare: >max_batch
+                # concurrent callers inside one window)
+                e.event.set()
+        return entry.status if entry.status is not None else self._plugin.pre_filter(pod)
+
+    def _classify_batch(self, batch: List[_Entry]) -> None:
+        plugin = self._plugin
+        dm = plugin.device_manager
+        pods = [e.pod for e in batch]
+        try:
+            thr_maps = dm.guarded("check", dm.check_pods_multi, pods, "throttle")
+            clthr_maps = dm.guarded(
+                "check", dm.check_pods_multi, pods, "clusterthrottle"
+            )
+        except Exception:
+            thr_maps = clthr_maps = None
+        if thr_maps is None or clthr_maps is None:
+            return  # breaker open / dispatch failed: waiters fall back
+        for e, tmap, cmap in zip(batch, thr_maps, clthr_maps):
+            try:
+                # the cluster kind's missing-namespace contract
+                # (clusterthrottle_controller.go:273-276) holds here too
+                if plugin.cluster_throttle_ctr._get_namespace(e.pod.namespace) is None:
+                    e.status = Status(
+                        StatusCode.ERROR,
+                        (f"namespace {e.pod.namespace!r} not found",),
+                    )
+                    continue
+                thr4 = plugin.throttle_ctr.classify_from_map(tmap)
+                clthr4 = plugin.cluster_throttle_ctr.classify_from_map(cmap)
+                e.status = plugin._compose_prefilter_status(e.pod, thr4, clthr4)
+            except Exception as exc:  # per-pod decode error → per-pod status
+                e.status = Status(StatusCode.ERROR, (str(exc),))

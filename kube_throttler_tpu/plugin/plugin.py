@@ -125,6 +125,7 @@ class KubeThrottler:
             }
         self.throttle_ctr.tracer = self.tracer
         self.cluster_throttle_ctr.tracer = self.tracer
+        self._coalescer = None
         if start_workers:
             self.throttle_ctr.start()
             self.cluster_throttle_ctr.start()
@@ -132,6 +133,17 @@ class KubeThrottler:
     @property
     def name(self) -> str:
         return PLUGIN_NAME
+
+    def coalescer(self, window_s: float = 0.0, max_batch: int = 64):
+        """The micro-batching pre_filter front-end for CONCURRENT callers:
+        one fused device dispatch per window instead of one per caller
+        (plugin/coalesce.py). First call constructs it; parameters are
+        fixed thereafter."""
+        if self._coalescer is None:
+            from .coalesce import PreFilterCoalescer
+
+            self._coalescer = PreFilterCoalescer(self, window_s, max_batch)
+        return self._coalescer
 
     # -------------------------------------------------------------- prefilter
 
@@ -141,18 +153,24 @@ class KubeThrottler:
 
     def _pre_filter(self, pod: Pod) -> Status:
         try:
-            thr_active, thr_insufficient, thr_exceeds, thr_affected = (
-                self.throttle_ctr.check_throttled(pod, False)
-            )
+            thr4 = self.throttle_ctr.check_throttled(pod, False)
         except Exception as e:
             return Status(StatusCode.ERROR, (str(e),))
 
         try:
-            clthr_active, clthr_insufficient, clthr_exceeds, clthr_affected = (
-                self.cluster_throttle_ctr.check_throttled(pod, False)
-            )
+            clthr4 = self.cluster_throttle_ctr.check_throttled(pod, False)
         except Exception as e:
             return Status(StatusCode.ERROR, (str(e),))
+
+        return self._compose_prefilter_status(pod, thr4, clthr4)
+
+    def _compose_prefilter_status(self, pod: Pod, thr4, clthr4) -> Status:
+        """Reason composition from both kinds' check_throttled 4-tuples —
+        ordering mirrors plugin.go:182-214 exactly. Shared by the direct
+        path and the micro-batching coalescer (which produces the tuples
+        from one fused dispatch)."""
+        thr_active, thr_insufficient, thr_exceeds, thr_affected = thr4
+        clthr_active, clthr_insufficient, clthr_exceeds, clthr_affected = clthr4
 
         if (
             len(thr_active) + len(thr_insufficient) + len(thr_exceeds)
