@@ -605,7 +605,14 @@ class _KindState:
         broadcast kernel."""
         mask = self.index.mask
         nnz_max = int(mask.sum(axis=1).max()) if mask.size else 0
-        K = _next_pow2(max(nnz_max, 1), lo=4)
+        # TRUE pow2 here, not the ×4 shape ladder: K is a property of the
+        # CLUSTER STATE (max matches per pod), not of a per-call burst — it
+        # changes only on rung escalation, so compile count stays tiny
+        # while padding waste caps at 2× (the ladder padded 20 matches to
+        # 64, tripling every [P,K] batch kernel's work at 100k×10k)
+        K = 4
+        while K < max(nnz_max, 1):
+            K *= 2
         if K * 4 >= max(self.tcap, 16):
             self._cols_host = None
             self._device_cols = None
@@ -1054,11 +1061,13 @@ class DeviceStateManager:
                 state = ks.device_state()
                 pods, _ = ks.device_pods(need_mask=False)
                 live_cols = ks.device_cols()
+            # true pow2 like _rebuild_cols' K (NOT the ×4 ladder) so every
+            # rung the live cols can occupy is warm
             k_rungs = []
             k = 4
             while k * 4 < max(ks.tcap, 16):
                 k_rungs.append(k)
-                k = _next_pow2(k + 1, lo=4)
+                k *= 2
             if on_cpu:
                 k_rungs = k_rungs[:2]
             if live_cols is not None and live_cols.shape[1] not in k_rungs:
