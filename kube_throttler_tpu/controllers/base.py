@@ -17,8 +17,9 @@ from __future__ import annotations
 import logging
 import threading
 from datetime import timedelta
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
+from ..engine.store import EventType
 from ..engine.workqueue import RateLimitingQueue, ShutDown
 from ..utils.tracing import NoopTracer, vlog
 from ..utils.clock import Clock, RealClock
@@ -65,8 +66,26 @@ class ControllerBase:
         self.list_keys_func: Optional[Callable[[], List[str]]] = None
         self._threads: List[threading.Thread] = []
         self._started = False
+        # {store_key: id(status)} of writes in flight — see
+        # _commit_reconcile_plans (self-echo suppression)
+        self._inflight_status_echoes: Dict[str, int] = {}
         if self.resync_interval is not None:
             self.workqueue.add_after(RESYNC_KEY, self.resync_interval)
+
+    def _is_self_status_echo(self, event) -> bool:
+        """True for the MODIFIED echo of a status THIS controller is
+        writing right now: the store dispatches handlers synchronously
+        inside the write, so identity of the exact status object we passed
+        in (keyed, so a recycled id on another key can't match) is a
+        precise signature. Re-enqueueing such an echo is a guaranteed
+        no-op reconcile — the write carried no information the reconcile
+        that produced it hadn't already observed."""
+        obj = event.obj
+        return (
+            event.type == EventType.MODIFIED
+            and self._inflight_status_echoes.get(self._store_key(obj))
+            == id(obj.status)
+        )
 
     def start(self) -> None:
         if self._started:
@@ -132,9 +151,24 @@ class ControllerBase:
         (None ⇒ unsupported), and ``_store_key(thr)``.
         """
         changed = {key: new for key, _, new, _ in plans if new is not None}
-        batched = (
-            self._batch_write_statuses(list(changed.values())) if changed else {}
-        )
+        # self-echo suppression: the store dispatches our own MODIFIED echo
+        # synchronously INSIDE the write below, and _on_throttle_event
+        # re-enqueued the key on every one — at drain saturation ~half of
+        # all drained keys were these no-op self-echo reconciles. Mark the
+        # exact status objects about to be written (identity, per key) so
+        # the handler can recognize and drop the echo; entries are removed
+        # the moment the write returns. Remote-mode echoes arrive later as
+        # freshly-decoded objects (different identity) and still enqueue —
+        # the reference's watch-observe loop is preserved on the wire.
+        for new in changed.values():
+            self._inflight_status_echoes[self._store_key(new)] = id(new.status)
+        try:
+            batched = (
+                self._batch_write_statuses(list(changed.values())) if changed else {}
+            )
+        finally:
+            for new in changed.values():
+                self._inflight_status_echoes.pop(self._store_key(new), None)
         if batched is None:  # no batch writer: interleave per key
             for key, thr, new_thr, unreserve_pods in plans:
                 try:

@@ -353,6 +353,8 @@ class ThrottleController(ControllerBase):
         thr = event.obj
         if not self.is_responsible_for(thr):
             return
+        if self._is_self_status_echo(event):
+            return  # our own in-flight status write; reconciling it is a no-op
         self.enqueue(thr.key)
 
     def _on_pod_event(self, event: Event) -> None:
