@@ -74,17 +74,21 @@ class ControllerBase:
 
     def _is_self_status_echo(self, event) -> bool:
         """True for the MODIFIED echo of a status THIS controller is
-        writing right now: the store dispatches handlers synchronously
-        inside the write, so identity of the exact status object we passed
-        in (keyed, so a recycled id on another key can't match) is a
-        precise signature. Re-enqueueing such an echo is a guaranteed
-        no-op reconcile — the write carried no information the reconcile
-        that produced it hadn't already observed."""
+        writing right now. The store dispatches handlers synchronously
+        inside the write, ON THE WRITER'S OWN THREAD — so the signature is
+        (writing thread, key, identity of the exact status object passed
+        in). The thread check closes the race where a CONCURRENT
+        spec-update write from another thread re-attaches the stored
+        (still-marked) status object via with_status while our marker is
+        live: that event dispatches on the other thread and must still
+        enqueue. Re-enqueueing a true self-echo is a guaranteed no-op
+        reconcile — the write carried no information the reconcile that
+        produced it hadn't already observed."""
         obj = event.obj
         return (
             event.type == EventType.MODIFIED
             and self._inflight_status_echoes.get(self._store_key(obj))
-            == id(obj.status)
+            == (threading.get_ident(), id(obj.status))
         )
 
     def start(self) -> None:
@@ -160,8 +164,9 @@ class ControllerBase:
         # the moment the write returns. Remote-mode echoes arrive later as
         # freshly-decoded objects (different identity) and still enqueue —
         # the reference's watch-observe loop is preserved on the wire.
+        me = threading.get_ident()
         for new in changed.values():
-            self._inflight_status_echoes[self._store_key(new)] = id(new.status)
+            self._inflight_status_echoes[self._store_key(new)] = (me, id(new.status))
         try:
             batched = (
                 self._batch_write_statuses(list(changed.values())) if changed else {}

@@ -224,3 +224,95 @@ def test_gather_ignores_padding_and_invalid_cols():
     assert counts[0].sum() == 1  # only the valid throttle counted
     assert counts[1:].sum() == 0  # invalid pod rows contribute nothing
     assert not bool(np.asarray(ok)[0]) or counts[0, 0] == 1
+
+
+def test_host_single_check_matches_device_kernel():
+    """check_pod's default HOST numpy classifier (_host_classify_rows) must
+    agree cell-for-cell with the device kernel path
+    (KT_SINGLE_CHECK_DEVICE=1) on randomized live state — the two are
+    line-for-line ports of the same 4-step resolution and this pins them
+    together."""
+    import random
+    from dataclasses import replace
+
+    from kube_throttler_tpu.api import ResourceAmount, Throttle, ThrottleSpec
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+    )
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    rng = random.Random(23)
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    plugin = KubeThrottler(
+        decode_plugin_args(
+            {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+        ),
+        store,
+        use_device=True,
+    )
+    for i in range(40):
+        store.create_throttle(
+            Throttle(
+                name=f"t{i}",
+                namespace="default",
+                spec=ThrottleSpec(
+                    throttler_name="kube-throttler",
+                    threshold=ResourceAmount.of(
+                        pod=rng.choice([None, 1, 2, 5]),
+                        requests={
+                            "cpu": f"{rng.randrange(1, 9) * 100}m",
+                            "memory": f"{rng.randrange(1, 5)}Gi",
+                        },
+                    ),
+                    selector=ThrottleSelector(
+                        selector_terms=(
+                            ThrottleSelectorTerm(
+                                LabelSelector(match_labels={"grp": f"g{i % 5}"})
+                            ),
+                        )
+                    ),
+                ),
+            )
+        )
+    for i in range(120):
+        p = make_pod(
+            f"p{i}",
+            namespace="default",
+            labels={"grp": f"g{rng.randrange(5)}"},
+            requests={
+                "cpu": f"{rng.randrange(1, 6) * 100}m",
+                "memory": f"{rng.randrange(1, 3)}Gi",
+            },
+        )
+        p = replace(p, spec=replace(p.spec, node_name="n1"))
+        p.status.phase = "Running"
+        store.create_pod(p)
+    plugin.run_pending_once()
+
+    dm = plugin.device_manager
+    # the test pins BOTH implementations against each other explicitly by
+    # forcing the route per-iteration (the ambient resolution — kernel on
+    # cpu, host on accelerators, KT_SINGLE_CHECK_DEVICE override — is not
+    # under test here)
+    probes = [
+        make_pod(
+            f"q{i}",
+            namespace="default",
+            labels={"grp": f"g{i % 5}"},
+            requests={"cpu": f"{rng.randrange(1, 9) * 100}m"},
+        )
+        for i in range(24)
+    ]
+    for on_equal in (False, True):
+        for kind in ("throttle", "clusterthrottle"):
+            for p in probes:
+                dm._single_check_device = False
+                host = dm.check_pod(p, kind, on_equal)
+                dm._single_check_device = True
+                dev = dm.check_pod(p, kind, on_equal)
+                assert host == dev, (kind, on_equal, p.name, host, dev)
