@@ -715,3 +715,44 @@ def test_drain_requeues_only_failed_status_writes():
             store.get_throttle("default", f"t{i}").status.used.resource_counts == 1
         )
     assert store.get_throttle("default", "t2").status.used.resource_counts is None
+
+
+def test_self_echo_suppression_is_thread_scoped():
+    """The self-echo signature is (writer thread, key, status identity):
+    the SAME event object must suppress on the writing thread and must NOT
+    suppress from any other thread — a concurrent spec-update write
+    re-attaches the stored status object (with_status), and ITS echo,
+    dispatched on the other writer's thread, has to enqueue or a threshold
+    edit would sit until resync (review finding, r5)."""
+    import threading
+
+    from kube_throttler_tpu.api import ResourceAmount, Throttle, ThrottleSpec
+    from kube_throttler_tpu.controllers import ThrottleController
+    from kube_throttler_tpu.engine.store import Event, EventType, Store
+
+    store = Store()
+    ctr = ThrottleController(
+        throttler_name="kube-throttler",
+        target_scheduler_name="my-scheduler",
+        store=store,
+    )
+    thr = Throttle(
+        name="t1", namespace="default",
+        spec=ThrottleSpec(throttler_name="kube-throttler",
+                          threshold=ResourceAmount.of(pod=1)),
+    )
+    ctr._inflight_status_echoes[thr.key] = (
+        threading.get_ident(), id(thr.status),
+    )
+    event = Event(EventType.MODIFIED, "Throttle", thr, old_obj=thr)
+    assert ctr._is_self_status_echo(event) is True
+
+    seen = {}
+    t = threading.Thread(
+        target=lambda: seen.__setitem__("other", ctr._is_self_status_echo(event))
+    )
+    t.start(); t.join()
+    assert seen["other"] is False  # other thread: never suppressed
+
+    ctr._inflight_status_echoes.clear()
+    assert ctr._is_self_status_echo(event) is False  # marker gone
