@@ -228,6 +228,12 @@ class _KindState:
         self._delta_old = None  # snapshot between capture begin/end
         self._counted_device = None
         self._counted_dirty = True
+        # {id(ResourceAmount): (weakref, cnt, req int64[R'], present bool[R'])}
+        # — raw integer rows stashed when aggregate_used_for DECODES a used
+        # amount, so the status-write echo can write the staging row
+        # directly instead of round-tripping Fraction→milli again
+        # (~24µs of the echo's ~43µs); weakref finalizers evict
+        self._used_raw: dict = {}
 
     def _alloc_pods(self, pcap: int) -> None:
         self.pod_req = np.zeros((pcap, self.R), dtype=np.int64)
@@ -409,10 +415,25 @@ class _KindState:
                 eff, "thr_cnt", "thr_cnt_present", "thr_req", "thr_req_present", col
             )
         if not (diff and old.status.used == thr.status.used):
-            self._amount_into_row(
-                thr.status.used,
-                "used_cnt", "used_cnt_present", "used_req", "used_req_present", col,
-            )
+            used = thr.status.used
+            raw = self._used_raw.get(id(used))
+            if raw is not None and raw[0]() is used:
+                # the echo of our own reconcile: the decode that built this
+                # ResourceAmount stashed its exact int64 row — write it
+                # directly, skipping the Fraction→milli re-encode
+                _, cnt_v, req_row, pres_row = raw
+                self.used_cnt[col] = cnt_v
+                self.used_cnt_present[col] = used.resource_counts is not None
+                self.used_req[col, :] = 0
+                self.used_req_present[col, :] = False
+                n = req_row.shape[0]
+                self.used_req[col, :n] = req_row
+                self.used_req_present[col, :n] = pres_row
+            else:
+                self._amount_into_row(
+                    used,
+                    "used_cnt", "used_cnt_present", "used_req", "used_req_present", col,
+                )
         st = thr.status.throttled
         if not (diff and old.status.throttled == st):
             self.st_cnt_throttled[col] = st.resource_counts_pod
@@ -1425,6 +1446,7 @@ class DeviceStateManager:
             self._agg_locks[kind].release()
         with self.tracer.trace("agg_decode"):
             names = self.dims.names
+            raw_cache = ks._used_raw
             for i, key in enumerate(valid_keys):
                 if cnt[i] <= 0:
                     continue  # stays the nil ResourceAmount
@@ -1433,12 +1455,24 @@ class DeviceStateManager:
                     for j in range(min(len(names), req.shape[1]))
                     if ctb[i, j] > 0
                 }
-                out[key] = (
-                    ResourceAmount(
-                        resource_counts=int(cnt[i]), resource_requests=requests
-                    ),
-                    out[key][1],
+                amt = ResourceAmount(
+                    resource_counts=int(cnt[i]), resource_requests=requests
                 )
+                # stash the raw int64 row beside the decoded amount so the
+                # status-write echo (set_throttle_row) writes the staging
+                # row without re-deriving milli values from Fractions
+                pres = ctb[i] > 0
+                try:
+                    ref = weakref.ref(
+                        amt, lambda _, k=id(amt), c=raw_cache: c.pop(k, None)
+                    )
+                except TypeError:
+                    pass
+                else:
+                    raw_cache[id(amt)] = (
+                        ref, int(cnt[i]), np.where(pres, req[i], 0), pres
+                    )
+                out[key] = (amt, out[key][1])
             return out
 
     # -- queries ----------------------------------------------------------
