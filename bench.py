@@ -1491,6 +1491,8 @@ def main():
                     detail["fullscale_batch_pods_per_sec"] = round(
                         b["pods_per_sec"]
                     )
+                    tick_f = bench_served_tick(plugin_f, "served-full")
+                    detail["fullscale_tick_ms"] = round(tick_f * 1e3)
                     plugin_f.start()
                     sf = bench_served_streaming(
                         store_f, plugin_f, "served-full",
