@@ -1491,8 +1491,13 @@ def main():
                     detail["fullscale_batch_pods_per_sec"] = round(
                         b["pods_per_sec"]
                     )
-                    tick_f = bench_served_tick(plugin_f, "served-full")
-                    detail["fullscale_tick_ms"] = round(tick_f * 1e3)
+                    try:
+                        tick_f = bench_served_tick(plugin_f, "served-full")
+                        detail["fullscale_tick_ms"] = round(tick_f * 1e3)
+                    except Exception as e:  # noqa: BLE001 — isolate like
+                        # safe('served:tick'): a tick failure must not drop
+                        # the downstream full-scale cfg5 measurements
+                        errors["served-full:tick"] = f"{e.__class__.__name__}: {e}"
                     plugin_f.start()
                     sf = bench_served_streaming(
                         store_f, plugin_f, "served-full",
