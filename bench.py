@@ -1487,6 +1487,23 @@ def main():
                 )
                 detail["fullscale_setup_s"] = round(time.perf_counter() - t0, 1)
                 try:
+                    # the BASELINE north-star metric AT ITS OWN SCALE:
+                    # per-decision PreFilter percentiles against the live
+                    # 100k×10k daemon state (the gather path is O(K·R), so
+                    # this also demonstrates decision cost ~independent of
+                    # cluster size). Becomes the headline when present.
+                    fs_stats, fs_r1, fs_r4, fs_r4co = bench_served_prefilter(
+                        plugin_f, "served-full", n=1200
+                    )
+                    detail["fullscale_p50_ms"] = round(fs_stats["p50"] * 1e3, 4)
+                    detail["fullscale_p99_ms"] = round(fs_stats["p99"] * 1e3, 4)
+                    detail["fullscale_decisions_per_sec"] = round(
+                        fs_stats["decisions_per_sec_median"]
+                    )
+                    detail["fullscale_decisions_cv"] = round(
+                        fs_stats["decisions_cv"], 4
+                    )
+                    RESULT_STATE["served_stats_full"] = fs_stats
                     b = bench_served_batch(plugin_f, "served-full", iters=3)
                     detail["fullscale_batch_pods_per_sec"] = round(
                         b["pods_per_sec"]
@@ -1556,6 +1573,14 @@ def build_result() -> dict:
     scale = RESULT_STATE.get("scale", 10)
 
     target_ms = 1.0  # BASELINE north star: <1ms p99 on one v5e-1
+    # when the full-scale (100k×10k) per-decision measurement ran, IT is
+    # the headline — the north-star metric at the north-star scale; the
+    # quick-scale percentiles stay in detail (served_p99_raw_ms etc.)
+    served_stats_full = RESULT_STATE.get("served_stats_full")
+    headline_scale = scale
+    if served_stats_full is not None:
+        served_stats = served_stats_full
+        headline_scale = 1
     if served_stats is not None:
         # THE headline: end-to-end PreFilter through the real daemon stack.
         # ONLY the 'axon' platform (this environment's network tunnel to a
@@ -1574,7 +1599,12 @@ def build_result() -> dict:
         tunnel_s = rtt if (rtt and platform != "cpu" and rtt > 0.010) else 0.0
         value_ms = max((served_stats["p99"] - tunnel_s) * 1e3, 1e-3)
         detail["served_p99_raw_ms"] = round(raw_p99_ms, 4)
-        detail["served_p50_raw_ms"] = detail.pop("served_p50_ms", None)
+        if served_stats_full is not None:
+            # headline is the full-scale measurement; its p50 pairs with it
+            # (the quick-scale p50 stays under served_p50_ms)
+            detail["served_p50_raw_ms"] = round(served_stats_full["p50"] * 1e3, 4)
+        else:
+            detail["served_p50_raw_ms"] = detail.pop("served_p50_ms", None)
         if tunnel_s:
             detail["tunnel_rtt_subtracted_ms"] = round(tunnel_s * 1e3, 2)
         if single_stats is not None:
@@ -1582,7 +1612,10 @@ def build_result() -> dict:
                 max(float(single_stats["p99"]) * 1e3, 1e-4), 4
             )
             detail["single_cv"] = round(single_stats["cv"], 4)
-        state_label = f"{100_000 // scale // 1000}k-pod/{10_000 // scale // 1000}k-throttle"
+        state_label = (
+            f"{100_000 // headline_scale // 1000}k-pod/"
+            f"{10_000 // headline_scale // 1000}k-throttle"
+        )
         metric = (
             "SERVED PreFilter decision p99 latency: plugin.pre_filter end-to-end "
             f"(device-indexed check) vs live {state_label} daemon state, "
