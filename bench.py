@@ -1492,8 +1492,11 @@ def main():
                     # 100k×10k daemon state (the gather path is O(K·R), so
                     # this also demonstrates decision cost ~independent of
                     # cluster size). Becomes the headline when present.
+                    # n sized for headline stability: 3 interleaved bands of
+                    # 800 calls ≈ 0.9s each at full scale — short bands sat
+                    # inside single scheduler slices and read CV ~0.26
                     fs_stats, fs_r1, fs_r4, fs_r4co = bench_served_prefilter(
-                        plugin_f, "served-full", n=1200
+                        plugin_f, "served-full", n=2400
                     )
                     detail["fullscale_p50_ms"] = round(fs_stats["p50"] * 1e3, 4)
                     detail["fullscale_p99_ms"] = round(fs_stats["p99"] * 1e3, 4)
