@@ -36,10 +36,12 @@ compiled variants; shapes are padded so object churn never recompiles.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .schema import PodBatch, ThrottleState
 
@@ -67,22 +69,25 @@ def _classify_core(
     thr_cnt, thr_cnt_present, thr_req, thr_req_present,
     st_cnt_throttled, st_req_flag_present, st_req_throttled,
     au_cnt, au_cnt_present, au_req, au_req_present,
-    on_equal: bool, step3_on_equal: bool,
+    on_equal: bool, step3_on_equal: bool, axis: int = -1,
 ):
     """The 4-step ordered resolution on broadcast-compatible operands:
-    pod side [P,1(,R)], throttle side [1,T(,R)] (dense) or [P,K(,R)]
-    (gather). One body ⇒ the dense and sparse kernels cannot drift."""
+    pod side [P,1(,R)], throttle side [1,T(,R)] (dense) or R-leading
+    [R,P,1] / [R,P,K] (gather — see _gather_statuses for why). ``axis``
+    names the R dimension of the per-resource operands; the count-side
+    operands never carry it. One body ⇒ the dense and sparse kernels
+    cannot drift."""
     # --- step 1: pod alone vs threshold (onEqual=False) -------------------
     # pod count is always 1 and always present
     exceeds_cnt = thr_cnt_present & (1 > thr_cnt)
     exceeds_req = jnp.any(
-        thr_req_present & pod_present & (pod_req > thr_req) & (pod_req != 0), axis=-1
+        thr_req_present & pod_present & (pod_req > thr_req) & (pod_req != 0), axis=axis
     )
     exceeds = exceeds_cnt | exceeds_req
 
     # --- step 2: persisted throttled flags --------------------------------
     st_active = st_cnt_throttled | jnp.any(
-        st_req_flag_present & st_req_throttled & pod_nonzero, axis=-1
+        st_req_flag_present & st_req_throttled & pod_nonzero, axis=axis
     )
 
     # --- step 3: used + reserved saturation -------------------------------
@@ -92,7 +97,7 @@ def _classify_core(
         & au_req_present
         & _cmp(au_req, thr_req, step3_on_equal)
         & pod_nonzero,
-        axis=-1,
+        axis=axis,
     )
     saturated = sat_cnt | sat_req
 
@@ -108,7 +113,7 @@ def _classify_core(
         & tot_req_present
         & _cmp(tot_req, thr_req, on_equal)
         & pod_nonzero,
-        axis=-1,
+        axis=axis,
     )
     insufficient = over_cnt | over_req
 
@@ -216,33 +221,89 @@ def check_pods_gather(state: ThrottleState, pods: PodBatch, cols: jnp.ndarray,
             f"cols shape {cols.shape} != (P={pods.req.shape[0]}, K)"
         )
     return statuses_to_compact(
-        _gather_statuses(state, pods, cols, on_equal, step3_on_equal)
+        _gather_statuses_blocked(state, pods, cols, on_equal, step3_on_equal)
     )
 
 
 def _gather_statuses(state, pods, cols, on_equal, step3_on_equal):
     """Shared body of the sparse gather kernels: int8[P,K] per-slot
-    statuses (CHECK_NOT_AFFECTED for padded/invalid slots)."""
+    statuses (CHECK_NOT_AFFECTED for padded/invalid slots).
+
+    Orientation: the per-resource operands are gathered R-LEADING —
+    ``state.thr_req.T[:, c]`` → [R,P,K] — not the naive ``thr_req[c]`` →
+    [P,K,R]. TPU tiles the two minor dims (8,128): an R-minor gather
+    result pads R=8 → 128 lanes, a 16× memory/bandwidth expansion that
+    OOM'd the 100k×10k prewarm on a 16G v5e (4G per gathered u32 operand,
+    observed r5). R-leading puts K on the lane dim (pads ≤2× at K=64 and
+    not at all from 128 up) and R on the cheap outer dim; the reduction
+    over R becomes ``axis=0``."""
     c = jnp.maximum(cols, 0)  # [P,K]; padded slots gather col 0 then mask out
     slot = (cols >= 0) & state.valid[c] & pods.valid[:, None]
 
-    pod_req = pods.req[:, None, :]
-    pod_present = pods.req_present[:, None, :]
+    def g(a):  # [T,R] per-resource state → [R,P,K]
+        return a.T[:, c]
+
+    pod_req = pods.req.T[:, :, None]  # [R,P,1]
+    pod_present = pods.req_present.T[:, :, None]
     pod_nonzero = pod_present & (pod_req != 0)
 
     result = _classify_core(
         pod_req, pod_present, pod_nonzero,
         state.thr_cnt[c], state.thr_cnt_present[c],
-        state.thr_req[c], state.thr_req_present[c],
+        g(state.thr_req), g(state.thr_req_present),
         state.st_cnt_throttled[c],
-        state.st_req_flag_present[c], state.st_req_throttled[c],
+        g(state.st_req_flag_present), g(state.st_req_throttled),
         (state.used_cnt + state.res_cnt)[c],
         (state.used_cnt_present | state.res_cnt_present)[c],
-        (state.used_req + state.res_req)[c],
-        (state.used_req_present | state.res_req_present)[c],
-        on_equal, step3_on_equal,
+        g(state.used_req + state.res_req),
+        g(state.used_req_present | state.res_req_present),
+        on_equal, step3_on_equal, axis=0,
     )
     return jnp.where(slot, result, jnp.int8(CHECK_NOT_AFFECTED))
+
+
+# Peak-footprint governor for the sparse gather kernels: a [P,K] dispatch
+# materializes ~6 gathered [R,P,K] operands (u32 limbs + presence preds),
+# so an unbounded P×K — the 2048-col rung at the 131072-pod ladder cap is
+# 2.1G elements — cannot be dispatched as one program on a 16G chip. Blocks
+# of ≤ KT_GATHER_CHUNK_ELEMS padded elements (R × P_block × K_padded) run
+# under lax.map: one compiled block body, device-serial blocks, bit-
+# identical statuses. 64M elems ≈ 256M per u32 operand ≈ ~1.5G peak.
+_GATHER_CHUNK_ELEMS = int(os.environ.get("KT_GATHER_CHUNK_ELEMS", str(64 * 1024 * 1024)))
+
+
+def _gather_statuses_blocked(state, pods, cols, on_equal, step3_on_equal):
+    """_gather_statuses, chunked over P when the padded gather footprint
+    exceeds _GATHER_CHUNK_ELEMS. Shapes are static under jit, so the block
+    decomposition is a trace-time decision; P is padded to a whole number
+    of blocks with invalid pods (slot masking already yields
+    CHECK_NOT_AFFECTED there) and sliced back."""
+    P, K = cols.shape
+    R = pods.req.shape[1]
+    k_pad = max(K, 128)  # lane-dim tile: K below 128 pads up to 128
+    if P * k_pad * R <= _GATHER_CHUNK_ELEMS:
+        return _gather_statuses(state, pods, cols, on_equal, step3_on_equal)
+    pb = max(1, _GATHER_CHUNK_ELEMS // (k_pad * R))
+    nb = -(-P // pb)
+    pad = nb * pb - P
+
+    def padp(a):
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    pods_b = PodBatch(
+        valid=padp(pods.valid).reshape(nb, pb),
+        req=padp(pods.req).reshape(nb, pb, R),
+        req_present=padp(pods.req_present).reshape(nb, pb, R),
+    )
+    cols_b = jnp.pad(cols, ((0, pad), (0, 0)), constant_values=-1).reshape(nb, pb, K)
+
+    def block(xs):
+        bpods, bcols = xs
+        return _gather_statuses(state, bpods, bcols, on_equal, step3_on_equal)
+
+    out = lax.map(block, (pods_b, cols_b))  # [nb, pb, K] int8
+    return out.reshape(nb * pb, K)[:P]
 
 
 @partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
@@ -254,7 +315,7 @@ def check_pods_gather_statuses(
     instead of compact counts — the micro-batching pre_filter front-end
     needs each pod's per-throttle classification to build reference reason
     strings (plugin.go:182-214), not just the verdict."""
-    return _gather_statuses(state, pods, cols, on_equal, step3_on_equal)
+    return _gather_statuses_blocked(state, pods, cols, on_equal, step3_on_equal)
 
 
 @partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))

@@ -157,15 +157,24 @@ def full_update_step_gather(
     slot = (cols >= 0) & (counted & pods.valid)[:, None]  # [P,K]
     tgt = jnp.where(slot, cols, T).reshape(-1)  # T = out of range ⇒ dropped
     used_cnt = jnp.zeros(T, dtype=jnp.int64).at[tgt].add(1, mode="drop")
-    req_rows = jnp.broadcast_to(pods.req[:, None, :], (P_, K, R)).reshape(P_ * K, R)
+    # R-LEADING scatter operands: the naive [P·K, R] update-row matrix
+    # tile-pads R=8 → 128 lanes on TPU — a 16× expansion (8.6G at the
+    # 131072-pod ladder cap), the same OOM class the gather kernels hit
+    # (see ops/check.py _gather_statuses). With [R, P·K] rows scattering
+    # into an [R, T] accumulator the huge P·K count rides the un-padded
+    # lane dim and R the sublane dim; transposing back costs one [T,R].
+    req_rows = jnp.broadcast_to(pods.req.T[:, :, None], (R, P_, K)).reshape(R, P_ * K)
     pres_rows = jnp.broadcast_to(
-        pods.req_present[:, None, :], (P_, K, R)
-    ).reshape(P_ * K, R)
-    used_req = jnp.zeros((T, R), dtype=jnp.int64).at[tgt].add(req_rows, mode="drop")
+        pods.req_present.T[:, :, None], (R, P_, K)
+    ).reshape(R, P_ * K)
+    used_req = (
+        jnp.zeros((R, T), dtype=jnp.int64).at[:, tgt].add(req_rows, mode="drop").T
+    )
     contrib = (
-        jnp.zeros((T, R), dtype=jnp.int32)
-        .at[tgt]
+        jnp.zeros((R, T), dtype=jnp.int32)
+        .at[:, tgt]
         .add(pres_rows.astype(jnp.int32), mode="drop")
+        .T
     )
     used_cnt_present = used_cnt > 0
     used_req_present = contrib > 0

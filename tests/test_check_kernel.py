@@ -205,6 +205,41 @@ def test_gather_matches_compact(kind, on_equal, seed):
     np.testing.assert_array_equal(np.asarray(got_ok), np.asarray(want_ok))
 
 
+@pytest.mark.parametrize("seed", [0, 7])
+def test_gather_blocked_matches_unblocked(seed, monkeypatch):
+    """The P-chunked gather decomposition (lax.map blocks, activated when
+    P×K_pad×R exceeds KT_GATHER_CHUNK_ELEMS — the r5 full-scale TPU OOM
+    fix) must be bit-identical to the single-dispatch path, including when
+    P does not divide evenly into blocks."""
+    from kube_throttler_tpu.ops import check, check_pods_gather
+
+    rng = random.Random(seed)
+    throttles, reserved, pods = _build_objects(rng, n_throttles=9, n_pods=29, kind="throttle")
+    dims = DimRegistry()
+    state = encode_throttle_state(throttles, dims, reserved=reserved)
+    batch = encode_pods(pods, dims)
+    mask = np.asarray(
+        rng.choices([True, False], weights=[1, 3], k=len(pods) * len(throttles))
+    ).reshape(len(pods), len(throttles))
+    cols = _cols_of_mask(mask, K=max(1, int(mask.sum(axis=1).max())))
+
+    # un-jitted bodies: the jitted wrappers cache by shape, so the chunk
+    # threshold (read at trace time) must be exercised through the raw
+    # functions for the monkeypatch to take effect
+    want = np.asarray(check._gather_statuses(state, batch, cols, False, True))
+    # force ~4-row blocks (29 pods ⇒ a ragged final block exercises padding)
+    monkeypatch.setattr(
+        check, "_GATHER_CHUNK_ELEMS", 4 * max(cols.shape[1], 128) * batch.req.shape[1]
+    )
+    got = np.asarray(check._gather_statuses_blocked(state, batch, cols, False, True))
+    np.testing.assert_array_equal(got, want)
+    # and through the compact reduction (counts + schedulable gate)
+    want_c, want_ok = check_pods_gather(state, batch, cols)
+    got_c = check.statuses_to_compact(got)
+    np.testing.assert_array_equal(np.asarray(got_c[0]), np.asarray(want_c))
+    np.testing.assert_array_equal(np.asarray(got_c[1]), np.asarray(want_ok))
+
+
 def test_gather_ignores_padding_and_invalid_cols():
     """-1 pad slots and cols pointing at invalid (freed) throttle slots must
     contribute nothing."""
