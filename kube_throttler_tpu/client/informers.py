@@ -71,6 +71,15 @@ class Indexer:
         with self._lock:
             return self._objects.get(key)
 
+    def get_many(self, keys) -> List[object]:
+        """Batch ``get`` under ONE lock hold — None per missing key. The
+        serving hot path resolves ~K affected-throttle objects per
+        decision; per-key get() paid a lock acquire + two frames each
+        (~3µs × K measured at the 100k×10k scale)."""
+        with self._lock:
+            g = self._objects.get
+            return [g(k) for k in keys]
+
     def list(self) -> List[object]:
         with self._lock:
             return list(self._objects.values())

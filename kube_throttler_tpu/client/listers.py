@@ -52,6 +52,11 @@ class ThrottleLister:
     def throttles(self, namespace: str) -> ThrottleNamespaceLister:
         return ThrottleNamespaceLister(self._indexer, namespace)
 
+    def get_by_keys(self, keys) -> List[Optional[Throttle]]:
+        """Bulk fetch by full "ns/name" store keys (one indexer lock hold);
+        None per missing key. Serving fast path — see Indexer.get_many."""
+        return self._indexer.get_many(keys)
+
 
 class ClusterThrottleLister:
     def __init__(self, indexer: Indexer) -> None:
@@ -65,6 +70,11 @@ class ClusterThrottleLister:
         if obj is None:
             raise KeyError(f"clusterthrottle {name} not found")
         return obj
+
+    def get_by_names(self, names) -> List[Optional[ClusterThrottle]]:
+        """Bulk fetch by bare names (one indexer lock hold); None per
+        missing name. Serving fast path — see Indexer.get_many."""
+        return self._indexer.get_many(names)
 
 
 class PodNamespaceLister:

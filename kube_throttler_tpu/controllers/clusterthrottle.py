@@ -342,10 +342,22 @@ class ClusterThrottleController(ControllerBase):
 
     def classify_from_map(self, results: Dict[str, str]):
         """See ThrottleController.classify_from_map (cluster keys carry no
-        namespace prefix)."""
+        namespace prefix; same bulk resolution + skip-deleted semantics)."""
         active, insufficient, exceeds, affected = [], [], [], []
-        for key, status in results.items():
-            thr = self._get_cluster_throttle(key.lstrip("/"))
+        if self.listers is not None:
+            objs = self.listers.cluster_throttles.get_by_names(
+                [key.lstrip("/") for key in results]
+            )
+        else:
+            objs = []
+            for key in results:
+                try:
+                    objs.append(self.store.get_cluster_throttle(key.lstrip("/")))
+                except NotFoundError:
+                    objs.append(None)
+        for (key, status), thr in zip(results.items(), objs):
+            if thr is None:
+                continue
             affected.append(thr)
             if status == "active":
                 active.append(thr)

@@ -321,11 +321,29 @@ class ThrottleController(ControllerBase):
         """Device classification map {throttle_key: status} → the
         check_throttled 4-tuple. Shared by the per-pod device path and the
         micro-batching pre_filter front-end (one fused dispatch produces
-        many pods' maps; each composes reasons through this same code)."""
+        many pods' maps; each composes reasons through this same code).
+
+        Object resolution is BULK (one indexer lock hold for all K keys):
+        the per-key lister chain (namespace-lister alloc + lock + dict
+        layers) measured ~3µs × ~20 affected keys × 2 kinds per decision
+        at the 100k×10k scale — a third of the served p50. A key whose
+        object vanished between the device snapshot and here (concurrent
+        delete) is skipped: a deleted throttle cannot block scheduling,
+        matching the lister-backed affectedThrottles behavior
+        (throttle_controller.go:221-269 drops not-found keys)."""
         active, insufficient, exceeds, affected = [], [], [], []
-        for key, status in results.items():
-            namespace, _, name = key.partition("/")
-            thr = self._get_throttle(namespace, name)
+        if self.listers is not None:
+            objs = self.listers.throttles.get_by_keys(list(results.keys()))
+        else:
+            objs = []
+            for key in results:
+                try:
+                    objs.append(self.store.get_throttle(*key.split("/", 1)))
+                except NotFoundError:
+                    objs.append(None)
+        for (key, status), thr in zip(results.items(), objs):
+            if thr is None:
+                continue
             affected.append(thr)
             if status == "active":
                 active.append(thr)

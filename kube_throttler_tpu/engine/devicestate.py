@@ -159,6 +159,71 @@ def _host_classify_rows(rows, pod_req, pod_present, on_equal, step3_on_equal):
     return np.where(valid, out, np.int8(CHECK_NOT_AFFECTED))
 
 
+_cls_lib = None
+_cls_lib_tried = False
+
+
+def _native_cls_lib():
+    """The native classifier tier (ktn_cls_* in native/ktnative.cpp), or
+    None (no toolchain / KT_TPU_NO_NATIVE=1 → numpy tier). Cached to keep
+    the per-decision cost to one global read."""
+    global _cls_lib, _cls_lib_tried
+    if not _cls_lib_tried:
+        from ..native import load
+
+        _cls_lib = load()
+        _cls_lib_tried = True
+    return _cls_lib
+
+
+def _native_classify_cols(lib, ks, cols, pod_req_row, pod_present_row, on_equal, step3):
+    """ktn_cls_run over the kind's LIVE staging planes — caller holds the
+    main lock, so the C++ K×R pass (sub-µs) reads a coherent snapshot with
+    zero [K,R] gather copies and zero per-call numpy allocation. Plane
+    pointers are registered into a C-side handle once per staging
+    allocation; the identity check re-registers after capacity growth
+    (ensure_capacity reallocates, logarithmically under the ladder).
+    Semantics are pinned to _host_classify_rows (numpy tier) AND the
+    device kernel by test_host_single_check_matches_device_kernel, whose
+    final section forces the numpy tier through the module lib cache."""
+    planes = (
+        ks.thr_valid,
+        ks.thr_cnt, ks.thr_cnt_present, ks.thr_req, ks.thr_req_present,
+        ks.st_cnt_throttled, ks.st_req_flag_present, ks.st_req_throttled,
+        ks.used_cnt, ks.used_cnt_present, ks.used_req, ks.used_req_present,
+        ks.res_cnt, ks.res_cnt_present, ks.res_req, ks.res_req_present,
+    )
+    cached = ks._cls_cache
+    if (
+        cached is None
+        or cached[0] != ks.R
+        or any(a is not b for a, b in zip(cached[1], planes))
+    ):
+        if cached is not None:
+            lib.ktn_cls_destroy(cached[2])
+        handle = lib.ktn_cls_create(ks.R, *(a.ctypes.data for a in planes))
+        # the tuple keeps the registered arrays alive for the handle's raw
+        # pointers; replaced wholesale on the next growth
+        ks._cls_cache = (ks.R, planes, handle)
+    else:
+        handle = cached[2]
+    K = cols.shape[0]
+    sc = ks._cls_scratch
+    if sc is None or sc[0].shape[0] < K:
+        cap = max(64, 1 << (int(K) - 1).bit_length())
+        sc = (np.empty(cap, dtype=np.int32), np.empty(cap, dtype=np.int8))
+        ks._cls_scratch = sc
+    cbuf, obuf = sc
+    cbuf[:K] = cols
+    lib.ktn_cls_run(
+        handle, K, cbuf.ctypes.data,
+        pod_req_row.ctypes.data, pod_present_row.ctypes.data,
+        int(on_equal), int(step3), obuf.ctypes.data,
+    )
+    # copy: the scratch is reused by the next decision once the lock drops
+    return obuf[:K].copy()
+
+
 def _pad_pow2(idx: np.ndarray, lo: int = 8) -> np.ndarray:
     """Pad a 1-D index array to the next ladder rung by repeating its
     first element (a duplicate scatter index writing the same value is a
@@ -184,6 +249,11 @@ class _KindState:
         self._alloc_throttles(tcap)
         self.dirty_pods = True
         self.dirty_throttles = True
+        # native single-pod classifier: (R, planes tuple, C handle int) —
+        # re-registered when any staging plane is reallocated (identity
+        # check in _native_classify_cols); scratch = (cols i32, out i8)
+        self._cls_cache = None
+        self._cls_scratch = None
         self._device_state: Optional[ThrottleState] = None
         self._device_packed = None  # CheckPrecompPacked cache for check_pod
         self._device_pods: Optional[PodBatch] = None
@@ -1565,6 +1635,7 @@ class DeviceStateManager:
             dense = None
             rows = None
             packed = None
+            out_k = None
             with self._lock:
                 ks = self.throttle if kind == "throttle" else self.clusterthrottle
                 ks.ensure_capacity()
@@ -1596,15 +1667,25 @@ class DeviceStateManager:
                     if not self._resolve_single_check_route():
                         # HOST path (accelerator backends): a single pod's
                         # check is a [K,R] computation over rows that live
-                        # in host staging anyway — numpy beats a device
-                        # ROUND TRIP (~70ms through a remote-TPU tunnel)
-                        # by orders of magnitude. Fancy indexing copies
-                        # under the lock = coherent snapshot; arithmetic
-                        # runs outside. The device keeps the BATCH
+                        # in host staging anyway — host arithmetic beats a
+                        # device ROUND TRIP (~70ms through a remote-TPU
+                        # tunnel) by orders of magnitude. Native tier runs
+                        # the whole 4-step pass in C++ against the live
+                        # planes under the lock (sub-µs — the ~20-numpy-op
+                        # pass measured ~50µs/kind at 100k×10k); numpy
+                        # tier snapshots rows under the lock and
+                        # classifies outside. The device keeps the BATCH
                         # surfaces, where parallelism actually pays. (On
                         # the CPU backend the fused kernel wins instead —
                         # see _resolve_single_check_route.)
-                        rows = self._gather_check_rows(ks, cols)
+                        lib = _native_cls_lib()
+                        if lib is not None:
+                            out_k = _native_classify_cols(
+                                lib, ks, cols, row_req[0], row_present[0],
+                                on_equal, step3,
+                            )
+                        else:
+                            rows = self._gather_check_rows(ks, cols)
                     else:
                         packed = ks.device_packed()
                 else:
@@ -1616,7 +1697,7 @@ class DeviceStateManager:
                     out_k = _host_classify_rows(
                         rows, row_req[0], row_present[0], on_equal, step3
                     )
-                else:
+                elif out_k is None:
                     # device A/B path (KT_SINGLE_CHECK_DEVICE=1): classify
                     # the K affected rows against the cached packed
                     # precomp — O(K·R) device AND host work, independent

@@ -124,6 +124,19 @@ def _declare(lib: ctypes.CDLL) -> None:
         _u8p, _u8p,
     ]
     lib.ktn_match_row.restype = None
+    # single-pod classifier: planes registered once per staging allocation
+    # (ktn_cls_create), per-call args are raw pointer ints (c_void_p) so the
+    # hot call marshals 8 scalars instead of 22 numpy data_as conversions
+    lib.ktn_cls_create.argtypes = [ctypes.c_int32] + [ctypes.c_void_p] * 16
+    lib.ktn_cls_create.restype = ctypes.c_void_p
+    lib.ktn_cls_destroy.argtypes = [ctypes.c_void_p]
+    lib.ktn_cls_destroy.restype = None
+    lib.ktn_cls_run.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+    ]
+    lib.ktn_cls_run.restype = None
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -155,7 +168,11 @@ def load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(str(so))
             _declare(lib)
             _lib = lib
-        except OSError as exc:
+        except (OSError, AttributeError) as exc:
+            # AttributeError: a prebuilt .so predating a symbol added to
+            # _declare (archive extraction can set mtimes that defeat the
+            # source-mtime freshness check) — degrade to the Python tier
+            # like any other load failure instead of crashing the caller
             logger.warning(
                 "native selector engine load failed (%s); falling back to the "
                 "pure-Python row-match tier",

@@ -351,3 +351,29 @@ def test_host_single_check_matches_device_kernel():
                 dm._single_check_device = True
                 dev = dm.check_pod(p, kind, on_equal)
                 assert host == dev, (kind, on_equal, p.name, host, dev)
+
+    # the host route has two tiers (native C++ ktn_cls_run when the lib
+    # loads, numpy _host_classify_rows otherwise); pin them against each
+    # other too by forcing the numpy tier via the module-level lib cache
+    from kube_throttler_tpu.engine import devicestate as ds
+
+    if ds._native_cls_lib() is not None:
+        dm._single_check_device = False
+        native_res = [
+            dm.check_pod(p, k, oe)
+            for oe in (False, True)
+            for k in ("throttle", "clusterthrottle")
+            for p in probes
+        ]
+        old = (ds._cls_lib, ds._cls_lib_tried)
+        ds._cls_lib, ds._cls_lib_tried = None, True
+        try:
+            numpy_res = [
+                dm.check_pod(p, k, oe)
+                for oe in (False, True)
+                for k in ("throttle", "clusterthrottle")
+                for p in probes
+            ]
+        finally:
+            ds._cls_lib, ds._cls_lib_tried = old
+        assert native_res == numpy_res
