@@ -279,25 +279,49 @@ class KubeThrottler:
         unknown namespaces to errors (the per-pod path returns ERROR for
         them, clusterthrottle_controller.go:273-276 — the batch surfaces
         must never report them schedulable). Shared by pre_filter_batch and
-        full_tick_sharded so the two surfaces cannot drift."""
+        full_tick_sharded so the two surfaces cannot drift.
+
+        Merge shape: the first kind's verdicts build the result dict in one
+        C-speed ``dict(zip(...))``; later kinds only FLIP the rows they
+        block (np.nonzero of the inverted verdicts — blocked pods are the
+        sparse case) plus a subset check for pods the first kind didn't
+        carry. The former per-pod Python AND (2×100k dict ops) measured
+        ~60ms of every full-scale batch call. The namespace routing stays
+        key-derived (one partition per verdict key): deriving it from the
+        pod informer's namespace index instead would make the
+        never-schedulable invariant timing-dependent — a pod the device
+        mirror has seen but the pod informer has not yet indexed would
+        slip through."""
         import numpy as np
 
         schedulable: dict = {}
         errors: list = []
-        for _, (ok, rows) in per_kind.items():
+        for j, (ok, rows) in enumerate(per_kind.values()):
             # one vectorized gather per kind instead of a scalar numpy
             # index per pod (ok[row] costs ~µs each; at 100k pods the
             # per-item form dominated the whole batch call)
             ok = np.asarray(ok)
             idx = np.fromiter(rows.values(), dtype=np.int64, count=len(rows))
-            vals = ok[idx].tolist()
-            for key, v in zip(rows.keys(), vals):
-                schedulable[key] = v and schedulable.get(key, True)
-        for key in list(schedulable):
-            ns, _, _ = key.partition("/")
-            if ns not in known_ns:
-                del schedulable[key]
-                errors.append(key)
+            vals = ok[idx]
+            if j == 0:
+                schedulable = dict(zip(rows.keys(), vals.tolist()))
+                continue
+            keys_list = None  # built only when this kind changes anything
+            blocked = np.nonzero(~vals)[0]
+            if blocked.size:
+                keys_list = list(rows.keys())
+                for i in blocked.tolist():
+                    schedulable[keys_list[i]] = False
+            if not (rows.keys() <= schedulable.keys()):  # C-speed subset probe
+                if keys_list is None:
+                    keys_list = list(rows.keys())
+                for k, v in zip(keys_list, vals.tolist()):
+                    if k not in schedulable:
+                        schedulable[k] = v
+        bad = [k for k in schedulable if k.partition("/")[0] not in known_ns]
+        for key in bad:
+            del schedulable[key]
+            errors.append(key)
         return schedulable, errors
 
     def full_tick_sharded(self, n_devices: Optional[int] = None, shape=None) -> dict:
