@@ -1663,7 +1663,10 @@ class DeviceStateManager:
                     return {}
                 if cols.size <= self.indexed_check_max:
                     ck = ks.index._col_keys
-                    col_keys = [ck.get(int(c)) for c in cols]
+                    # tolist() converts the whole cols vector in C; the
+                    # per-element int(c) form paid a numpy-scalar box per
+                    # col (~240k dict.get+int calls per 6k decisions)
+                    col_keys = list(map(ck.get, cols.tolist()))
                     if not self._resolve_single_check_route():
                         # HOST path (accelerator backends): a single pod's
                         # check is a [K,R] computation over rows that live
@@ -1844,17 +1847,24 @@ class DeviceStateManager:
         )
         return counts, schedulable, row_map
 
-    def full_tick_sharded(self, mesh, on_equal: bool = False, now=None):
+    def full_tick_sharded(self, mesh, on_equal: bool = False, now=None,
+                          dense_mesh: bool = False):
         """Both kinds' COMPLETE tick over a ("pods","throttles") device
         Mesh — the multi-chip serving path for bulk triage at cluster
         scale. One shard_map program per kind (parallel/sharded.py)
         resolves time-varying thresholds from the override schedule,
         re-aggregates ``used`` from the live pod set, recomputes the
         throttled flags, and classifies every (pod × throttle) admission
-        cell; each device owns a [P/dp, T/tp] tile and the only
-        cross-device traffic is two psum all-reduces (used partials over
-        the pods axis, verdict counts over the throttles axis) — no [P,T]
-        global tensor ever exists on any device.
+        cell; the only cross-device traffic is two psum all-reduces (used
+        partials over the pods axis, verdict counts over the throttles
+        axis) — no [P,T] global tensor ever exists on any device.
+
+        Route: whenever the sparse [P,K] cols companion exists it is the
+        program on EVERY mesh — single-chip ``full_update_step_gather``,
+        multi-chip ``sharded_full_update_gather`` (O(P·K/dp) per-device
+        work; cols rebase per throttle tile). The dense [P/dp, T/tp]
+        tiled program remains for near-dense masks and under
+        ``dense_mesh=True`` (A/B and parity testing).
 
         Semantics: unlike ``check_batch`` (which classifies against the
         WRITTEN statuses, exactly what the reference's PreFilter reads —
@@ -1869,7 +1879,11 @@ class DeviceStateManager:
         from datetime import datetime, timezone
 
         from ..ops.overrides import _datetime_to_ns, encode_override_schedule
-        from ..parallel.sharded import full_update_step_gather, sharded_full_update
+        from ..parallel.sharded import (
+            full_update_step_gather,
+            sharded_full_update,
+            sharded_full_update_gather,
+        )
 
         dp, tp = (mesh.shape["pods"], mesh.shape["throttles"])
         single = dp == 1 and tp == 1
@@ -1887,12 +1901,16 @@ class DeviceStateManager:
                         f"({ks.pcap},{ks.tcap}); capacities are ladder rungs "
                         "(multiples of 8), so use power-of-two mesh axes"
                     )
-                # 1×1 mesh: prefer the sparse [P,K] cols companion — the
-                # tick then needs no [P,T] tensor at all (the dense mask
-                # upload alone is ~2.1GB at 100k×10k). Multi-chip keeps the
-                # dense tiled layout (shard_map shards the mask).
+                # prefer the sparse [P,K] cols companion on EVERY mesh —
+                # the tick then needs no [P,T] tensor at all (the dense
+                # mask upload alone is ~2.1GB at 100k×10k): 1×1 runs
+                # full_update_step_gather, multi-chip the shard_map form
+                # (cols rows shard over "pods", global ids rebase per
+                # throttle tile). ``dense_mesh`` forces the dense tiled
+                # program (A/B + its parity tests); small states whose
+                # cols ladder opted out fall back to dense regardless.
                 cols = None
-                if single:
+                if not dense_mesh:
                     pods, mask = ks.device_pods(need_mask=False)
                     cols = ks.device_cols()
                 if cols is None:
@@ -1932,7 +1950,7 @@ class DeviceStateManager:
             step3 = True if kind == "throttle" else on_equal
             res_cnt, res_cnt_p, res_req, res_req_p = snap["res"]
             with self.tracer.trace("tick_device"):
-                if snap["cols"] is not None:
+                if snap["cols"] is not None and single:
                     counts, schedulable, used_cnt, used_req, _, _ = (
                         full_update_step_gather(
                             sched, snap["pods"], snap["cols"], snap["counted"],
@@ -1940,6 +1958,18 @@ class DeviceStateManager:
                             snap["thr_valid"], now_ns,
                             on_equal=on_equal, step3_on_equal=step3,
                         )
+                    )
+                elif snap["cols"] is not None:
+                    key = (mesh, on_equal, step3, "gather")
+                    step = self._sharded_steps.get(key)
+                    if step is None:
+                        step = self._sharded_steps[key] = sharded_full_update_gather(
+                            mesh, on_equal=on_equal, step3_on_equal=step3
+                        )
+                    counts, schedulable, used_cnt, used_req, _, _ = step(
+                        sched, snap["pods"], snap["cols"], snap["counted"],
+                        res_cnt, res_cnt_p, res_req, res_req_p,
+                        snap["thr_valid"], now_ns,
                     )
                 else:
                     key = (mesh, on_equal, step3)

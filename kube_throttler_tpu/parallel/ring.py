@@ -139,15 +139,11 @@ def ring_full_update(mesh: Mesh, *, on_equal: bool = False, step3_on_equal: bool
         ) == 0
         return counts, schedulable, used_cnt, used_req, st_cnt, st_req
 
+    from .sharded import uniform_pods_specs, uniform_sched_specs
+
     ring = P(AXIS)
-    sched_specs = OverrideSchedule(
-        ov_valid=ring, ov_begin=ring, ov_end=ring,
-        ov_cnt=ring, ov_cnt_present=ring,
-        ov_req=ring, ov_req_present=ring,
-        spec_cnt=ring, spec_cnt_present=ring,
-        spec_req=ring, spec_req_present=ring,
-    )
-    pods_specs = PodBatch(valid=ring, req=ring, req_present=ring)
+    sched_specs = uniform_sched_specs(ring)
+    pods_specs = uniform_pods_specs(ring)
 
     mapped = jax.shard_map(
         _sweep,

@@ -29,10 +29,25 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dataclasses import fields as _dc_fields
+
 from ..ops.aggregate import aggregate_used, throttled_flags
 from ..ops.check import CHECK_ACTIVE, CHECK_INSUFFICIENT, CHECK_POD_EXCEEDS, _classify
 from ..ops.overrides import OverrideSchedule, calculate_thresholds
 from ..ops.schema import PodBatch, ThrottleState
+
+
+def uniform_sched_specs(spec) -> OverrideSchedule:
+    """OverrideSchedule spec pytree with every leaf on one PartitionSpec.
+    Shared by all mesh wrappers (2D dense, 2D sparse, ring) so adding a
+    field to OverrideSchedule is a one-place change instead of a silent
+    shard_map pytree mismatch in whichever copy was forgotten."""
+    return OverrideSchedule(**{f.name: spec for f in _dc_fields(OverrideSchedule)})
+
+
+def uniform_pods_specs(spec) -> PodBatch:
+    """PodBatch spec pytree with every leaf on one PartitionSpec."""
+    return PodBatch(**{f.name: spec for f in _dc_fields(PodBatch)})
 
 
 def full_update_step(
@@ -114,7 +129,7 @@ def full_update_step(
     return counts, schedulable, used_cnt, used_req, st_cnt, st_req
 
 
-@partial(jax.jit, static_argnames=("on_equal", "step3_on_equal"))
+@partial(jax.jit, static_argnames=("on_equal", "step3_on_equal", "pod_axis", "thr_axis"))
 def full_update_step_gather(
     sched: OverrideSchedule,
     pods: PodBatch,
@@ -129,26 +144,44 @@ def full_update_step_gather(
     *,
     on_equal: bool = False,
     step3_on_equal: bool = True,
+    pod_axis: str | None = None,
+    thr_axis: str | None = None,
 ):
-    """The SPARSE single-device tick: same fused reconcile+classify as
+    """The SPARSE tick: same fused reconcile+classify as
     ``full_update_step`` but driven by the [P,K] matched-cols companion
     instead of the dense [P,T] mask — O(P·K·R) work and no [P,T] tensor
     anywhere (neither compute nor transfer). On real clusters K ≪ T, so
-    this is the single-chip serving shape; the dense shard_map variant
-    remains the multi-chip path (its tiles need the mask layout).
+    this is the production serving shape on one chip AND on a mesh (see
+    ``sharded_full_update_gather``).
 
-    used-aggregation becomes an exact int64 scatter-add over the flat
-    [P·K] (col, contribution) pairs (padded/uncounted slots route to an
-    out-of-range index and drop); classification is ``check_pods_gather``
-    against the freshly derived state. Returns the same tuple as
-    ``full_update_step``: (counts int32[P,4], schedulable bool[P],
-    used_cnt int64[T], used_req int64[T,R], st_cnt bool[T],
-    st_req bool[T,R])."""
+    Sharded form (``pod_axis``/``thr_axis`` set, inside shard_map): pods
+    and their cols rows are sharded over "pods"; cols carry GLOBAL col
+    ids, and each "throttles"-axis shard rebases them into its local tile
+    (out-of-tile slots → -1, exactly the ownership-partition trick of
+    ``sharded_apply_deltas``). used partials psum over the pods axis;
+    per-pod class counts psum over the throttles axis (each global col has
+    exactly one owning tile, so every slot is counted once). Identical
+    comm shape to the dense ``sharded_full_update`` — two single-hop ICI
+    all-reduces — with O(P·K) tiles instead of O(P·T).
+
+    used-aggregation is an exact int64 scatter-add over the flat [P·K]
+    (col, contribution) pairs (padded/uncounted/out-of-tile slots route to
+    an out-of-range index and drop); classification is
+    ``check_pods_gather`` against the freshly derived state. Returns the
+    same tuple as ``full_update_step``: (counts int32[P,4],
+    schedulable bool[P], used_cnt int64[T], used_req int64[T,R],
+    st_cnt bool[T], st_req bool[T,R])."""
     from ..ops.check import check_pods_gather
 
     T = thr_valid.shape[0]
     P_, K = cols.shape
     R = pods.req.shape[1]
+
+    if thr_axis is not None:
+        # rebase global col ids into this shard's tile; foreign slots pad
+        offset = jax.lax.axis_index(thr_axis) * T
+        local = (cols >= offset) & (cols < offset + T)
+        cols = jnp.where(local, cols - offset, jnp.int32(-1))
 
     thr_cnt, thr_cnt_present, thr_req, thr_req_present = calculate_thresholds(
         sched, now_ns
@@ -176,6 +209,10 @@ def full_update_step_gather(
         .add(pres_rows.astype(jnp.int32), mode="drop")
         .T
     )
+    if pod_axis is not None:
+        used_cnt = jax.lax.psum(used_cnt, pod_axis)
+        used_req = jax.lax.psum(used_req, pod_axis)
+        contrib = jax.lax.psum(contrib, pod_axis)
     used_cnt_present = used_cnt > 0
     used_req_present = contrib > 0
 
@@ -205,7 +242,57 @@ def full_update_step_gather(
     counts, schedulable = check_pods_gather(
         state, pods, cols, on_equal=on_equal, step3_on_equal=step3_on_equal
     )
+    if thr_axis is not None:
+        # local counts cover only this tile's cols; sum across tiles and
+        # re-derive the gate from the GLOBAL counts (mirrors the dense
+        # full_update_step's step 5)
+        counts = jax.lax.psum(counts, thr_axis)
+        schedulable = (
+            counts[:, CHECK_ACTIVE]
+            + counts[:, CHECK_INSUFFICIENT]
+            + counts[:, CHECK_POD_EXCEEDS]
+        ) == 0
     return counts, schedulable, used_cnt, used_req, st_cnt, st_req
+
+
+def sharded_full_update_gather(
+    mesh: Mesh, *, on_equal: bool = False, step3_on_equal: bool = True
+):
+    """Compile the SPARSE full step over a ("pods","throttles") mesh via
+    shard_map — the multi-chip serving path without any [P,T] tensor.
+
+    Input layout: pod-side arrays AND the [P,K] global-id cols sharded on
+    "pods" (cols replicate over the throttles axis; each shard rebases
+    into its tile), throttle-side arrays on "throttles". Outputs: per-pod
+    on "pods", per-throttle on "throttles". Comm shape identical to the
+    dense ``sharded_full_update`` (two psums); per-device compute and
+    memory drop from O(P·T/(dp·tp)) to O(P·K/dp)."""
+    pod_spec = P("pods")
+    thr_spec = P("throttles")
+
+    sched_specs = uniform_sched_specs(thr_spec)
+    pods_specs = uniform_pods_specs(pod_spec)
+
+    def _step(sched, pods, cols, counted, res_cnt, res_cnt_p, res_req, res_req_p, thr_valid, now_ns):
+        # the raw body (like the dense wrapper calls unjitted
+        # full_update_step): shard_map provides the axis context
+        return full_update_step_gather.__wrapped__(
+            sched, pods, cols, counted,
+            res_cnt, res_cnt_p, res_req, res_req_p, thr_valid, now_ns,
+            on_equal=on_equal, step3_on_equal=step3_on_equal,
+            pod_axis="pods", thr_axis="throttles",
+        )
+
+    mapped = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(
+            sched_specs, pods_specs, pod_spec, pod_spec,
+            thr_spec, thr_spec, thr_spec, thr_spec, thr_spec, P(),
+        ),
+        out_specs=(pod_spec, pod_spec, thr_spec, thr_spec, thr_spec, thr_spec),
+    )
+    return jax.jit(mapped)
 
 
 def sharded_apply_deltas(mesh: Mesh):
@@ -262,14 +349,8 @@ def sharded_full_update(mesh: Mesh, *, on_equal: bool = False, step3_on_equal: b
     pod_spec = P("pods")
     thr_spec = P("throttles")
 
-    sched_specs = OverrideSchedule(
-        ov_valid=thr_spec, ov_begin=thr_spec, ov_end=thr_spec,
-        ov_cnt=thr_spec, ov_cnt_present=thr_spec,
-        ov_req=thr_spec, ov_req_present=thr_spec,
-        spec_cnt=thr_spec, spec_cnt_present=thr_spec,
-        spec_req=thr_spec, spec_req_present=thr_spec,
-    )
-    pods_specs = PodBatch(valid=pod_spec, req=pod_spec, req_present=pod_spec)
+    sched_specs = uniform_sched_specs(thr_spec)
+    pods_specs = uniform_pods_specs(pod_spec)
 
     def _step(sched, pods, mask, counted, res_cnt, res_cnt_p, res_req, res_req_p, thr_valid, now_ns):
         return full_update_step(

@@ -206,6 +206,78 @@ class TestFullTickSharded:
                 np.asarray(used_req_1)[cols], np.asarray(used_req_8)[cols]
             )
 
+    def test_sparse_sharded_matches_dense_sharded(self, stack):
+        """The multi-chip SPARSE tick (sharded_full_update_gather: [P,K]
+        global-id cols rebased per throttle tile, two psums) must match
+        the dense [P/dp,T/tp] shard_map program cell-for-cell on the same
+        8-device mesh — counts, verdicts, and recomputed used."""
+        store, plugin = stack
+        _populate(store, random.Random(5), n_thr=96, n_pods=200, groups=8)
+        # _populate creates only namespaced Throttles; the cluster kind
+        # needs its own population large enough for cols eligibility or
+        # its half of this parity loop would silently run dense-vs-dense
+        from kube_throttler_tpu.api.types import (
+            ClusterThrottle,
+            ClusterThrottleSelector,
+            ClusterThrottleSelectorTerm,
+            ClusterThrottleSpec,
+        )
+
+        for i in range(96):
+            store.create_cluster_throttle(
+                ClusterThrottle(
+                    name=f"ct{i}",
+                    spec=ClusterThrottleSpec(
+                        throttler_name="kube-throttler",
+                        threshold=ResourceAmount.of(
+                            pod=(i % 7) + 1,
+                            requests={"cpu": f"{(i % 5 + 1)}00m"},
+                        ),
+                        selector=ClusterThrottleSelector(
+                            selector_terms=(
+                                ClusterThrottleSelectorTerm(
+                                    pod_selector=LabelSelector(
+                                        match_labels={"grp": f"g{i % 8}"}
+                                    ),
+                                ),
+                            )
+                        ),
+                    ),
+                )
+            )
+        plugin.run_pending_once()
+        dm = plugin.device_manager
+
+        mesh = make_mesh(8, (4, 2))
+        sparse = dm.full_tick_sharded(mesh)
+        with dm._lock:
+            for ks in (dm.throttle, dm.clusterthrottle):
+                ks.device_pods(need_mask=False)
+                assert ks.device_cols() is not None, (
+                    f"test state too small: {ks.kind} cols ladder opted out, "
+                    "sparse-sharded tick not exercised for that kind"
+                )
+        dense = dm.full_tick_sharded(mesh, dense_mesh=True)
+
+        for kind in ("throttle", "clusterthrottle"):
+            counts_s, ok_s, rows_s, used_cnt_s, used_req_s, cols_s = sparse[kind]
+            counts_d, ok_d, rows_d, used_cnt_d, used_req_d, cols_d = dense[kind]
+            assert rows_s == rows_d
+            rows = sorted(rows_s.values())
+            np.testing.assert_array_equal(
+                np.asarray(counts_s)[rows], np.asarray(counts_d)[rows]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(ok_s)[rows], np.asarray(ok_d)[rows]
+            )
+            cols = sorted(cols_s)
+            np.testing.assert_array_equal(
+                np.asarray(used_cnt_s)[cols], np.asarray(used_cnt_d)[cols]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(used_req_s)[cols], np.asarray(used_req_d)[cols]
+            )
+
     def test_active_override_resolved_on_device(self, stack):
         """An active temporary override must shape the tick's thresholds:
         spec cpu=100m would throttle the 200m pod, but the active override
