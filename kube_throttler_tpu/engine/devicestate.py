@@ -1097,12 +1097,12 @@ class DeviceStateManager:
         # throttles, the dense [1,T] sweep beyond (tunable for tests)
         self.indexed_check_max = 1024
         # single-pod check routing, resolved lazily from the backend on
-        # first use (see _resolve_single_check_route): on the CPU backend
-        # the fused XLA kernel WINS (one ~43µs compiled call vs ~85µs of
-        # ~30 tiny numpy ops — measured A/B); on an accelerator backend a
-        # dispatch is a real device round trip (~70ms through this CI's
-        # TPU tunnel) for [K,R] arithmetic, so the HOST numpy classifier
-        # wins by orders of magnitude. KT_SINGLE_CHECK_DEVICE=1/0 forces.
+        # first use (see _resolve_single_check_route): accelerators always
+        # route host (a dispatch is a ~70ms tunnel round trip for [K,R]
+        # arithmetic); on the CPU backend the native C++ host tier beats
+        # the fused XLA kernel, which in turn beats the numpy tier — so
+        # kernel only without the native lib. KT_SINGLE_CHECK_DEVICE=1/0
+        # forces either route (parity tests force both).
         self._single_check_device: Optional[bool] = None
         self.throttle = _KindState("throttle", self.dims)
         self.clusterthrottle = _KindState("clusterthrottle", self.dims)
@@ -1549,11 +1549,14 @@ class DeviceStateManager:
 
     def _resolve_single_check_route(self) -> bool:
         """True ⇒ single-pod checks use the device kernel; False ⇒ the host
-        numpy classifier. Resolved once from KT_SINGLE_CHECK_DEVICE (1/0
-        forces) or the live backend: kernel on cpu (fused XLA beats ~30
-        tiny numpy ops, measured 43µs vs 86µs), host on accelerators
-        (a dispatch is a real round trip there — ~70ms through the CI's
-        TPU tunnel — for [K,R] arithmetic)."""
+        classifier. Resolved once from KT_SINGLE_CHECK_DEVICE (1/0 forces)
+        or the live backend+tiers: on accelerators always host (a dispatch
+        is a real round trip there — ~70ms through the CI's TPU tunnel —
+        for [K,R] arithmetic). On the CPU backend it depends on the host
+        TIER: the native C++ classifier beats the fused XLA kernel
+        (~100µs vs ~157µs per full-scale served decision, measured), but
+        the numpy tier loses to it (~30 tiny numpy ops at ~86µs vs the
+        kernel's ~43µs) — so kernel only when the native lib is absent."""
         if self._single_check_device is None:
             import jax
 
@@ -1561,7 +1564,9 @@ class DeviceStateManager:
             if forced in ("0", "1"):
                 self._single_check_device = forced == "1"
             else:
-                self._single_check_device = jax.default_backend() == "cpu"
+                self._single_check_device = (
+                    jax.default_backend() == "cpu" and _native_cls_lib() is None
+                )
         return self._single_check_device
 
     @staticmethod
@@ -1668,18 +1673,21 @@ class DeviceStateManager:
                     # col (~240k dict.get+int calls per 6k decisions)
                     col_keys = list(map(ck.get, cols.tolist()))
                     if not self._resolve_single_check_route():
-                        # HOST path (accelerator backends): a single pod's
-                        # check is a [K,R] computation over rows that live
-                        # in host staging anyway — host arithmetic beats a
-                        # device ROUND TRIP (~70ms through a remote-TPU
-                        # tunnel) by orders of magnitude. Native tier runs
-                        # the whole 4-step pass in C++ against the live
-                        # planes under the lock (sub-µs — the ~20-numpy-op
-                        # pass measured ~50µs/kind at 100k×10k); numpy
-                        # tier snapshots rows under the lock and
-                        # classifies outside. The device keeps the BATCH
-                        # surfaces, where parallelism actually pays. (On
-                        # the CPU backend the fused kernel wins instead —
+                        # HOST path — the default on every backend when
+                        # the native tier loads: a single pod's check is a
+                        # [K,R] computation over rows that live in host
+                        # staging anyway. On accelerators host arithmetic
+                        # beats a device ROUND TRIP (~70ms through a
+                        # remote-TPU tunnel) by orders of magnitude; on
+                        # CPU the native tier beats even the fused XLA
+                        # kernel. Native tier runs the whole 4-step pass
+                        # in C++ against the live planes under the lock
+                        # (sub-µs — the ~20-numpy-op pass measured
+                        # ~50µs/kind at 100k×10k); numpy tier snapshots
+                        # rows under the lock and classifies outside. The
+                        # device keeps the BATCH surfaces, where
+                        # parallelism actually pays. (CPU without the
+                        # native lib routes to the fused kernel instead —
                         # see _resolve_single_check_route.)
                         lib = _native_cls_lib()
                         if lib is not None:
