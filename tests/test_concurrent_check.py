@@ -63,6 +63,24 @@ def _stack():
     return store, plugin
 
 
+def _assert_device_matches_oracle(store, plugin, probe):
+    """Quiescence check shared by the race tests: the device path's blocked
+    verdicts for ``probe`` must equal the pure oracle's over the live
+    throttle set."""
+    device = plugin.device_manager.check_pod(probe, "throttle", False)
+    ctr = plugin.throttle_ctr
+    oracle = {}
+    for thr in store.list_throttles():
+        if not thr.spec.selector.matches_to_pod(probe):
+            continue
+        reserved, _ = ctr.cache.reserved_resource_amount(thr.key)
+        status = thr.check_throttled_for(probe, reserved, False)
+        if status != "not-throttled":
+            oracle[thr.key] = status
+    device_blocked = {k: v for k, v in device.items() if v != "not-throttled"}
+    assert device_blocked == oracle
+
+
 class TestConcurrentCheck:
     def test_readers_race_writer_without_torn_state(self):
         store, plugin = _stack()
@@ -141,18 +159,100 @@ class TestConcurrentCheck:
         # quiesce and diff the device path against the host oracle
         plugin.run_pending_once()
         probe = make_pod("probe-final", labels={"grp": "g1"}, requests={"cpu": "200m"})
-        device = dm.check_pod(probe, "throttle", False)
-        ctr = plugin.throttle_ctr
-        oracle = {}
-        for thr in store.list_throttles():
-            if not thr.spec.selector.matches_to_pod(probe):
-                continue
-            reserved, _ = ctr.cache.reserved_resource_amount(thr.key)
-            status = thr.check_throttled_for(probe, reserved, False)
-            if status != "not-throttled":
-                oracle[thr.key] = status
-        device_blocked = {k: v for k, v in device.items() if v != "not-throttled"}
-        assert device_blocked == oracle
+        _assert_device_matches_oracle(store, plugin, probe)
+
+    def test_readers_race_capacity_growth(self):
+        """Readers race a writer that CREATES throttles continuously, so
+        the tcap ladder grows and the staging planes REALLOCATE mid-
+        flight. This specifically exercises the native classifier's plane
+        re-registration (devicestate._native_classify_cols identity check
+        swaps the C-side handle under the main lock) against concurrent
+        check_pod callers — a stale handle would read freed memory, a
+        missed re-registration would classify against dead arrays.
+        Correctness is pinned by the oracle diff at quiescence."""
+        store, plugin = _stack()
+        dm = plugin.device_manager
+        for i in range(4):
+            store.create_throttle(
+                _throttle(f"t{i}", {"grp": f"g{i % 4}"}, pod=3, requests={"cpu": "1"})
+            )
+        for i in range(16):
+            store.create_pod(
+                _bound(
+                    make_pod(f"p{i}", labels={"grp": f"g{i % 4}"}, requests={"cpu": "100m"})
+                )
+            )
+        plugin.run_pending_once()
+
+        stop = threading.Event()
+        errors: list = []
+        valid_names = set(STATUS_NAMES.values())
+        checks = [0]
+
+        def reader(tid: int) -> None:
+            probe = make_pod(
+                f"probe{tid}", labels={"grp": f"g{tid % 4}"}, requests={"cpu": "200m"}
+            )
+            n = 0
+            while not stop.is_set():
+                try:
+                    result = dm.check_pod(probe, "throttle", False)
+                    assert all(v in valid_names for v in result.values()), result
+                    n += 1
+                except Exception as e:  # noqa: BLE001 — collected for the assert
+                    errors.append(e)
+                    return
+            checks[0] += n
+
+        created = [4]
+
+        def grower() -> None:
+            i = 4
+            while not stop.is_set():
+                try:
+                    store.create_throttle(
+                        _throttle(
+                            f"t{i}", {"grp": f"g{i % 4}"}, pod=2 + i % 4,
+                            requests={"cpu": f"{1 + i % 3}"},
+                        )
+                    )
+                    plugin.run_pending_once()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+                i += 1
+                created[0] = i
+
+        tcap0 = dm.throttle.tcap
+        threads = [threading.Thread(target=reader, args=(t,)) for t in range(3)]
+        gt = threading.Thread(target=grower)
+        for t in threads:
+            t.start()
+        gt.start()
+        # run until the tcap ladder actually CROSSED a rung (the event
+        # under test — staging reallocation + native plane re-registration)
+        # rather than a wall-clock guess; generous deadline for loaded CI
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if dm.throttle.tcap > tcap0 and created[0] > tcap0:
+                break
+            time.sleep(0.05)
+        time.sleep(0.5)  # let readers race the post-growth state a little
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        gt.join(timeout=10)
+        assert not gt.is_alive(), "grower thread hung"
+        assert not any(t.is_alive() for t in threads), "reader thread hung"
+        assert not errors, errors[:3]
+        assert checks[0] > 0
+        assert dm.throttle.tcap > tcap0, (
+            f"ladder never grew ({created[0]} creates, tcap {tcap0})"
+        )
+
+        plugin.run_pending_once()
+        probe = make_pod("probe-final", labels={"grp": "g1"}, requests={"cpu": "200m"})
+        _assert_device_matches_oracle(store, plugin, probe)
 
     def test_check_batch_all_single_snapshot(self):
         """check_batch_all returns both kinds against one lock hold; the
