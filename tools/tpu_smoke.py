@@ -145,6 +145,41 @@ def main():
     jax.block_until_ready((counts_g, ok_g))
     print("check_pods_gather ok")
 
+    # full-scale gather-memory smoke (TPU backends only): dispatch the
+    # shapes that OOM'd a 16G v5e in r5 before the R-leading orientation +
+    # P-chunking fix — [131072, 64] (the observed failure) and the
+    # [131072, 2048] worst rung (exercises the lax.map block decomposition
+    # on real hardware, which interpret-mode tests cannot)
+    if jax.devices()[0].platform != "cpu":
+        import bench as _bench
+        from kube_throttler_tpu.ops.schema import PodBatch as _PodBatch
+
+        nprng = np.random.default_rng(0)
+        big_state = _bench.synth_state(nprng, 10240, 8)
+        # pods built directly — bench.synth_pods also materializes the
+        # dense [P,T] mask (~1.3 GB host) the gather path never reads
+        big_req = np.zeros((131072, 8), dtype=np.int64)
+        big_req[:, 0] = nprng.integers(100, 2000, size=131072)
+        big_present = np.zeros((131072, 8), dtype=bool)
+        big_present[:, 0] = True
+        big_batch = _PodBatch(
+            valid=np.ones(131072, dtype=bool), req=big_req, req_present=big_present
+        )
+        for K in (64, 2048):
+            # int32 draws + in-place masking keep the host peak ~2 GB at
+            # K=2048 (float64 random + int64 where-intermediates hit ~6 GB)
+            big_cols = nprng.integers(0, 10240, (131072, K), dtype=np.int32)
+            drop = nprng.random((131072, K), dtype=np.float32) >= 0.3
+            big_cols[drop] = -1
+            del drop
+            t0 = time.perf_counter()
+            out = check_pods_gather(big_state, big_batch, big_cols)
+            jax.block_until_ready(out)
+            print(
+                f"full-scale gather K={K} ok "
+                f"({time.perf_counter()-t0:.1f}s incl. compile — no HBM OOM)"
+            )
+
     # the Pallas mosaic sweep (TPU backends only): block-padded shapes,
     # precomputed residual form, compared against check_pods on the same
     # padded state — the one kernel only real hardware can validate
