@@ -1746,23 +1746,34 @@ class DeviceStateManager:
     def check_pods_multi(
         self, pod_list: Sequence[Pod], kind: str, on_equal: bool = False
     ) -> List[Dict[str, str]]:
-        """Several DISTINCT pods classified in ONE fused device dispatch —
-        the micro-batching front-end's kernel call. Same per-pod result
-        shape as ``check_pod`` ({throttle_key: status_name}), but the
-        dispatch+sync cost (the dominant slice of a 1-pod check) is paid
-        once for the whole batch. Shapes bucket on (B, K) ladder rungs.
+        """Several DISTINCT pods classified in one call — the
+        micro-batching front-end's kernel. Same per-pod result shape as
+        ``check_pod`` ({throttle_key: status_name}).
+
+        Routing mirrors ``check_pod``'s resolver: on the HOST route the
+        native classifier runs B sub-µs passes under the snapshot lock —
+        no device involvement at all, which matters most where the
+        coalescer is aimed (remote-accelerator deployments: a fused
+        device dispatch still pays a full tunnel round trip per window —
+        the capture-2 TPU bench measured the coalesced path at 28/s on
+        exactly that). On the device route it stays ONE fused dispatch
+        bucketed on (B, K) ladder rungs.
 
         Host-side snapshot under the lock (encode + mask rows + state
-        handles), dispatch and decode outside — same locking discipline as
-        check_pod."""
+        handles); the device dispatch and all decode run outside — same
+        locking discipline as check_pod."""
         from ..ops.check import check_pods_gather_statuses
 
         if not pod_list:
             return []
+        native_out = None
+        host_rows = None
         with self._lock:
             ks = self.throttle if kind == "throttle" else self.clusterthrottle
             ks.ensure_capacity()
             R, tcap = ks.R, ks.tcap
+            step3 = True if kind == "throttle" else on_equal
+            host_route = not self._resolve_single_check_route()
             rows, colss = [], []
             for pod in pod_list:
                 row_req, row_present = self._encoded_row(ks, pod)
@@ -1775,8 +1786,49 @@ class DeviceStateManager:
                     cols = np.nonzero(rowm[:tcap])[0]
                 rows.append((row_req, row_present))
                 colss.append(cols.astype(np.int32))
-            state = ks.device_state()
+            # host tiers only while every pod's K is indexed-sized: the
+            # lock-held native work stays ≤ B × indexed_check_max × R, and
+            # an oversize (near-dense) pod sends the whole batch to the
+            # fused dispatch, which runs outside the lock (check_pod's
+            # dense-fallback analog)
+            host_route = host_route and all(
+                c.size <= self.indexed_check_max for c in colss
+            )
+            state = None
+            if host_route:
+                lib = _native_cls_lib()
+                if lib is not None:
+                    native_out = [
+                        _native_classify_cols(
+                            lib, ks, cc, rq[0], rp[0], on_equal, step3
+                        )
+                        for (rq, rp), cc in zip(rows, colss)
+                    ]
+                else:
+                    # numpy tier: [K]-row snapshots under the lock,
+                    # classification outside (mirrors check_pod)
+                    host_rows = [self._gather_check_rows(ks, cc) for cc in colss]
+            else:
+                state = ks.device_state()
             col_keys = dict(ks.index._col_keys)
+
+        if host_rows is not None:
+            native_out = [
+                _host_classify_rows(hr, rq[0], rp[0], on_equal, step3)
+                for hr, (rq, rp) in zip(host_rows, rows)
+            ]
+        if native_out is not None:
+            results: List[Dict[str, str]] = []
+            for cc, out_k in zip(colss, native_out):
+                res: Dict[str, str] = {}
+                for slot, col in enumerate(cc.tolist()):
+                    status = int(out_k[slot])
+                    if status != CHECK_NOT_AFFECTED:
+                        key = col_keys.get(col)
+                        if key is not None:
+                            res[key] = STATUS_NAMES[status]
+                results.append(res)
+            return results
 
         B = len(pod_list)
         Bp = _next_pow2(B, lo=4)
@@ -1794,7 +1846,6 @@ class DeviceStateManager:
         # converts them ~an order of magnitude cheaper than explicit
         # jnp.asarray device_puts (measured 361µs vs 39µs per call here)
         batch = PodBatch(valid=valid, req=req, req_present=present)
-        step3 = True if kind == "throttle" else on_equal
         out = np.asarray(
             check_pods_gather_statuses(
                 state, batch, cols_arr,

@@ -431,13 +431,45 @@ class TestPreFilterCoalescer:
         ]
 
     def test_check_pods_multi_matches_check_pod(self):
+        """Both routes of the multi check pinned against check_pod: the
+        HOST route (native B sub-µs passes — the default) and the fused
+        DEVICE dispatch (forced; the remote-accelerator A/B side)."""
+        import os
+
+        from kube_throttler_tpu.engine import devicestate as ds
+
         _, plugin, rng = self._stack()
         dm = plugin.device_manager
         probes = self._probes(rng, 13)
-        for kind in ("throttle", "clusterthrottle"):
-            multi = dm.check_pods_multi(probes, kind)
-            for pod, got in zip(probes, multi):
-                assert got == dm.check_pod(pod, kind), (kind, pod.name)
+        # the False leg is the NATIVE host route only when the lib loaded;
+        # a silent load failure would run the device path twice and the
+        # native multi decode would lose coverage — so demand the lib
+        # unless the numpy tier was explicitly requested
+        native_available = ds._native_cls_lib() is not None
+        assert native_available or os.environ.get("KT_TPU_NO_NATIVE") == "1", (
+            "native lib failed to load — the host-route leg would not "
+            "exercise the native multi path (run with a C++ toolchain)"
+        )
+        legs = ([False] if native_available else []) + [True]
+        for forced_device in legs:
+            dm._single_check_device = forced_device
+            for kind in ("throttle", "clusterthrottle"):
+                multi = dm.check_pods_multi(probes, kind)
+                for pod, got in zip(probes, multi):
+                    assert got == dm.check_pod(pod, kind), (
+                        forced_device, kind, pod.name,
+                    )
+        # and the numpy host tier (no native lib) through the same surface
+        old = (ds._cls_lib, ds._cls_lib_tried)
+        ds._cls_lib, ds._cls_lib_tried = None, True
+        try:
+            dm._single_check_device = False
+            for kind in ("throttle", "clusterthrottle"):
+                multi = dm.check_pods_multi(probes, kind)
+                for pod, got in zip(probes, multi):
+                    assert got == dm.check_pod(pod, kind), ("numpy", kind, pod.name)
+        finally:
+            ds._cls_lib, ds._cls_lib_tried = old
 
     def test_coalesced_matches_direct_concurrent(self):
         import threading
