@@ -200,11 +200,15 @@ def _native_classify_cols(lib, ks, cols, pod_req_row, pod_present_row, on_equal,
         or any(a is not b for a, b in zip(cached[1], planes))
     ):
         if cached is not None:
-            lib.ktn_cls_destroy(cached[2])
+            cached[3]()  # single-shot destroy (finalizer marks itself dead)
         handle = lib.ktn_cls_create(ks.R, *(a.ctypes.data for a in planes))
         # the tuple keeps the registered arrays alive for the handle's raw
-        # pointers; replaced wholesale on the next growth
-        ks._cls_cache = (ks.R, planes, handle)
+        # pointers; replaced wholesale on the next growth. The finalizer
+        # frees the C-side handle when the kind state is GC'd (tests build
+        # many managers); calling it early (re-registration) destroys
+        # exactly once — weakref.finalize guarantees at-most-once.
+        fin = weakref.finalize(ks, lib.ktn_cls_destroy, handle)
+        ks._cls_cache = (ks.R, planes, handle, fin)
     else:
         handle = cached[2]
     K = cols.shape[0]
@@ -249,9 +253,11 @@ class _KindState:
         self._alloc_throttles(tcap)
         self.dirty_pods = True
         self.dirty_throttles = True
-        # native single-pod classifier: (R, planes tuple, C handle int) —
-        # re-registered when any staging plane is reallocated (identity
-        # check in _native_classify_cols); scratch = (cols i32, out i8)
+        # native single-pod classifier: (R, planes tuple, C handle int,
+        # finalizer) — re-registered when any staging plane is reallocated
+        # (identity check in _native_classify_cols); the weakref finalizer
+        # frees the C handle on GC or early at re-registration (at-most-
+        # once either way); scratch = (cols i32, out i8)
         self._cls_cache = None
         self._cls_scratch = None
         self._device_state: Optional[ThrottleState] = None

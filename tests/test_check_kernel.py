@@ -261,6 +261,36 @@ def test_gather_ignores_padding_and_invalid_cols():
     assert not bool(np.asarray(ok)[0]) or counts[0, 0] == 1
 
 
+def test_native_handle_lifecycle():
+    """The C-side classifier handle must be destroyed exactly once: early
+    at plane re-registration (capacity growth replaces staging arrays) OR
+    at _KindState GC — weakref.finalize guarantees at-most-once, so the
+    two paths cannot double-free."""
+    import gc
+
+    from kube_throttler_tpu.engine import devicestate as ds
+    from kube_throttler_tpu.ops.schema import DimRegistry
+
+    lib = ds._native_cls_lib()
+    if lib is None:
+        pytest.skip("native lib unavailable (KT_TPU_NO_NATIVE or no toolchain)")
+    ks = ds._KindState("throttle", DimRegistry())
+    cols = np.array([0, 1], dtype=np.int64)
+    pod_req = np.zeros(ks.R, dtype=np.int64)
+    pod_present = np.zeros(ks.R, dtype=bool)
+    ds._native_classify_cols(lib, ks, cols, pod_req, pod_present, False, True)
+    fin = ks._cls_cache[3]
+    assert fin.alive
+    ks.thr_cnt = ks.thr_cnt.copy()  # a growth-like plane replacement
+    ds._native_classify_cols(lib, ks, cols, pod_req, pod_present, False, True)
+    assert not fin.alive, "re-registration must retire the old handle"
+    fin2 = ks._cls_cache[3]
+    assert fin2.alive and fin2 is not fin
+    del ks
+    gc.collect()
+    assert not fin2.alive, "GC must retire the live handle"
+
+
 def test_host_single_check_matches_device_kernel():
     """check_pod's default HOST numpy classifier (_host_classify_rows) must
     agree cell-for-cell with the device kernel path
