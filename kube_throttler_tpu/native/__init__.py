@@ -38,7 +38,6 @@ _load_lock = threading.Lock()
 _load_attempted = False
 
 _i32p = ctypes.POINTER(ctypes.c_int32)
-_u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
 logger = logging.getLogger(__name__)
@@ -117,11 +116,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ktn_clear_col.restype = None
     lib.ktn_num_cols.argtypes = [ctypes.c_void_p]
     lib.ktn_num_cols.restype = ctypes.c_int32
+    # raw-pointer-int args (c_void_p) on the hot row-match: each data_as
+    # POINTER conversion costs ~2µs and the call makes six — at 2 kinds ×
+    # 100k pod events that marshaling alone was seconds of cold start
     lib.ktn_match_row.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
-        _i32p, _i32p, ctypes.c_int32,
-        _i32p, _i32p, ctypes.c_int32,
-        _u8p, _u8p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p,
     ]
     lib.ktn_match_row.restype = None
     # single-pod classifier: planes registered once per staging allocation
@@ -208,6 +210,11 @@ class NativeRowEngine:
             raise RuntimeError("native library unavailable")
         self._lib = lib
         self._h = ctypes.c_void_p(lib.ktn_create(1 if kind == "clusterthrottle" else 0))
+        # ktn_num_cols cached per column-set mutation (set_col can extend):
+        # the hot match_row otherwise pays an extra ctypes call per row
+        self._n_cols: Optional[int] = None
+        # (out, general) uint8 scratch for match_row — see its docstring
+        self._match_scratch: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     def __del__(self) -> None:  # pragma: no cover - interpreter teardown
         h = getattr(self, "_h", None)
@@ -220,6 +227,7 @@ class NativeRowEngine:
 
     def reserve(self, tcap: int) -> None:
         self._lib.ktn_reserve(self._h, tcap)
+        self._n_cols = None
 
     # operator codes — shared contract with the Op enum in ktnative.cpp
     OP_EQ = 0
@@ -266,12 +274,15 @@ class NativeRowEngine:
             *(_ptr(a) for a in pod_arrays),
             *(_ptr(a) for a in ns_arrays),
         )
+        self._n_cols = None
 
     def set_col_general(self, col: int, thr_ns: int) -> None:
         self._lib.ktn_set_col_general(self._h, col, thr_ns)
+        self._n_cols = None
 
     def clear_col(self, col: int) -> None:
         self._lib.ktn_clear_col(self._h, col)
+        self._n_cols = None
 
     def match_row(
         self,
@@ -280,19 +291,31 @@ class NativeRowEngine:
         pod_labels: Dict[int, int],
         ns_labels: Dict[int, int],
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Returns (match, needs_general) as uint8 arrays of length num_cols."""
-        n_cols = self._lib.ktn_num_cols(self._h)
-        # np.empty, not zeros: ktn_match_row memsets both buffers itself
-        out = np.empty(n_cols, dtype=np.uint8)
-        general = np.empty(n_cols, dtype=np.uint8)
-        pk = _as_i32(list(pod_labels.keys()))
-        pv = _as_i32(list(pod_labels.values()))
-        nk = _as_i32(list(ns_labels.keys()))
-        nv = _as_i32(list(ns_labels.values()))
+        """Returns (match, needs_general) as uint8 arrays of length num_cols.
+
+        The returned arrays are per-engine SCRATCH, valid only until the
+        next match_row call — the caller contract (SelectorIndex holds its
+        RLock around every call AND copies what it keeps —
+        engine/index.py _match_row_arbitrary) makes reuse safe and saves
+        two allocations on the hot pod-event path. Pointer args pass as
+        raw ints (see _declare)."""
+        n_cols = self._n_cols
+        if n_cols is None:
+            n_cols = self._n_cols = self._lib.ktn_num_cols(self._h)
+        sc = self._match_scratch
+        if sc is None or sc[0].shape[0] < n_cols:
+            # np.empty: ktn_match_row memsets both buffers itself
+            sc = (np.empty(n_cols, dtype=np.uint8), np.empty(n_cols, dtype=np.uint8))
+            self._match_scratch = sc
+        out, general = sc[0][:n_cols], sc[1][:n_cols]
+        pk = np.fromiter(pod_labels.keys(), dtype=np.int32, count=len(pod_labels))
+        pv = np.fromiter(pod_labels.values(), dtype=np.int32, count=len(pod_labels))
+        nk = np.fromiter(ns_labels.keys(), dtype=np.int32, count=len(ns_labels))
+        nv = np.fromiter(ns_labels.values(), dtype=np.int32, count=len(ns_labels))
         self._lib.ktn_match_row(
             self._h, pod_ns, 1 if ns_exists else 0,
-            _ptr(pk), _ptr(pv), len(pk),
-            _ptr(nk), _ptr(nv), len(nk),
-            out.ctypes.data_as(_u8p), general.ctypes.data_as(_u8p),
+            pk.ctypes.data, pv.ctypes.data, len(pk),
+            nk.ctypes.data, nv.ctypes.data, len(nk),
+            out.ctypes.data, general.ctypes.data,
         )
         return out, general
