@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
+from functools import cached_property
 from typing import Dict, List, Mapping, Optional, Union
 
 from ..quantity import parse_quantity
@@ -65,9 +66,11 @@ class Pod:
     spec: PodSpec = field(default_factory=PodSpec)
     status: PodStatus = field(default_factory=PodStatus)
 
-    @property
+    @cached_property
     def key(self) -> str:
-        """namespace/name — the NamespacedName string form used everywhere."""
+        """namespace/name — the NamespacedName string form used everywhere.
+        Cached: identity fields never mutate by contract (updates go
+        through dataclasses.replace, which builds a fresh instance)."""
         return f"{self.namespace}/{self.name}"
 
     def is_scheduled(self) -> bool:

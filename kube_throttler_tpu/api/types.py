@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field, replace
+from functools import cached_property
 from datetime import datetime, timedelta, timezone
 from fractions import Fraction
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -545,8 +546,12 @@ class Throttle:
     spec: ThrottleSpec = field(default_factory=ThrottleSpec)
     status: ThrottleStatus = field(default_factory=ThrottleStatus)
 
-    @property
+    @cached_property
     def key(self) -> str:
+        # cached_property on a frozen dataclass: writes via the instance
+        # __dict__ (no __setattr__), replace() builds a fresh instance so
+        # the cache can never go stale; the f-string rebuilt per access
+        # was ~13 hits per served decision and ~80 per cfg5 drain key
         return f"{self.namespace}/{self.name}"
 
     def check_throttled_for(
@@ -574,10 +579,11 @@ class ClusterThrottle:
     spec: ClusterThrottleSpec = field(default_factory=ClusterThrottleSpec)
     status: ThrottleStatus = field(default_factory=ThrottleStatus)
 
-    @property
+    @cached_property
     def key(self) -> str:
         # Go types.NamespacedName{Namespace: "", Name: name}.String() — the
         # leading "/" appears in PreFilter reason strings (plugin.go:289-295).
+        # Cached like Throttle.key (frozen-safe — see there).
         return f"/{self.name}"
 
     def check_throttled_for(
