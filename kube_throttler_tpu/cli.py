@@ -394,6 +394,13 @@ def main(argv: Optional[list] = None) -> int:
             f"device kernels prewarmed ({_nk} shapes, {_time.perf_counter()-_t0:.1f}s)",
             flush=True,
         )
+    # /readyz components beyond the plugin's own (device, workqueues):
+    # remote reflectors report down-until-synced/degraded-in-backoff; a
+    # journal that recovered lossily or is dropping writes reports degraded
+    if session is not None:
+        session.register_health(plugin.health)
+    if journal is not None:
+        plugin.health.register("journal", journal.health_state)
     scheduler = None
     if args.nodes > 0:
         from .scheduler import Node, Scheduler

@@ -21,6 +21,16 @@ the resulting events exactly like a real apiserver:
 
 The event-log bound (``log_size``) is deliberately small-able so tests can
 force the 410→relist path.
+
+Server-side fault verbs: assigning a :class:`~..faults.FaultPlan` to
+``server.faults`` lets integration tests script outages the CLIENT cannot
+distinguish from real ones — sites ``mock.list`` (500 / 410 / stall),
+``mock.watch.cut`` (stream severed mid-flight), ``mock.watch.gone``
+(410 ERROR event mid-stream), ``mock.status.conflict`` (forced 409) and
+``mock.status.error`` (500 on a status PUT). This is the other half of the
+fault matrix: client-side injection (transport.py) exercises our error
+handling; server-side verbs exercise the full wire round trip through real
+sockets.
 """
 
 from __future__ import annotations
@@ -117,6 +127,9 @@ class MockApiServer:
         # observability for tests: largest single LIST response (items)
         self.max_list_page_items = 0
         self.list_requests = 0
+        # server-side fault verbs: a FaultPlan scripted by tests (see module
+        # docstring); None = no injection
+        self.faults = None
         for kind in COLLECTION_PATHS:
             self.store.add_event_handler(kind, self._make_recorder(kind), replay=False)
 
@@ -262,7 +275,28 @@ class MockApiServer:
 
     # -- endpoint implementations -----------------------------------------
 
+    def _fault(self, site: str):
+        """One fault-point check against the scripted plan (None when no
+        plan is installed or the site stays quiet this hit)."""
+        if self.faults is None:
+            return None
+        fault = self.faults.check(site)
+        if fault is not None:
+            fault.sleep()
+        return fault
+
     def _serve_list(self, handler, kind: str, query=None) -> None:
+        fault = self._fault("mock.list")
+        if fault is not None:
+            if fault.mode == "gone":
+                handler._send_json(
+                    410, {"message": "injected: resourceVersion too old", "code": 410}
+                )
+                return
+            if fault.mode == "error":
+                handler._send_json(500, {"message": "injected apiserver error"})
+                return
+            # mode "delay": the sleep already happened — serve normally
         query = query or {}
         try:
             limit = int((query.get("limit") or ["0"])[0] or "0")
@@ -394,6 +428,44 @@ class MockApiServer:
             while not self._shutdown.is_set():
                 if deadline is not None and time.monotonic() >= deadline:
                     break  # graceful timeoutSeconds expiry; client re-watches
+                fault = self._fault("mock.watch.cut")
+                if fault is not None:
+                    # sever the stream abruptly: no chunked terminator, so
+                    # the client sees a mid-body connection loss (the torn
+                    # TCP session a crashing apiserver leaves behind).
+                    # shutdown(), not just close(): the handler's
+                    # rfile/wfile still hold the socket, so close() alone
+                    # would defer the FIN until the keep-alive loop ends
+                    # and leave the client blocked on a silent stream.
+                    import socket as _socket
+
+                    try:
+                        handler.connection.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    handler.close_connection = True
+                    return
+                fault = self._fault("mock.watch.gone")
+                if fault is not None:
+                    # mid-stream 410 ERROR event (compaction overtook the
+                    # resume point while the stream was open)
+                    self._write_watch_line(
+                        handler,
+                        {
+                            "type": "ERROR",
+                            "object": {
+                                "kind": "Status",
+                                "code": 410,
+                                "reason": "Expired",
+                                "message": "injected: too old resource version",
+                            },
+                        },
+                    )
+                    try:
+                        handler.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        pass
+                    return
                 try:
                     rv, etype, obj = q.get(timeout=self.bookmark_interval)
                 except Empty:
@@ -537,6 +609,16 @@ class MockApiServer:
         m = _STATUS_RE.match(urlsplit(path).path)
         if m is None:
             handler._send_json(404, {"message": f"no route {path}"})
+            return
+        fault = self._fault("mock.status.conflict")
+        if fault is not None:
+            handler._send_json(
+                409, {"message": "injected: the object has been modified"}
+            )
+            return
+        fault = self._fault("mock.status.error")
+        if fault is not None:
+            handler._send_json(500, {"message": "injected apiserver error"})
             return
         kind = "Throttle" if m.group("ns") else "ClusterThrottle"
         rv_raw = str((body.get("metadata") or {}).get("resourceVersion", "") or "")

@@ -35,6 +35,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import socket
 import ssl
 import threading
@@ -238,6 +239,47 @@ def in_cluster_config(sa_dir: str = _SA_DIR) -> RestConfig:
     )
 
 
+class Backoff:
+    """Jittered exponential backoff with a cap and reset-on-success — the
+    client-go wait.Backoff analog the reflector loop uses instead of its
+    former fixed 1.0s sleep (a thundering-herd and a 30×-too-slow recovery
+    at the same time).
+
+    ``next()`` returns ``base * factor^n`` capped at ``cap``, with the top
+    half jittered (half-fixed/half-random, the "equal jitter" scheme): under
+    a mass disconnect N reflectors spread over [d/2, d] instead of stamping
+    the apiserver in lockstep. ``reset()`` (on a healthy stream) snaps the
+    next delay back to ``base``."""
+
+    def __init__(
+        self,
+        base: float = 1.0,
+        cap: float = 30.0,
+        factor: float = 2.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base = max(0.001, float(base))
+        self.cap = float(cap)
+        self.factor = float(factor)
+        self._rng = rng or random.Random()
+        self._attempts = 0
+        self._lock = threading.Lock()
+
+    @property
+    def attempts(self) -> int:
+        return self._attempts
+
+    def next(self) -> float:
+        with self._lock:
+            raw = min(self.cap, self.base * (self.factor**self._attempts))
+            self._attempts += 1
+        return raw / 2 + self._rng.random() * (raw / 2)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._attempts = 0
+
+
 class _TokenBucket:
     """Client-side write rate limiter — the analog of client-go's
     rest.Config QPS/Burst that the reference's generated clientset
@@ -295,9 +337,14 @@ class ApiClient:
         qps: Optional[float] = 50.0,
         burst: int = 100,
         page_size: Optional[int] = None,
+        faults=None,
     ):
         self.config = config
         self.timeout = timeout
+        # optional FaultPlan (faults/plan.py): deterministic client-side
+        # failure injection — connection resets, 409/410 storms, stalled
+        # watch reads — for chaos tests. None in production.
+        self.faults = faults
         self.page_size = (
             self.DEFAULT_PAGE_SIZE if page_size is None else max(0, page_size)
         )
@@ -407,6 +454,11 @@ class ApiClient:
         between requests is indistinguishable from a network error, and
         the single retry is the standard stale-socket pattern. Credential
         rotation invalidates the cache (the SSL context is stamped)."""
+        if self.faults is not None:
+            # a reset here is indistinguishable from a mid-request network
+            # failure: callers see the same exception surface they would
+            # from a dying apiserver
+            self.faults.maybe_raise("transport.request", default=ConnectionResetError)
         headers = self._headers()
         payload = None
         if body is not None:
@@ -532,6 +584,10 @@ class ApiClient:
         ERROR event carrying 410."""
         if read_timeout is None:
             read_timeout = self.WATCH_TIMEOUT_SECONDS + 30.0
+        if self.faults is not None:
+            self.faults.maybe_raise(
+                "transport.watch.open", default=lambda: ApiError(500, "injected")
+            )
         query = urlencode(
             {
                 "watch": "true",
@@ -552,12 +608,26 @@ class ApiClient:
             if resp.status >= 400:
                 raise ApiError(resp.status, resp.read().decode(errors="replace")[:200])
             while stop is None or not stop.is_set():
+                if self.faults is not None:
+                    fault = self.faults.check("transport.watch.read")
+                    if fault is not None:
+                        fault.sleep()  # "delay" stalls the read (slow stream)
+                        if fault.mode == "close":
+                            return  # stream torn down — caller re-watches
+                        if fault.mode == "gone":
+                            raise GoneError("injected 410")
+                        if fault.mode == "error":
+                            raise fault.make_error()
                 try:
                     line = resp.readline()
                 except (socket.timeout, TimeoutError):
                     return  # idle stream — caller resumes from last RV
                 except (OSError, ssl.SSLError):
                     return  # connection torn down
+                except HTTPException:
+                    # chunked stream severed mid-chunk (IncompleteRead):
+                    # same recovery as a torn connection — re-watch
+                    return
                 if not line:
                     return  # server closed the stream
                 line = line.strip()
@@ -591,6 +661,11 @@ class ApiClient:
         409 raises ConflictError."""
         if self._write_bucket is not None:
             self._write_bucket.take()
+        if self.faults is not None:
+            # 409 storm: same surface as a real optimistic-concurrency loss
+            self.faults.maybe_raise(
+                "transport.put.conflict", default=lambda: ConflictError(path)
+            )
         return self._request("PUT", path, body=body)
 
 
@@ -658,14 +733,23 @@ class Reflector:
         versions: Optional[RemoteVersions] = None,
         backoff: float = 1.0,
         metrics: Optional[ReflectorMetrics] = None,
+        backoff_cap: float = 30.0,
+        backoff_rng: Optional[random.Random] = None,
     ):
         self.client = client
         self.kind = kind
         self.store = store
         self.versions = versions
+        # ``backoff`` stays the BASE delay (compat kwarg); the loop now
+        # walks base→cap with jitter and resets on a healthy stream instead
+        # of sleeping a fixed second per failure (transport hardening)
         self.backoff = backoff
+        self._backoff = Backoff(base=backoff, cap=backoff_cap, rng=backoff_rng)
         self.metrics = metrics
         self.last_resource_version = "0"
+        # consecutive failed list/watch attempts since the last healthy
+        # stream — the /readyz health probe reads this (0 = healthy)
+        self.consecutive_failures = 0
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -803,6 +887,11 @@ class Reflector:
             logger.warning("reflector %s: unknown watch event %r", self.kind, etype)
             return
         self._count(lambda m: m.events)  # applied to the cache (not bookmarks)
+        if self._backoff.attempts or self.consecutive_failures:
+            # an applied event IS the health signal: snap the retry ladder
+            # back to base so the next hiccup starts cheap again
+            self._backoff.reset()
+            self.consecutive_failures = 0
         if rv:
             self.last_resource_version = rv
 
@@ -823,11 +912,17 @@ class Reflector:
             try:
                 self.last_resource_version = self._relist()
                 self._synced.set()
+                self._backoff.reset()  # healthy list
+                self.consecutive_failures = 0
             except Exception:
                 if self._stop.is_set():
                     return
-                logger.exception("reflector %s: list failed; backing off", self.kind)
-                self._stop.wait(self.backoff)
+                self.consecutive_failures += 1
+                delay = self._backoff.next()
+                logger.exception(
+                    "reflector %s: list failed; backing off %.2fs", self.kind, delay
+                )
+                self._stop.wait(delay)
                 continue
             # watch → re-watch from last RV; Gone → fall through to relist
             while not self._stop.is_set():
@@ -848,10 +943,13 @@ class Reflector:
                 except Exception:
                     if self._stop.is_set():
                         return
+                    self.consecutive_failures += 1
+                    delay = self._backoff.next()
                     logger.exception(
-                        "reflector %s: watch failed; backing off", self.kind
+                        "reflector %s: watch failed; backing off %.2fs",
+                        self.kind, delay,
                     )
-                    self._stop.wait(self.backoff)
+                    self._stop.wait(delay)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -869,6 +967,16 @@ class Reflector:
 
     def wait_for_sync(self, timeout: float = 30.0) -> bool:
         return self._synced.wait(timeout)
+
+    def health_state(self) -> str:
+        """Health-component contract (health.py): ``down`` before the first
+        successful list, ``degraded`` while retrying behind backoff, ``ok``
+        on a healthy stream."""
+        if not self._synced.is_set():
+            return "down"
+        if self.consecutive_failures >= 3:
+            return "degraded"
+        return "ok"
 
 
 class RemoteStatusWriter:
@@ -1253,10 +1361,11 @@ class RemoteSession:
         metrics_registry=None,
         qps: Optional[float] = 50.0,
         burst: int = 100,
+        faults=None,
     ):
         self.config = config
         self.store = store
-        self.client = ApiClient(config, qps=qps, burst=burst)
+        self.client = ApiClient(config, qps=qps, burst=burst, faults=faults)
         self.versions = RemoteVersions()
         metrics = (
             ReflectorMetrics(metrics_registry) if metrics_registry is not None else None
@@ -1297,3 +1406,19 @@ class RemoteSession:
         self.event_recorder.close()
         for refl in self.reflectors.values():
             refl.stop()
+
+    def register_health(self, health) -> None:
+        """Expose each reflector as a /readyz component (health.Health):
+        the watch path being down/degraded is exactly what an operator's
+        readiness probe needs to see before blaming admission."""
+        for kind, refl in self.reflectors.items():
+            health.register(
+                f"reflector.{kind}",
+                lambda r=refl: (
+                    r.health_state(),
+                    {
+                        "resourceVersion": r.last_resource_version,
+                        "consecutiveFailures": r.consecutive_failures,
+                    },
+                ),
+            )

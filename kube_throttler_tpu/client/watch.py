@@ -6,12 +6,24 @@ The reference's generated clients expose ``Watch(ctx, opts)`` returning a
 ``Watch`` is an iterator over :class:`~..engine.store.Event` objects fed by
 the store's synchronous dispatch, decoupled through a queue so consumers run
 on their own thread at their own pace.
+
+The queue is BOUNDED (client-go's watch channels are too — chanSize 100 in
+the reflector): the store dispatches events synchronously under its lock,
+so a slow or dead consumer on an unbounded queue would grow memory without
+limit, and on a blocking one would wedge every mutator in the process. The
+default policy is ``drop-oldest``: the dispatch thread never blocks, the
+consumer keeps the newest events, and the watch is marked ``overflowed`` so
+the consumer knows its stream has a gap and can relist (the same contract
+as a 410 on a real watch). ``block`` restores the old apply-backpressure
+behavior for consumers that must see every event and guarantee their own
+pace.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Callable, Iterator, Optional
 
 from ..engine.store import Event, EventType, Store
@@ -22,9 +34,24 @@ class Watch:
 
     With ``replay`` the stream begins with synthetic ADDED events for every
     object currently in the store (list-then-watch semantics).
+
+    ``maxsize`` bounds the queue (0 = unbounded); ``overflow`` picks the
+    slow-consumer policy: ``"drop-oldest"`` (default — dispatch never
+    blocks, ``overflowed``/``dropped`` record the gap) or ``"block"``
+    (dispatch waits; the pre-hardening behavior).
     """
 
     _SENTINEL = object()
+
+    DEFAULT_MAXSIZE = 4096
+    OVERFLOW_POLICIES = ("drop-oldest", "block")
+
+    # class-level aggregates for /metrics (see metrics.register_watch_metrics):
+    # live instances tracked weakly so an abandoned, never-stopped watch
+    # doesn't pin the stats forever
+    _live: "weakref.WeakSet[Watch]" = weakref.WeakSet()
+    _stats_lock = threading.Lock()
+    _dropped_total = 0
 
     def __init__(
         self,
@@ -32,21 +59,53 @@ class Watch:
         kind: str,
         filter: Optional[Callable[[Event], bool]] = None,
         replay: bool = False,
+        maxsize: Optional[int] = None,
+        overflow: str = "drop-oldest",
     ) -> None:
+        if overflow not in self.OVERFLOW_POLICIES:
+            raise ValueError(
+                f"overflow must be one of {self.OVERFLOW_POLICIES}, got {overflow!r}"
+            )
         self._store = store
         self._kind = kind
         self._filter = filter
-        self._queue: "queue.Queue" = queue.Queue()
+        self._maxsize = self.DEFAULT_MAXSIZE if maxsize is None else max(0, maxsize)
+        self._overflow = overflow
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self._maxsize)
         self._stopped = threading.Event()
         self._terminal = False  # consumer-side: sentinel observed
+        self.dropped = 0  # events shed by drop-oldest on this watch
+        self.overflowed = False  # the stream has a gap — consumer should relist
 
         def handler(event: Event) -> None:
             if self._stopped.is_set():
                 return
-            if self._filter is None or self._filter(event):
+            if self._filter is not None and not self._filter(event):
+                return
+            if self._overflow == "block":
                 self._queue.put(event)
+                return
+            while True:
+                try:
+                    self._queue.put_nowait(event)
+                    return
+                except queue.Full:
+                    try:
+                        shed = self._queue.get_nowait()
+                    except queue.Empty:
+                        continue  # consumer raced us; retry the put
+                    if shed is self._SENTINEL:
+                        # never shed the terminator: the stream is stopping,
+                        # losing THIS event instead is fine
+                        self._queue.put_nowait(shed)
+                        return
+                    self.overflowed = True
+                    self.dropped += 1
+                    with Watch._stats_lock:
+                        Watch._dropped_total += 1
 
         self._handler = handler
+        Watch._live.add(self)
         store.add_event_handler(kind, handler, replay=replay)
 
     def stop(self) -> None:
@@ -55,7 +114,20 @@ class Watch:
         if not self._stopped.is_set():
             self._stopped.set()
             self._store.remove_event_handler(self._kind, self._handler)
-            self._queue.put(self._SENTINEL)
+            while True:
+                try:
+                    self._queue.put_nowait(self._SENTINEL)
+                    return
+                except queue.Full:
+                    # full bounded queue with a gone consumer: shed one event
+                    # to make room for the terminator (never block stop())
+                    try:
+                        self._queue.get_nowait()
+                    except queue.Empty:
+                        continue
+
+    def qsize(self) -> int:
+        return self._queue.qsize()
 
     def next(self, timeout: Optional[float] = None) -> Event:
         """Block for the next event. Raises ``queue.Empty`` on timeout,
@@ -83,6 +155,21 @@ class Watch:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- metrics ----------------------------------------------------------
+
+    @classmethod
+    def stats(cls) -> dict:
+        """Aggregate snapshot across live watches (scrape-time reader for
+        the watch-queue gauge/counter families)."""
+        live = [w for w in cls._live if not w._stopped.is_set()]
+        with cls._stats_lock:
+            dropped_total = cls._dropped_total
+        return {
+            "open": len(live),
+            "depth": sum(w.qsize() for w in live),
+            "dropped_total": dropped_total,
+        }
 
 
 __all__ = ["Watch", "Event", "EventType"]

@@ -1129,9 +1129,23 @@ class DeviceStateManager:
         # staging keeps accumulating during an outage (handlers are pure
         # numpy) and the pending-overflow full-rebase mark self-heals the
         # aggregates on recovery, so reopening needs no special resync.
+        #
+        # Three states (closed → open → half-open → closed/open): after the
+        # cooldown expires the breaker goes HALF-OPEN and admits exactly ONE
+        # probe dispatch; success closes it, failure re-opens for another
+        # cooldown. The former blind reopen let every concurrent caller pile
+        # onto a still-dead backend the instant the 30s elapsed — under a
+        # hard outage that is a synchronized multi-second dispatch stall per
+        # cooldown period across every serving thread.
         self.device_retry_cooldown = 30.0
         self._device_down_until = 0.0
+        self._breaker_lock = threading.Lock()
+        self._breaker_open = False  # False = closed; half-open is derived
+        self._probe_inflight = False
         self._monotonic = None  # test injection point; defaults to time.monotonic
+        # optional FaultPlan: site "device.dispatch" fails guarded dispatches
+        # deterministically (chaos tests drive the breaker through it)
+        self.faults = None
         self.fallback_counter = None  # CounterVec set by the plugin
         # {kind: ReservedResourceAmounts} wired by the plugin once the
         # controllers exist: lets _on_any_throttle replay standing
@@ -1153,31 +1167,76 @@ class DeviceStateManager:
 
     def device_available(self) -> bool:
         """False while the circuit breaker is open (recent device failure);
-        callers should serve from their host-oracle paths meanwhile."""
-        return self._now_monotonic() >= self._device_down_until
+        callers should serve from their host-oracle paths meanwhile. True
+        once the cooldown has expired (half-open: a probe is allowed)."""
+        with self._breaker_lock:
+            return (
+                not self._breaker_open
+                or self._now_monotonic() >= self._device_down_until
+            )
+
+    def breaker_state(self) -> str:
+        """``closed`` | ``open`` | ``half-open`` — the metrics gauge and the
+        /readyz device component read this. Half-open means the cooldown has
+        elapsed and the next guarded dispatch (or the one in flight) is the
+        probe that decides."""
+        with self._breaker_lock:
+            if not self._breaker_open:
+                return "closed"
+            if self._now_monotonic() < self._device_down_until:
+                return "open"
+            return "half-open"
 
     def guarded(self, surface: str, fn, *args, **kwargs):
         """Run one device dispatch behind the circuit breaker.
 
         Returns the dispatch result, or None when the breaker is open or
-        the dispatch raised (opening it). THE single guard implementation —
-        every serving surface (per-pod check, batch triage, reconcile)
-        routes through here so breaker semantics cannot drift between
-        hand-rolled copies. All guarded dispatches return dicts, so None
-        is unambiguous."""
-        if not self.device_available():
-            return None
+        the dispatch raised (opening it). While HALF-OPEN exactly one
+        caller becomes the probe; everyone else keeps falling back to host
+        until the probe's verdict is in — so a still-dead backend costs one
+        thread one failing dispatch per cooldown, not a stampede. THE
+        single guard implementation — every serving surface (per-pod check,
+        batch triage, reconcile) routes through here so breaker semantics
+        cannot drift between hand-rolled copies. All guarded dispatches
+        return dicts, so None is unambiguous."""
+        probe = False
+        with self._breaker_lock:
+            if self._breaker_open:
+                if self._now_monotonic() < self._device_down_until:
+                    return None  # open: cooldown running
+                if self._probe_inflight:
+                    return None  # half-open: someone else is probing
+                self._probe_inflight = True
+                probe = True
         try:
-            return fn(*args, **kwargs)
+            if self.faults is not None:
+                self.faults.maybe_raise(
+                    "device.dispatch",
+                    default=lambda: RuntimeError("injected device fault"),
+                )
+            result = fn(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — any dispatch failure opens it
             self.note_device_failure(surface, e)
             return None
+        if probe:
+            with self._breaker_lock:
+                self._breaker_open = False
+                self._probe_inflight = False
+            logger.info(
+                "device probe on %s succeeded; circuit breaker closed", surface
+            )
+        return result
 
     def note_device_failure(self, surface: str, exc: BaseException) -> None:
         """Open the breaker for ``device_retry_cooldown`` seconds and count
         the fallback. Called by controllers when a device dispatch raises
         (tunnel drop, backend death) right before they fall back to host."""
-        self._device_down_until = self._now_monotonic() + self.device_retry_cooldown
+        with self._breaker_lock:
+            self._breaker_open = True
+            self._probe_inflight = False
+            self._device_down_until = (
+                self._now_monotonic() + self.device_retry_cooldown
+            )
         if self.fallback_counter is not None:
             self.fallback_counter.inc({"surface": surface})
         logger.warning(

@@ -12,9 +12,18 @@ every watch event as a JSON line and replays it on startup, making
 Format: one ``{"type": ..., "kind": ..., "object": {...}}`` per line —
 deliberately the watch wire-event shape (client/transport.py), so the
 journal doubles as a replayable watch stream. A truncated trailing line
-(crash mid-write) is tolerated and dropped. When the live log exceeds
-``compact_after`` lines it is compacted to a snapshot of ADDED events
-(written to a temp file, atomically renamed).
+(crash mid-write) is tolerated and truncated away; a corrupted INTERIOR
+line (torn write followed by later appends, bit rot) is skipped and
+counted — startup must not abort on one bad line when every event after it
+is intact. When the live log exceeds ``compact_after`` lines it is
+compacted to a snapshot of ADDED events (written to a temp file, atomically
+renamed); a failed compaction (fsync/rename error) is logged and retried a
+window later — it never breaks the store's dispatch.
+
+Fault injection (faults/plan.py): site ``journal.append`` supports mode
+``torn`` (write half the line, no newline — the next append turns it into
+interior corruption) and ``error`` (drop the write); site ``journal.fsync``
+fails the compaction fsync.
 """
 
 from __future__ import annotations
@@ -38,53 +47,82 @@ _KIND_ORDER = {"Namespace": 0, "Throttle": 1, "ClusterThrottle": 1, "Pod": 2}
 class StoreJournal:
     """Attach with :func:`attach`; detach via :meth:`close`."""
 
-    def __init__(self, store: Store, path: str, compact_after: int = 100_000):
+    def __init__(
+        self, store: Store, path: str, compact_after: int = 100_000, faults=None
+    ):
         self.store = store
         self.path = path
         self.compact_after = compact_after
+        self.faults = faults
         self._lock = threading.Lock()
         self._lines = 0
         self._file = None
+        # robustness counters (health probe + tests read these)
+        self.replay_skipped = 0  # corrupted interior lines skipped on replay
+        self.write_errors = 0  # appends dropped (injected/real write failure)
+        self.torn_writes = 0  # injected torn appends
+        self.compact_failures = 0  # compactions aborted (old log kept)
 
     # -- replay -------------------------------------------------------------
 
     def _replay(self) -> Tuple[int, Optional[int]]:
         """Apply journaled events to the (empty) store. Returns
-        ``(applied, truncate_at)``: the event count, and — when a corrupt
-        line stopped replay — the byte offset of the end of the last GOOD
-        line. The caller MUST truncate there before appending: appending
-        past a corrupt line would strand every later event behind the gap
-        on all future replays (silent loss of post-crash history)."""
+        ``(applied, truncate_at)``: the event count, and — when the file
+        ends in a run of corrupt lines (crash mid-write) — the byte offset
+        of the end of the last GOOD line. The caller MUST truncate there
+        before appending: appending past a corrupt tail would strand every
+        later event behind the gap on all future replays (silent loss of
+        post-crash history).
+
+        Corrupt INTERIOR lines — bad lines with good lines after them (a
+        torn write the process survived, bit rot) — are skipped and counted
+        in ``replay_skipped``, each logged with its line number. Aborting
+        on them would trade one lost event for the whole post-gap history;
+        replay applies everything that parses and lets the counter/health
+        probe surface the gap."""
         if not os.path.exists(self.path):
             return 0, None
         applied = 0
-        good_end = 0
+        offset = 0  # byte offset after the current line
+        good_end = 0  # byte offset after the last good line
+        bad_run: list = []  # (lineno, error) since the last good line
         with open(self.path, "rb") as f:
             for lineno, raw in enumerate(f, 1):
+                offset += len(raw)
                 line = raw.strip()
                 if not line:
-                    good_end += len(raw)
-                    continue
+                    continue  # blank line: harmless, neither good nor bad
                 try:
                     event = json.loads(line.decode("utf-8"))
                     self._apply(event)
-                    applied += 1
-                    good_end += len(raw)
                 except (
                     json.JSONDecodeError,
                     KeyError,
                     ValueError,
                     UnicodeDecodeError,
                 ) as e:
-                    # only acceptable at the tail (crash mid-write); report
-                    # either way and stop — replaying past a gap would
-                    # reorder history
+                    bad_run.append((lineno, str(e)))
+                    continue
+                applied += 1
+                # bad lines BEFORE a good line are interior corruption:
+                # skip-and-count, never truncate (that would delete the
+                # good history that follows)
+                for bad_lineno, err in bad_run:
+                    self.replay_skipped += 1
                     logger.warning(
-                        "journal %s: stopping replay at line %d (%s); "
-                        "truncating the corrupt tail",
-                        self.path, lineno, e,
+                        "journal %s: skipping corrupted line %d (%s)",
+                        self.path, bad_lineno, err,
                     )
-                    return applied, good_end
+                bad_run = []
+                good_end = offset
+        if bad_run:
+            # trailing corrupt run (crash mid-write): truncate it away
+            logger.warning(
+                "journal %s: dropping %d corrupt trailing line(s) from "
+                "line %d (%s); truncating",
+                self.path, len(bad_run), bad_run[0][0], bad_run[0][1],
+            )
+            return applied, good_end
         return applied, None
 
     def _apply(self, event: dict) -> None:
@@ -136,14 +174,42 @@ class StoreJournal:
                 "object": object_to_dict(event.obj),
             }
         )
+        fault = self.faults.check("journal.append") if self.faults is not None else None
         with self._lock:
             if self._file is None:
+                return
+            if fault is not None and fault.mode == "error":
+                # simulated failed write: the event never reaches the log
+                # (the gap is what replay-convergence soaks must tolerate)
+                self.write_errors += 1
+                return
+            if fault is not None and fault.mode == "torn":
+                # half the line, no newline: the NEXT append concatenates
+                # onto the fragment, producing one corrupt interior line —
+                # the exact artifact a crash between write() and the
+                # newline leaves behind
+                self._file.write(line[: max(1, len(line) // 2)])
+                self._file.flush()
+                self.torn_writes += 1
+                self._lines += 1
                 return
             self._file.write(line + "\n")
             self._file.flush()
             self._lines += 1
             if self._lines >= self.compact_after:
-                self._compact_locked()
+                try:
+                    self._compact_locked()
+                except OSError:
+                    # a failed compaction (disk full, fsync error) must not
+                    # propagate into the store's dispatch — the old log is
+                    # intact and still growing; retry a full window later
+                    self.compact_failures += 1
+                    self._lines = 0
+                    logger.warning(
+                        "journal %s: compaction failed; keeping the "
+                        "uncompacted log and retrying later",
+                        self.path, exc_info=True,
+                    )
 
     def _compact_locked(self) -> None:
         """Rewrite the journal as a snapshot of the CURRENT store contents
@@ -170,6 +236,11 @@ class StoreJournal:
                         + "\n"
                     )
                 f.flush()
+                if self.faults is not None:
+                    self.faults.maybe_raise(
+                        "journal.fsync",
+                        default=lambda: OSError("injected fsync failure"),
+                    )
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
         except OSError:
@@ -183,6 +254,26 @@ class StoreJournal:
         self._lines = len(objs)
         logger.info("journal %s compacted to %d objects", self.path, len(objs))
 
+    def compact(self) -> None:
+        """Force a compaction now (operational hook + the chaos soak's
+        heal-the-log step): the journal becomes a clean snapshot of the
+        live store, erasing any torn/corrupt interior lines."""
+        with self._lock:
+            if self._file is not None:
+                self._compact_locked()
+
+    def health_state(self) -> Tuple[str, dict]:
+        """Health-component contract (health.py): degraded while any
+        corruption/write-loss counter is nonzero — the journal still works,
+        but an operator should know recovery was lossy."""
+        detail = {
+            "replaySkipped": self.replay_skipped,
+            "writeErrors": self.write_errors,
+            "compactFailures": self.compact_failures,
+        }
+        degraded = self.replay_skipped or self.write_errors or self.compact_failures
+        return ("degraded" if degraded else "ok"), detail
+
     def close(self) -> None:
         for kind in Store.KINDS:
             self.store.remove_event_handler(kind, self._on_event)
@@ -193,14 +284,19 @@ class StoreJournal:
                 self._file = None
 
 
-def attach(store: Store, path: str, compact_after: int = 100_000) -> StoreJournal:
+def attach(
+    store: Store, path: str, compact_after: int = 100_000, faults=None
+) -> StoreJournal:
     """Replay ``path`` into the (freshly constructed, empty) store, then
     journal every subsequent event to it. Must run BEFORE other handlers
     are registered so replayed events don't double-dispatch."""
-    journal = StoreJournal(store, path, compact_after=compact_after)
+    journal = StoreJournal(store, path, compact_after=compact_after, faults=faults)
     n, truncate_at = journal._replay()
     if n:
-        logger.info("journal %s: replayed %d events", path, n)
+        logger.info(
+            "journal %s: replayed %d events (%d corrupted line(s) skipped)",
+            path, n, journal.replay_skipped,
+        )
     if truncate_at is not None:
         with open(path, "r+b") as f:
             f.truncate(truncate_at)

@@ -1,0 +1,245 @@
+"""Deterministic, seedable fault injection for the failure paths this
+standalone recast owns.
+
+The reference is crash-only because the apiserver is its state of record
+(SURVEY §5): a dropped watch, a 410 relist, a torn write are all somebody
+else's recovery problem. Here the transport, the watch fan-out, the journal,
+and the device dispatch are OUR code — so their failure paths need to be
+drivable on demand, deterministically, from tests and soaks.
+
+Design:
+
+- a :class:`FaultPlan` is a seed plus an ordered list of :class:`FaultRule`
+  entries, each scoped to a *site* pattern (``fnmatch`` glob over dotted
+  site names like ``transport.watch.read`` or ``journal.append``);
+- instrumented code calls ``plan.check(site)`` (or the raising convenience
+  ``plan.maybe_raise(site)``) at each fault point; a hit either fires a
+  :class:`FiredFault` or passes through;
+- **determinism**: the fire/no-fire decision for hit *n* at site *s* under
+  rule *r* is a pure function of ``(seed, r, s, n)`` — per-decision RNG,
+  no shared stream — so concurrent threads hitting different sites cannot
+  perturb each other's fault sequences. Same seed → bit-for-bit the same
+  per-site fault sequence, regardless of thread interleaving;
+- every firing is recorded in ``plan.history[site]`` (hit index + mode),
+  which doubles as the reproducibility witness and the soak's post-mortem
+  trace.
+
+Sites are interpreted by the instrumented layer: the plan only decides
+*when*; the site decides *what* a firing means (raise, torn write, stream
+cut, forced 409, added delay). The instrumented sites in-tree:
+
+==========================  ==================================================
+site                        effect of a firing
+==========================  ==================================================
+transport.request           ConnectionResetError before the HTTP round trip
+transport.put.conflict      ConflictError from put() (409 storm)
+transport.watch.open        ApiError(500) opening the watch stream
+transport.watch.read        per-event: mode "close" ends the stream, "gone"
+                            raises GoneError (410 storm), "error" raises,
+                            "delay" stalls the read
+journal.append              mode "torn" writes half the line (interior
+                            corruption for the NEXT append), "error" skips
+                            the write
+journal.fsync               OSError during compaction fsync
+device.dispatch             dispatch raises (opens the circuit breaker)
+mock.list                   mockserver LIST answers 500 ("error"), 410
+                            ("gone"), or stalls ("delay")
+mock.watch.cut              mockserver cuts the watch stream mid-flight
+mock.watch.gone             mockserver emits a 410 ERROR event mid-stream
+mock.status.conflict        mockserver 409s a status PUT
+mock.status.error           mockserver 500s a status PUT
+==========================  ==================================================
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class FaultInjected(Exception):
+    """Default exception raised at a firing fault point with mode
+    ``error`` and no explicit ``error`` factory."""
+
+
+@dataclass(frozen=True)
+class FiredFault:
+    """One firing at one site: what the instrumented code should do."""
+
+    site: str
+    hit: int  # 1-based hit index at this site
+    mode: str  # "error" | "close" | "gone" | "torn" | "delay" | ...
+    rule_site: str  # the rule pattern that fired
+    delay: float = 0.0
+    _error: Optional[Callable[[], BaseException]] = None
+
+    def make_error(self) -> BaseException:
+        if self._error is not None:
+            return self._error()
+        return FaultInjected(f"injected fault at {self.site} (hit {self.hit})")
+
+    def sleep(self) -> None:
+        if self.delay > 0:
+            time.sleep(self.delay)
+
+
+@dataclass
+class FaultRule:
+    """When to fire at matching sites.
+
+    ``schedule`` (1-based hit indices, applied after ``after`` is skipped)
+    beats ``probability``; ``times`` caps total firings per site; ``after``
+    lets the first N hits through untouched (e.g. let the initial sync
+    succeed, then storm)."""
+
+    site: str  # fnmatch pattern over dotted site names
+    mode: str = "error"
+    error: Optional[Callable[[], BaseException]] = None
+    probability: float = 1.0
+    times: Optional[int] = None
+    after: int = 0
+    schedule: Optional[Sequence[int]] = None
+    delay: float = 0.0
+    _schedule_set: Optional[frozenset] = field(default=None, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.schedule is not None:
+            self._schedule_set = frozenset(int(i) for i in self.schedule)
+
+
+def _decision(seed: int, rule_idx: int, site: str, hit: int) -> float:
+    """Uniform [0,1) that depends ONLY on (seed, rule, site, hit) — sha256,
+    not ``hash()``, because PYTHONHASHSEED would break cross-process
+    reproducibility of a recorded fault plan."""
+    digest = hashlib.sha256(
+        f"{seed}\x00{rule_idx}\x00{site}\x00{hit}".encode()
+    ).digest()
+    return int.from_bytes(digest[:8], "big") / 2**64
+
+
+class FaultPlan:
+    """A seeded set of fault rules plus the per-site hit/firing bookkeeping.
+
+    Thread-safe; the decision function is stateless per hit (see module
+    docstring), so the per-site fault sequence is reproducible from the
+    seed alone."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rules: List[FaultRule] = []
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[Tuple[int, str], int] = {}  # (rule idx, site) → count
+        # site → [(hit, mode)] — the reproducibility witness
+        self.history: Dict[str, List[Tuple[int, str]]] = {}
+
+    def rule(
+        self,
+        site: str,
+        *,
+        mode: str = "error",
+        error: Optional[Callable[[], BaseException]] = None,
+        probability: float = 1.0,
+        times: Optional[int] = None,
+        after: int = 0,
+        schedule: Optional[Sequence[int]] = None,
+        delay: float = 0.0,
+    ) -> "FaultPlan":
+        """Add a rule; returns self for chaining."""
+        self._rules.append(
+            FaultRule(
+                site=site,
+                mode=mode,
+                error=error,
+                probability=probability,
+                times=times,
+                after=after,
+                schedule=schedule,
+                delay=delay,
+            )
+        )
+        return self
+
+    # -- the fault point API ------------------------------------------------
+
+    def check(self, site: str) -> Optional[FiredFault]:
+        """Count a hit at ``site``; return the fault to apply, or None.
+        First matching rule that decides to fire wins (rule order is
+        priority order)."""
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            for idx, rule in enumerate(self._rules):
+                if not fnmatch.fnmatchcase(site, rule.site):
+                    continue
+                if hit <= rule.after:
+                    continue
+                key = (idx, site)
+                if rule.times is not None and self._fired.get(key, 0) >= rule.times:
+                    continue
+                if rule._schedule_set is not None:
+                    fire = (hit - rule.after) in rule._schedule_set
+                elif rule.probability >= 1.0:
+                    fire = True
+                else:
+                    fire = _decision(self.seed, idx, site, hit) < rule.probability
+                if not fire:
+                    continue
+                self._fired[key] = self._fired.get(key, 0) + 1
+                self.history.setdefault(site, []).append((hit, rule.mode))
+                return FiredFault(
+                    site=site,
+                    hit=hit,
+                    mode=rule.mode,
+                    rule_site=rule.site,
+                    delay=rule.delay,
+                    _error=rule.error,
+                )
+        return None
+
+    def maybe_raise(
+        self, site: str, default: Callable[[], BaseException] = None
+    ) -> None:
+        """Convenience for sites whose only failure mode is raising: check,
+        apply any delay, then raise the fault's error (``default`` supplies
+        the exception factory when the rule carries none)."""
+        fault = self.check(site)
+        if fault is None:
+            return
+        fault.sleep()
+        if fault.mode == "delay":
+            return  # pure stall, no error
+        if fault._error is None and default is not None:
+            raise default()
+        raise fault.make_error()
+
+    # -- introspection ------------------------------------------------------
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def fired(self, site: Optional[str] = None) -> int:
+        """Total firings, optionally for one site."""
+        with self._lock:
+            if site is not None:
+                return len(self.history.get(site, []))
+            return sum(len(v) for v in self.history.values())
+
+    def snapshot(self) -> Dict[str, List[Tuple[int, str]]]:
+        """Deep-ish copy of the per-site firing history (the determinism
+        witness: equal across runs for equal seeds and site hit counts)."""
+        with self._lock:
+            return {site: list(v) for site, v in self.history.items()}
+
+    def reset(self) -> None:
+        """Clear hit counts and history, keep the rules (new run, same
+        plan)."""
+        with self._lock:
+            self._hits.clear()
+            self._fired.clear()
+            self.history.clear()

@@ -1,0 +1,82 @@
+"""Per-component health state machine behind ``/readyz``.
+
+kube-scheduler's healthz is a flat 200/500; a degraded throttler is more
+nuanced — the device breaker being open is a latency regression, not
+unreadiness (the host oracle serves); a reflector stuck in backoff is
+stale-but-serving; a journal that skipped corrupt lines recovered lossily.
+Operators need those distinctions without grepping logs, and probes need a
+single verdict.
+
+Components register a probe returning ``(state, detail)`` where state is
+one of ``ok`` / ``degraded`` / ``down``:
+
+- ``ok``       — fully functional;
+- ``degraded`` — serving with reduced fidelity/latency (open breaker,
+  reflector retrying, lossy journal recovery); /readyz stays 200 so the
+  pod is NOT yanked from rotation while it can still answer;
+- ``down``     — the component cannot serve (reflector never synced:
+  admission verdicts would be fabricated from an empty cache); /readyz
+  returns 503.
+
+The aggregate verdict is the worst component state. Probes run at request
+time on the serving thread — they must be cheap reads of existing state,
+never RPCs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Tuple, Union
+
+# probe return: "ok" | ("ok", {...detail}) — detail optional
+ProbeResult = Union[str, Tuple[str, dict]]
+Probe = Callable[[], ProbeResult]
+
+_SEVERITY = {"ok": 0, "degraded": 1, "down": 2}
+STATES = tuple(_SEVERITY)
+
+
+class Health:
+    """Registry of component probes + aggregate snapshot."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._probes: Dict[str, Probe] = {}
+
+    def register(self, component: str, probe: Probe) -> None:
+        """Register (or replace) a component probe."""
+        with self._lock:
+            self._probes[component] = probe
+
+    def unregister(self, component: str) -> None:
+        with self._lock:
+            self._probes.pop(component, None)
+
+    def snapshot(self) -> dict:
+        """Run every probe; returns ``{"state": worst, "components":
+        {name: {"state": ..., ...detail}}}``. A probe that raises marks its
+        component ``down`` (a broken health check is not evidence of
+        health) rather than failing the endpoint."""
+        with self._lock:
+            probes = list(self._probes.items())
+        components: Dict[str, dict] = {}
+        worst = "ok"
+        for name, probe in probes:
+            try:
+                result = probe()
+            except Exception as e:  # noqa: BLE001 — probe bugs must not 500 /readyz
+                state, detail = "down", {"error": f"{e.__class__.__name__}: {e}"}
+            else:
+                if isinstance(result, tuple):
+                    state, detail = result
+                else:
+                    state, detail = result, {}
+                if state not in _SEVERITY:
+                    state, detail = "down", {"error": f"bad probe state {state!r}"}
+            components[name] = {"state": state, **(detail or {})}
+            if _SEVERITY[state] > _SEVERITY[worst]:
+                worst = state
+        return {"state": worst, "components": components}
+
+
+__all__ = ["Health", "STATES"]

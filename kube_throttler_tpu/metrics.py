@@ -217,7 +217,8 @@ class Registry:
                     labels = ",".join(
                         f'{n}="{esc(v)}"' for n, v in zip(g.label_names, key)
                     )
-                    lines.append(f"{g.name}{{{labels}}} {fmt(value)}")
+                    brace = f"{{{labels}}}" if labels else ""
+                    lines.append(f"{g.name}{brace} {fmt(value)}")
         for h in histograms:
             lines.append(f"# HELP {h.name} {h.help}")
             lines.append(f"# TYPE {h.name} histogram")
@@ -347,6 +348,59 @@ class _KindRecorder:
             calc = thr.status.calculated_threshold.threshold
             self._record_counts(self.calculated_counts, base, calc.resource_counts)
             self._record_requests(self.calculated_requests, base, calc)
+
+
+_BREAKER_STATE_VALUES = {"closed": 0.0, "open": 1.0, "half-open": 2.0}
+
+
+def register_breaker_metrics(registry: Registry, device_manager) -> GaugeVec:
+    """Device circuit-breaker state gauge (0=closed, 1=open, 2=half-open),
+    refreshed at scrape time from the manager's state machine — the
+    operator-facing answer to "is admission serving device- or host-side
+    right now, and is a probe pending?"."""
+    gauge = registry.gauge_vec(
+        "kube_throttler_device_breaker_state",
+        "device circuit breaker state (0=closed, 1=open, 2=half-open)",
+        [],
+    )
+    registry.register_pre_expose(
+        lambda: gauge.set_key(
+            (), _BREAKER_STATE_VALUES.get(device_manager.breaker_state(), 0.0)
+        )
+    )
+    return gauge
+
+
+def register_watch_metrics(registry: Registry) -> None:
+    """Watch-queue depth/overflow families, fed at scrape time from the
+    Watch class aggregates (client/watch.py): queue depth climbing means a
+    consumer is falling behind; the overflow counter moving means events
+    were shed and that consumer must relist."""
+    from .client.watch import Watch
+
+    open_g = registry.gauge_vec(
+        "kube_throttler_watch_streams_open", "live Watch streams", []
+    )
+    depth_g = registry.gauge_vec(
+        "kube_throttler_watch_queue_depth",
+        "events queued across live Watch streams (slow-consumer lag)",
+        [],
+    )
+    dropped_c = registry.counter_vec(
+        "kube_throttler_watch_overflow_total",
+        "events shed by bounded Watch queues (drop-oldest policy); a "
+        "consumer that overflowed has a gap and must relist",
+        [],
+    )
+
+    def flush() -> None:
+        stats = Watch.stats()
+        open_g.set_key((), float(stats["open"]))
+        depth_g.set_key((), float(stats["depth"]))
+        # the class-level total is already monotonic: expose it directly
+        dropped_c.set_key((), float(stats["dropped_total"]))
+
+    registry.register_pre_expose(flush)
 
 
 class ThrottleMetricsRecorder:

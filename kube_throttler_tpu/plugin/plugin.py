@@ -17,7 +17,14 @@ from ..client import Clientset, InformerBundle, Listers, SharedInformerFactory
 from ..controllers import ClusterThrottleController, ThrottleController
 from ..engine.devicestate import DeviceStateManager
 from ..engine.store import Store
-from ..metrics import ClusterThrottleMetricsRecorder, Registry, ThrottleMetricsRecorder
+from ..health import Health
+from ..metrics import (
+    ClusterThrottleMetricsRecorder,
+    Registry,
+    ThrottleMetricsRecorder,
+    register_breaker_metrics,
+    register_watch_metrics,
+)
 from ..utils.tracing import PhaseTracer, vlog
 from ..utils.clock import Clock, RealClock
 from .args import KubeThrottlerPluginArgs
@@ -117,6 +124,7 @@ class KubeThrottler:
                 "(decisions/reconciles served host-side meanwhile)",
                 ["surface"],
             )
+            register_breaker_metrics(self.metrics_registry, self.device_manager)
             # reservation replay onto freshly allocated device columns
             # (throttle re-creation / throttlerName handover) reads these
             self.device_manager.reservation_sources = {
@@ -125,6 +133,14 @@ class KubeThrottler:
             }
         self.throttle_ctr.tracer = self.tracer
         self.cluster_throttle_ctr.tracer = self.tracer
+        register_watch_metrics(self.metrics_registry)
+        # /readyz component registry (health.py): the daemon surface serves
+        # its snapshot; the CLI adds journal/reflector components when they
+        # exist (standalone vs remote mode)
+        self.health = Health()
+        if self.device_manager is not None:
+            self.health.register("device", self._device_health)
+        self.health.register("workqueues", self._workqueue_health)
         self._coalescer = None
         if start_workers:
             self.throttle_ctr.start()
@@ -133,6 +149,30 @@ class KubeThrottler:
     @property
     def name(self) -> str:
         return PLUGIN_NAME
+
+    # ------------------------------------------------------------- health
+
+    def _device_health(self):
+        # an open/half-open breaker is DEGRADED, not down: the host oracle
+        # serves every admission surface, at worse latency
+        state = self.device_manager.breaker_state()
+        return ("ok" if state == "closed" else "degraded"), {"breaker": state}
+
+    # a workqueue this deep means reconciles are falling behind events by
+    # minutes — still serving (degraded), but an operator should look
+    WORKQUEUE_DEGRADED_DEPTH = 10_000
+
+    def _workqueue_health(self):
+        depths = {
+            "throttle": len(self.throttle_ctr.workqueue),
+            "clusterthrottle": len(self.cluster_throttle_ctr.workqueue),
+        }
+        state = (
+            "degraded"
+            if max(depths.values()) >= self.WORKQUEUE_DEGRADED_DEPTH
+            else "ok"
+        )
+        return state, depths
 
     def coalescer(self, window_s: float = 0.0, max_batch: int = 64):
         """The micro-batching pre_filter front-end for CONCURRENT callers:

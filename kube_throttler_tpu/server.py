@@ -160,19 +160,26 @@ class ThrottlerHTTPServer:
         if h.path == "/healthz":
             h._send(200, "ok", content_type="text/plain")
         elif h.path == "/readyz":
-            # component readiness: workqueue depths, device breaker state.
-            # 200 while serving is possible (the device being down is a
-            # degraded-latency state, not unreadiness — the host oracle
-            # serves); deep JSON for operators/probes that want detail.
+            # component readiness via the health state machine (health.py):
+            # 200 while serving is possible — ok AND degraded both serve
+            # (an open device breaker is a latency regression, the host
+            # oracle answers); 503 only when a component is down (e.g. a
+            # reflector that never synced — verdicts would be fabricated
+            # from an empty cache). Legacy keys (ok/device/workqueues) are
+            # kept for existing probes.
             dm = self.plugin.device_manager
+            snap = self.plugin.health.snapshot()
             body = {
-                "ok": True,
+                "ok": snap["state"] != "down",
+                "state": snap["state"],
+                "components": snap["components"],
                 "device": (
                     {"enabled": False}
                     if dm is None
                     else {
                         "enabled": True,
                         "available": dm.device_available(),
+                        "breaker": dm.breaker_state(),
                     }
                 ),
                 "workqueues": {
@@ -180,7 +187,7 @@ class ThrottlerHTTPServer:
                     "clusterthrottle": len(self.plugin.cluster_throttle_ctr.workqueue),
                 },
             }
-            h._send(200, body)
+            h._send(200 if snap["state"] != "down" else 503, body)
         elif h.path == "/metrics":
             h._send(
                 200,
