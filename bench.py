@@ -688,9 +688,18 @@ def bench_streaming(rng, T, R, label, n_events=1000):
     return eps
 
 
-def _served_throttle(i, groups):
+def _served_throttle(i, groups, flip_band_mc=0):
     """Throttle i selecting pod group g{i%groups}; threshold class varies so
-    probe verdicts mix (open / tight cpu / pod-count)."""
+    probe verdicts mix (open / tight cpu / pod-count).
+
+    ``flip_band_mc`` > 0 carves a FLIP BAND out of the tight-cpu class:
+    every 24th throttle's cpu threshold sits AT the expected group cpu sum
+    (P/groups × the 400m churn mean), so the paced churn's random walk
+    around that sum produces real throttled↔not-throttled crossings — the
+    events the flip-lag percentiles measure. Without the band, a scale
+    mismatch leaves every cpu threshold far from the live sum (at 100k×10k
+    the group sum ~80 cpu dwarfs the 2-14 cpu class) and a whole window
+    can pass with zero flips, making flip_lag_p99 unmeasurable."""
     from kube_throttler_tpu.api.types import (
         LabelSelector,
         ResourceAmount,
@@ -700,7 +709,9 @@ def _served_throttle(i, groups):
         ThrottleSpec,
     )
 
-    if i % 3 == 0:
+    if flip_band_mc and i % 24 == 1:
+        threshold = ResourceAmount.of(requests={"cpu": f"{flip_band_mc}m"})
+    elif i % 3 == 0:
         threshold = ResourceAmount.of(pod=10**6, requests={"cpu": "100000"})
     elif i % 3 == 1:
         threshold = ResourceAmount.of(requests={"cpu": f"{(i % 7 + 1) * 2}"})
@@ -720,6 +731,12 @@ def _served_throttle(i, groups):
             ),
         ),
     )
+
+
+def _flip_band_mc(P, groups):
+    """Expected group cpu sum in milli: P/groups pods × the 400m mean of
+    the churn generator's rng.randrange(1, 8) * 100 distribution."""
+    return round(P / groups * 400)
 
 
 def build_served_stack(P, T, groups=500, label="served"):
@@ -745,10 +762,12 @@ def build_served_stack(P, T, groups=500, label="served"):
     store.create_namespace(Namespace("default"))
 
     t0 = time.perf_counter()
+    flip_mc = _flip_band_mc(P, groups)
     for i in range(T):
-        store.create_throttle(_served_throttle(i, groups))
+        store.create_throttle(_served_throttle(i, groups, flip_band_mc=flip_mc))
     t_thr = time.perf_counter() - t0
-    log(f"[{label}] created {T} throttles in {t_thr:.1f}s")
+    log(f"[{label}] created {T} throttles in {t_thr:.1f}s "
+        f"(flip band: every 24th cpu threshold at {flip_mc}m)")
 
     t0 = time.perf_counter()
     from dataclasses import replace as _replace
@@ -776,6 +795,15 @@ def build_served_stack(P, T, groups=500, label="served"):
         t0 = time.perf_counter()
         nk = plugin.device_manager.prewarm()
         log(f"[{label}] prewarmed {nk} kernel shapes in {time.perf_counter()-t0:.1f}s")
+    # same pre-serving step the daemon takes (cli.py): freeze the startup
+    # heap so automatic full GCs never rescan the 100k-pod graph — without
+    # it gen2 pauses (500-750ms at full scale) land inside reconcile
+    # drains and dominate the flip-publication tail
+    from kube_throttler_tpu.utils.gchygiene import freeze_startup_heap
+
+    frozen = freeze_startup_heap()
+    if frozen > 0:
+        log(f"[{label}] gc hygiene: froze {frozen} startup objects; gen2 deferred")
     return store, plugin
 
 
@@ -886,6 +914,85 @@ def bench_served_prefilter(plugin, label, groups=500, n=2000):
     return stats, rate1, rate4, rate4_co
 
 
+def bench_coalesce_crossover(plugin, label, dispatch_ms=1.0, threads=4, duration=2.0):
+    """(VERDICT r5 rec 6) The coalescer's designed win condition, emulated:
+    per-dispatch cost ≥1ms — the shape of a remote-accelerator tunnel round
+    trip, where every direct pre_filter pays TWO blocking dispatches (one
+    per kind) while the coalescer amortizes two across a whole window's
+    batch. The manager's check entry points are wrapped with a sleep of
+    ``dispatch_ms`` per dispatch (sleep releases the GIL, exactly like a
+    blocking device read), 4-thread direct vs coalesced throughput is
+    measured, and the wrappers are removed. On this single-core CPU host
+    the UN-emulated comparison loses (~0.4× r5) — this measures the
+    crossover itself, empirically, instead of asserting it."""
+    import threading as _threading
+
+    from kube_throttler_tpu.api.pod import make_pod
+
+    dm = plugin.device_manager
+    probes = [
+        make_pod(
+            f"xprobe{i}",
+            labels={"grp": f"g{i % 500}"},
+            requests={"cpu": f"{(i % 7 + 1) * 100}m"},
+        )
+        for i in range(64)
+    ]
+    orig_pod, orig_multi = dm.check_pod, dm.check_pods_multi
+    delay = dispatch_ms / 1e3
+
+    def slow_pod(*a, **k):
+        time.sleep(delay)
+        return orig_pod(*a, **k)
+
+    def slow_multi(*a, **k):
+        time.sleep(delay)
+        return orig_multi(*a, **k)
+
+    def measure(fn):
+        stop = _threading.Event()
+        counts = [0] * threads
+
+        def worker(idx):
+            j = idx
+            while not stop.is_set():
+                fn(probes[j % len(probes)])
+                counts[idx] += 1
+                j += threads
+
+        ts = [_threading.Thread(target=worker, args=(w,)) for w in range(threads)]
+        for t in ts:
+            t.start()
+        time.sleep(duration)
+        stop.set()
+        for t in ts:
+            t.join(timeout=10)
+        return sum(counts) / duration
+
+    co = plugin.coalescer()
+    plugin.pre_filter(probes[0])
+    co.pre_filter(probes[0])  # warm both paths before arming the delay
+    dm.check_pod, dm.check_pods_multi = slow_pod, slow_multi
+    try:
+        direct = measure(plugin.pre_filter)
+        coalesced = measure(co.pre_filter)
+    finally:
+        dm.check_pod, dm.check_pods_multi = orig_pod, orig_multi
+    ratio = coalesced / max(direct, 1e-9)
+    log(
+        f"[{label}] COALESCE CROSSOVER (emulated {dispatch_ms:.1f}ms/dispatch, "
+        f"{threads} threads): direct {direct:,.0f}/s vs coalesced "
+        f"{coalesced:,.0f}/s -> {ratio:.2f}x "
+        f"({'coalescer wins' if ratio > 1 else 'direct wins'})"
+    )
+    return {
+        "dispatch_ms": dispatch_ms,
+        "direct_per_sec": direct,
+        "coalesced_per_sec": coalesced,
+        "ratio": ratio,
+    }
+
+
 def bench_served_batch(plugin, label, iters=5):
     """Bulk triage through the SERVED surface: plugin.pre_filter_batch
     classifies every stored pod against both kinds' full state in one
@@ -949,26 +1056,92 @@ def bench_served_tick(plugin, label):
 
 
 def _lag_tracker():
-    """(pending, lock, lags, handler): handler pops a key's oldest pending
-    timestamp on its MODIFIED event and records the lag sample."""
+    """(pending, flip_pending, lock, lags, flip_lags, handler): handler
+    pops a key's oldest pending timestamp on its MODIFIED event and
+    records the lag sample — into ``lags`` always (total lag), and ALSO
+    into ``flip_lags`` when the write changed the throttled flags or the
+    calculated threshold (a FLIP: the only status change that alters
+    admission verdicts). The flip/total split is the bench-side mirror of
+    the daemon's kube_throttler_status_flip_lag_seconds histograms.
+
+    The two samples anchor to DIFFERENT events, deliberately:
+
+    - total lag anchors to the key's OLDEST unpublished event (the
+      staleness window — coalescing must not shrink it);
+    - flip lag anchors to the LATEST crossing event (``flip_pending``,
+      stamped by the churn generator when a group's running cpu sum
+      actually crosses a throttle's threshold — see ``_flip_watch_of``).
+      A value-only refresh queued 2 s ago does not make the *flag* wrong;
+      the flag is only wrong from the crossing onward, so pairing a flip
+      write with the oldest refresh event would overstate flip lag by the
+      whole refresh backlog. Latest-crossing (overwrite, not setdefault)
+      handles cross-back sequences: after cross→cross-back→cross, the
+      published flag is newly wrong from the LAST crossing, and anchoring
+      the first would blame the daemon for the interval the flag was
+      accidentally right. The stamp is popped only by a flip write —
+      clearing it on value-only writes would race a write computed from
+      pre-crossing aggregates landing just after the stamp. When no
+      crossing is pending for a flipping key (e.g. a calculatedThreshold
+      change), the sample falls back to the oldest-pending anchor
+      (conservative: overstates, never understates)."""
     import threading as _threading
 
     from kube_throttler_tpu.engine.store import EventType
 
     pending: dict = {}
+    flip_pending: dict = {}
     lock = _threading.Lock()
     lags: list = []
+    flip_lags: list = []
 
     def on_write(event):
         if event.type != EventType.MODIFIED:
             return
         now = time.perf_counter()
+        key = event.obj.key
+        old = event.old_obj
+        flipped = old is not None and (
+            old.status.throttled != event.obj.status.throttled
+            or old.status.calculated_threshold.threshold
+            != event.obj.status.calculated_threshold.threshold
+        )
         with lock:
-            t0 = pending.pop(event.obj.key, None)
+            t0 = pending.pop(key, None)
+            tf = flip_pending.pop(key, None) if flipped else None
+        if flipped:
+            anchor = tf if tf is not None else t0
+            if anchor is not None:
+                flip_lags.append(now - anchor)
         if t0 is not None:
             lags.append(now - t0)
 
-    return pending, lock, lags, on_write
+    return pending, flip_pending, lock, lags, flip_lags, on_write
+
+
+def _flip_watch_of(store):
+    """(flip_watch, run_sums) for crossing-anchored flip-lag measurement:
+    ``flip_watch`` maps group → [(throttle key, cpu threshold milli)] for
+    every throttle with a cpu-requests threshold; ``run_sums`` seeds each
+    group's running cpu sum (milli) from the stored pods — the same values
+    the churn generator seeds its per-pod ``prev`` from, so the
+    incremental sums track the daemon's ``status.used`` exactly."""
+    from kube_throttler_tpu.resourcelist import pod_request_resource_list
+
+    flip_watch: dict = {}
+    for thr in store.list_throttles():
+        cpu = (thr.spec.threshold.resource_requests or {}).get("cpu")
+        if cpu is None:
+            continue
+        g = thr.spec.selector.selector_terms[0].pod_selector.match_labels["grp"]
+        flip_watch.setdefault(g, []).append((thr.key, int(cpu * 1000)))
+    run_sums: dict = {}
+    for pod in store.list_pods():
+        g = pod.labels.get("grp")
+        if g is None:
+            continue
+        cpu = pod_request_resource_list(pod).get("cpu")
+        run_sums[g] = run_sums.get(g, 0) + (int(cpu * 1000) if cpu else 0)
+    return flip_watch, run_sums
 
 
 def _group_keys_of(store):
@@ -979,7 +1152,8 @@ def _group_keys_of(store):
     return group_keys
 
 
-def _drive_pod_churn(store, group_keys, pending, pend_lock, rng, duration, pace_hz):
+def _drive_pod_churn(store, group_keys, pending, pend_lock, rng, duration, pace_hz,
+                     flip_state=None):
     """The cfg5 churn generator, SHARED by the in-process and remote-wire
     serving benches so their lag numbers stay comparable: paced pod
     updates that are REAL state changes every time — the cpu value always
@@ -987,7 +1161,14 @@ def _drive_pod_churn(store, group_keys, pending, pend_lock, rng, duration, pace_
     request, so even a pod's first update cannot be a no-op that leaves a
     stale pending timestamp poisoning later lag samples). Every event
     pre-registers its group's throttle keys in ``pending`` for the
-    event→status-commit pairing. Returns (n_events, fire-window seconds)."""
+    event→status-commit pairing.
+
+    ``flip_state`` = (flip_watch, run_sums, flip_pending) arms
+    crossing-anchored flip stamping: the generator maintains each group's
+    running cpu sum and, when an update moves the sum across a watched
+    throttle's threshold, stamps ``flip_pending[key]`` — the event that
+    actually made the published flag wrong (see ``_lag_tracker``). Returns
+    (n_events, fire-window seconds, crossings stamped)."""
     from dataclasses import replace as _replace
 
     from kube_throttler_tpu.api.pod import make_pod
@@ -995,6 +1176,8 @@ def _drive_pod_churn(store, group_keys, pending, pend_lock, rng, duration, pace_
 
     pods = store.list_pods()
     cur_cpu: dict = {}  # pod name → last cpu we wrote
+    flip_watch, run_sums, flip_pending = flip_state or ({}, {}, {})
+    n_crossings = 0
     n_events = 0
     t_start = time.perf_counter()
     deadline = t_start + duration
@@ -1021,9 +1204,18 @@ def _drive_pod_churn(store, group_keys, pending, pend_lock, rng, duration, pace_
         with pend_lock:
             for key in group_keys.get(g, ()):
                 pending.setdefault(key, now)
+            watch = flip_watch.get(g)
+            if watch:
+                s_old = run_sums.get(g, 0)
+                s_new = s_old + new_cpu - prev
+                run_sums[g] = s_new
+                for key, thr_mc in watch:
+                    if (s_old >= thr_mc) != (s_new >= thr_mc):
+                        flip_pending[key] = now  # latest crossing wins
+                        n_crossings += 1
         store.update_pod(updated)
         n_events += 1
-    return n_events, time.perf_counter() - t_start
+    return n_events, time.perf_counter() - t_start, n_crossings
 
 
 def bench_served_streaming(
@@ -1048,13 +1240,17 @@ def bench_served_streaming(
 
     rng = random.Random(1)
     # key → time of the first event not yet reflected in a status write
-    pending, pend_lock, lags, on_throttle_write = _lag_tracker()
+    pending, flip_pending, pend_lock, lags, flip_lags, on_throttle_write = (
+        _lag_tracker()
+    )
     group_keys = _group_keys_of(store)
+    flip_watch, run_sums = _flip_watch_of(store)
     store.add_event_handler("Throttle", on_throttle_write, replay=False)
     plugin.start()
     try:
-        n_events, t_fired = _drive_pod_churn(
-            store, group_keys, pending, pend_lock, rng, duration, pace_hz
+        n_events, t_fired, n_crossings = _drive_pod_churn(
+            store, group_keys, pending, pend_lock, rng, duration, pace_hz,
+            flip_state=(flip_watch, run_sums, flip_pending),
         )
         t_start = time.perf_counter() - t_fired
         # drain: wait for both workqueues to empty and writes to land
@@ -1070,6 +1266,7 @@ def bench_served_streaming(
 
     eps = n_events / t_total
     lag_arr = np.asarray(lags) if lags else np.asarray([0.0])
+    flip_arr = np.asarray(flip_lags) if flip_lags else np.asarray([0.0])
     result = {
         "events_per_sec": eps,
         # the rate the generator actually achieved DURING the window —
@@ -1080,6 +1277,13 @@ def bench_served_streaming(
         "lag_p50_ms": float(np.percentile(lag_arr, 50)) * 1e3,
         "lag_p99_ms": float(np.percentile(lag_arr, 99)) * 1e3,
         "status_writes": len(lags),
+        # flip lag: crossing-event → flag-visible for writes that changed
+        # throttled/calculatedThreshold ([0.0] sentinel when
+        # flip_samples == 0 — don't read the percentiles then)
+        "flip_lag_p50_ms": float(np.percentile(flip_arr, 50)) * 1e3,
+        "flip_lag_p99_ms": float(np.percentile(flip_arr, 99)) * 1e3,
+        "flip_samples": len(flip_lags),
+        "flip_crossings": n_crossings,
     }
     mode = f"paced {pace_hz:,.0f}/s" if pace_hz else "max rate"
     log(
@@ -1088,7 +1292,10 @@ def bench_served_streaming(
         f"({result['fired_events_per_sec']:,.0f}/s during the fire window of "
         f"{t_fired:.2f}s); event->status-commit lag p50 "
         f"{result['lag_p50_ms']:.1f}ms / p99 {result['lag_p99_ms']:.1f}ms "
-        f"over {len(lags)} status writes (target: 1k events/sec)"
+        f"over {len(lags)} status writes; FLIP lag p50 "
+        f"{result['flip_lag_p50_ms']:.1f}ms / p99 {result['flip_lag_p99_ms']:.1f}ms "
+        f"over {len(flip_lags)} flips from {n_crossings} crossings "
+        f"(target: 1k events/sec, flip p99 <150ms)"
     )
     return result
 
@@ -1117,8 +1324,9 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
     server = MockApiServer(bookmark_interval=1.0)
     remote = server.store
     remote.create_namespace(Namespace("default"))
+    flip_mc = _flip_band_mc(P, groups)
     for i in range(T):
-        remote.create_throttle(_served_throttle(i, groups))
+        remote.create_throttle(_served_throttle(i, groups, flip_band_mc=flip_mc))
     for i in range(P):
         pod = make_pod(
             f"p{i}",
@@ -1142,8 +1350,11 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
     commit_counts: dict = {}
     # lag is remote-commit→remote-commit: the tracker watches the REMOTE
     # store's Throttle MODIFIEDs (the arriving status PUTs)
-    pending, pend_lock, lags, on_remote_status = _lag_tracker()
+    pending, flip_pending, pend_lock, lags, flip_lags, on_remote_status = (
+        _lag_tracker()
+    )
     group_keys = _group_keys_of(remote)
+    flip_watch, run_sums = _flip_watch_of(remote)
     try:
         session.start(sync_timeout=30)
         plugin = KubeThrottler(
@@ -1168,6 +1379,12 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
             ):
                 break
             time.sleep(0.25)
+        # pre-serving GC posture, same as the daemon (cli.py /
+        # build_served_stack): freeze the converged heap so full-GC pauses
+        # don't land in the measured window
+        from kube_throttler_tpu.utils.gchygiene import freeze_startup_heap
+
+        freeze_startup_heap()
         # raw wire capacity probe: one warm status PUT round trip, repeated
         # — the per-request floor every commit pays (http.client +
         # http.server protocol overhead shares the same core as the whole
@@ -1187,8 +1404,9 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
             if done:
                 wire_rtt_ms = (time.perf_counter() - t0) / done * 1e3
         remote.add_event_handler("Throttle", on_remote_status, replay=False)
-        n_events, t_fired = _drive_pod_churn(
-            remote, group_keys, pending, pend_lock, rng, duration, pace_hz
+        n_events, t_fired, n_crossings = _drive_pod_churn(
+            remote, group_keys, pending, pend_lock, rng, duration, pace_hz,
+            flip_state=(flip_watch, run_sums, flip_pending),
         )
         # drain tail: give in-flight writes a bounded window to land
         session.status_committer.flush(timeout=min(3.0, duration / 2))
@@ -1207,11 +1425,16 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
     # [0.0] sentinel when nothing landed (status_writes=0 disambiguates):
     # NaN would propagate into the one-line report and break strict JSON
     lag_arr = np.asarray(lags) if lags else np.asarray([0.0])
+    flip_arr = np.asarray(flip_lags) if flip_lags else np.asarray([0.0])
     result = {
         "events_per_sec": n_events / t_fired,  # rate during the fire window
         "lag_p50_ms": float(np.percentile(lag_arr, 50)) * 1e3,
         "lag_p99_ms": float(np.percentile(lag_arr, 99)) * 1e3,
         "status_writes": len(lags),
+        "flip_lag_p50_ms": float(np.percentile(flip_arr, 50)) * 1e3,
+        "flip_lag_p99_ms": float(np.percentile(flip_arr, 99)) * 1e3,
+        "flip_samples": len(flip_lags),
+        "flip_crossings": n_crossings,
         "wire_put_rtt_ms": round(wire_rtt_ms, 3),
         "commit_counts": commit_counts,
     }
@@ -1219,11 +1442,15 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
         f"[{label}] cfg5 REMOTE WIRE ({P} pods x {T} throttles, paced "
         f"{pace_hz:,.0f}/s): {n_events} events -> {result['events_per_sec']:,.0f}/s; "
         f"remote-commit lag p50 {result['lag_p50_ms']:.1f}ms / p99 "
-        f"{result['lag_p99_ms']:.1f}ms over {len(lags)} status PUTs; raw "
-        f"wire PUT RTT {wire_rtt_ms:.2f}ms (the per-request protocol floor "
-        f"this host's single core pays in-pipeline); committer outcomes "
-        f"{commit_counts} (watch -> reflector -> reconcile -> async "
-        "committer -> HTTP status subresource)"
+        f"{result['lag_p99_ms']:.1f}ms over {len(lags)} status PUTs; FLIP "
+        f"lag p50 {result['flip_lag_p50_ms']:.1f}ms / p99 "
+        f"{result['flip_lag_p99_ms']:.1f}ms over {len(flip_lags)} flips "
+        f"from {n_crossings} crossings (two-lane committer); raw wire PUT "
+        f"RTT {wire_rtt_ms:.2f}ms (the "
+        f"per-request protocol floor this host's single core pays "
+        f"in-pipeline); committer outcomes {commit_counts} (watch -> "
+        "reflector -> reconcile -> async committer -> HTTP status "
+        "subresource)"
     )
     return result
 
@@ -1479,6 +1706,14 @@ def main():
                     served_stats["decisions_cv"], 4
                 )
                 detail["served_thread_scaling"] = round(rate4 / max(rate1, 1e-9), 2)
+            cx = safe("served:coalesce-x", bench_coalesce_crossover, plugin_s, "served")
+            if cx:
+                detail["coalesce_emulated_dispatch_ms"] = cx["dispatch_ms"]
+                detail["coalesce_direct_4t_per_sec"] = round(cx["direct_per_sec"])
+                detail["coalesce_coalesced_4t_per_sec"] = round(
+                    cx["coalesced_per_sec"]
+                )
+                detail["coalesce_crossover_ratio"] = round(cx["ratio"], 2)
             b = safe("served:batch", bench_served_batch, plugin_s, "served")
             if b:
                 detail["served_batch_pods_per_sec"] = round(b["pods_per_sec"])
@@ -1526,6 +1761,10 @@ def main():
                 detail["cfg5_remote_lag_p50_ms"] = round(rw["lag_p50_ms"], 2)
                 detail["cfg5_remote_lag_p99_ms"] = round(rw["lag_p99_ms"], 2)
                 detail["cfg5_remote_status_puts"] = rw["status_writes"]
+                detail["cfg5_remote_flip_lag_p50_ms"] = round(rw["flip_lag_p50_ms"], 2)
+                detail["cfg5_remote_flip_lag_p99_ms"] = round(rw["flip_lag_p99_ms"], 2)
+                detail["cfg5_remote_flip_samples"] = rw["flip_samples"]
+                detail["cfg5_remote_flip_crossings"] = rw["flip_crossings"]
                 detail["cfg5_remote_wire_put_rtt_ms"] = rw["wire_put_rtt_ms"]
             # steady-state status-write lag at the BASELINE 1k/s target load
             s2 = safe(
@@ -1541,6 +1780,10 @@ def main():
                 detail["cfg5_paced_fired_per_sec"] = round(s2["fired_events_per_sec"])
                 detail["cfg5_status_lag_p50_ms"] = round(s2["lag_p50_ms"], 2)
                 detail["cfg5_status_lag_p99_ms"] = round(s2["lag_p99_ms"], 2)
+                detail["cfg5_flip_lag_p50_ms"] = round(s2["flip_lag_p50_ms"], 2)
+                detail["cfg5_flip_lag_p99_ms"] = round(s2["flip_lag_p99_ms"], 2)
+                detail["cfg5_flip_samples"] = s2["flip_samples"]
+                detail["cfg5_flip_crossings"] = s2["flip_crossings"]
                 detail["cfg5_lag_mode"] = "paced-1k"
             elif s:  # paced window failed: keep the max-rate lag measurement
                 detail["cfg5_status_lag_p50_ms"] = round(s["lag_p50_ms"], 2)
@@ -1594,6 +1837,23 @@ def main():
                         # the downstream full-scale cfg5 measurements
                         errors["served-full:tick"] = f"{e.__class__.__name__}: {e}"
                     plugin_f.start()
+                    # capacity window FIRST (max rate, longer window so the
+                    # fixed drain tail doesn't dilute the sustained rate):
+                    # the ≥1k events/s criterion reads this one
+                    sm = bench_served_streaming(
+                        store_f, plugin_f, "served-full", duration=12.0,
+                    )
+                    detail["fullscale_cfg5_maxrate_events_per_sec"] = round(
+                        sm["events_per_sec"]
+                    )
+                    detail["fullscale_cfg5_maxrate_fired_per_sec"] = round(
+                        sm["fired_events_per_sec"]
+                    )
+                    detail["fullscale_cfg5_maxrate_lag_p99_ms"] = round(
+                        sm["lag_p99_ms"], 1
+                    )
+                    # then the steady-state window at the nominal 1k/s load
+                    # — the lag and flip-lag numbers come from here
                     sf = bench_served_streaming(
                         store_f, plugin_f, "served-full",
                         duration=8.0, pace_hz=1000.0,
@@ -1610,6 +1870,14 @@ def main():
                     detail["fullscale_cfg5_lag_p99_ms"] = round(
                         sf["lag_p99_ms"], 1
                     )
+                    detail["fullscale_cfg5_flip_lag_p50_ms"] = round(
+                        sf["flip_lag_p50_ms"], 1
+                    )
+                    detail["fullscale_cfg5_flip_lag_p99_ms"] = round(
+                        sf["flip_lag_p99_ms"], 1
+                    )
+                    detail["fullscale_cfg5_flip_samples"] = sf["flip_samples"]
+                    detail["fullscale_cfg5_flip_crossings"] = sf["flip_crossings"]
                     detail["fullscale_scale"] = [100_000, 10_000]
                 finally:
                     try:

@@ -427,6 +427,20 @@ def main(argv: Optional[list] = None) -> int:
         wire.start()
         print(f"wire-protocol apiserver on {args.host}:{wire.port}", flush=True)
 
+    # last step before taking traffic: freeze the startup heap (store,
+    # device mirror, kernel caches) so automatic full GCs never rescan it
+    # — at 100k×10k those paused every thread 500-750ms, straight into the
+    # flip-publication tail; the hygiene thread is the periodic
+    # collect-and-refreeze leak backstop (utils/gchygiene.py)
+    from .utils.gchygiene import GcHygieneThread, enabled as gc_hygiene_enabled
+
+    gc_hygiene = None
+    if gc_hygiene_enabled():
+        from .utils.gchygiene import freeze_startup_heap
+
+        freeze_startup_heap()
+        gc_hygiene = GcHygieneThread(tracer=plugin.tracer)
+        gc_hygiene.start()
     server = ThrottlerHTTPServer(
         plugin, host=args.host, port=args.port, remote=session is not None
     )
@@ -440,6 +454,8 @@ def main(argv: Optional[list] = None) -> int:
     )
 
     stop.wait()
+    if gc_hygiene is not None:
+        gc_hygiene.stop()
     server.stop()
     if wire is not None:
         wire.stop()

@@ -1047,16 +1047,30 @@ class AsyncStatusCommitter:
 
     - ``submit`` stores the NEWEST planned object per key (newest-wins: a
       hot throttle re-reconciled 10× between wire commits costs ONE PUT);
+    - TWO LANES per shard: keys whose ``throttled`` flags or
+      ``calculatedThreshold`` changed (flips — the only status bits that
+      change admission verdicts) land in a priority lane drained before
+      the value-only ``used``-refresh lane. At the all-keys-dirty
+      equilibrium the refresh lane holds thousands of queued PUTs; without
+      the lane split a flip waited behind all of them (measured p99
+      2.3-2.8s at full scale), with it a flip waits at most one in-flight
+      PUT plus the other queued flips;
     - N workers drain the key slots concurrently over their own keep-alive
       connections (ApiClient is per-thread-connection already);
     - PER-KEY ORDERING is structural, not locked: a key hashes to exactly
-      one worker shard, and a shard slot only ever holds the newest object,
-      so two PUTs for one key can neither race nor reorder;
+      one worker shard, lives in exactly ONE lane slot at a time (a flip
+      submit promotes the key's pending slot; a later refresh updates that
+      slot in place without demoting it), and a shard is drained by one
+      worker — so two PUTs for one key can neither race nor reorder. Lane
+      assignment is a scheduling hint only: what gets PUT is always the
+      newest object, whichever lane it sat in;
     - 409 conflicts re-read the live resourceVersion and retry (bounded);
-      transient transport errors retry with backoff; a key that exhausts
-      retries is dropped with a counter bump — the controller's resync
-      re-plans it (crash-only stance: the next reconcile regenerates any
-      dropped publication from local truth).
+      transient transport errors retry with backoff; a REFRESH that fails
+      while flips are queued re-stages itself (keeping its retry budget)
+      so a conflict storm on the refresh lane cannot head-of-line block a
+      flip; a key that exhausts retries is dropped with a counter bump —
+      the controller's resync re-plans it (crash-only stance: the next
+      reconcile regenerates any dropped publication from local truth).
 
     The daemon's serving truth (host aggregates + reservations) is local;
     the PUT is publication. Reconcile therefore proceeds (unreserve-on-
@@ -1069,19 +1083,25 @@ class AsyncStatusCommitter:
                  metrics_registry=None, max_retries: int = 4):
         self._writer = writer
         self._n = max(1, int(workers))
-        self._shards: list = [{} for _ in range(self._n)]
+        # per-shard lanes: key → (kind, obj, event_ts|None, flip, attempts)
+        self._hi_shards: list = [{} for _ in range(self._n)]
+        self._lo_shards: list = [{} for _ in range(self._n)]
         self._conds = [threading.Condition() for _ in range(self._n)]
         self._busy = [False] * self._n
         self._threads: list = []
         self._stopped = False
         self._max_retries = max_retries
         self._commits = None
+        self._lag = None
         if metrics_registry is not None:
+            from ..metrics import StatusLagMetrics
+
             self._commits = metrics_registry.counter_vec(
                 "kube_throttler_remote_status_commit_total",
                 "remote status PUT outcomes by kind and result",
                 ["kind", "result"],
             )
+            self._lag = StatusLagMetrics(metrics_registry, "remote")
 
     # -- writer-compatible surface (status_writer duck type) --------------
 
@@ -1096,17 +1116,40 @@ class AsyncStatusCommitter:
         return thr
 
     def update_throttle_statuses(self, thrs) -> Dict[str, object]:
-        out: Dict[str, object] = {}
-        for thr in thrs:
-            self._submit("Throttle", thr, thr.key)
-            out[thr.key] = thr
-        return out
+        return self.update_throttle_statuses_prioritized(thrs)
 
     def update_cluster_throttle_statuses(self, thrs) -> Dict[str, object]:
+        return self.update_cluster_throttle_statuses_prioritized(thrs)
+
+    def update_throttle_statuses_prioritized(
+        self, thrs, flip_keys=frozenset(), event_ts=None
+    ) -> Dict[str, object]:
+        """Batch submit with lane routing: ``flip_keys`` (store keys) take
+        the priority lane; ``event_ts`` ({store key: monotonic ts of the
+        causing event}) feeds the flip/total lag histograms at PUT
+        completion."""
         out: Dict[str, object] = {}
+        ts = event_ts or {}
         for thr in thrs:
-            self._submit("ClusterThrottle", thr, thr.name)
-            out[thr.name] = thr
+            key = thr.key
+            self._submit(
+                "Throttle", thr, key, flip=key in flip_keys, event_ts=ts.get(key)
+            )
+            out[key] = thr
+        return out
+
+    def update_cluster_throttle_statuses_prioritized(
+        self, thrs, flip_keys=frozenset(), event_ts=None
+    ) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        ts = event_ts or {}
+        for thr in thrs:
+            key = thr.name
+            self._submit(
+                "ClusterThrottle", thr, key, flip=key in flip_keys,
+                event_ts=ts.get(key),
+            )
+            out[key] = thr
         return out
 
     # -- lifecycle --------------------------------------------------------
@@ -1138,22 +1181,42 @@ class AsyncStatusCommitter:
         deadline = time.monotonic() + timeout
         for i, cond in enumerate(self._conds):
             with cond:
-                while (self._shards[i] or self._busy[i]) and time.monotonic() < deadline:
+                while (
+                    self._hi_shards[i] or self._lo_shards[i] or self._busy[i]
+                ) and time.monotonic() < deadline:
                     cond.wait(0.05)
-                if self._shards[i] or self._busy[i]:
+                if self._hi_shards[i] or self._lo_shards[i] or self._busy[i]:
                     return False
         return True
 
     def pending(self) -> int:
-        return sum(len(s) for s in self._shards)
+        return sum(len(s) for s in self._hi_shards) + sum(
+            len(s) for s in self._lo_shards
+        )
 
     # -- internals --------------------------------------------------------
 
-    def _submit(self, kind: str, obj, key: str) -> None:
+    def _submit(
+        self, kind: str, obj, key: str, flip: bool = False, event_ts=None
+    ) -> None:
         i = hash(key) % self._n
         cond = self._conds[i]
         with cond:
-            self._shards[i][key] = (kind, obj)
+            hi, lo = self._hi_shards[i], self._lo_shards[i]
+            prev = hi.pop(key, None)
+            was_hi = prev is not None
+            if prev is None:
+                prev = lo.pop(key, None)
+            ts = event_ts
+            if prev is not None and prev[2] is not None:
+                # the lag sample spans from the OLDEST unpublished event:
+                # coalescing must not shrink the measured staleness window
+                ts = prev[2] if ts is None else min(ts, prev[2])
+            # promote-never-demote while pending: the newest object carries
+            # the flipped state until it is published, so the key keeps its
+            # lane even when the latest submit is a value-only refresh
+            is_flip = flip or (prev is not None and prev[3])
+            (hi if (flip or was_hi) else lo)[key] = (kind, obj, ts, is_flip, 0)
             cond.notify_all()
 
     def _count(self, kind: str, result: str) -> None:
@@ -1161,30 +1224,52 @@ class AsyncStatusCommitter:
             self._commits.inc({"kind": kind, "result": result})
 
     def _run(self, i: int) -> None:
-        cond, shard = self._conds[i], self._shards[i]
+        """Shard worker: one slot at a time, priority lane first. Taking a
+        single slot per lock hold (instead of the whole shard) is what lets
+        a flip submitted mid-backlog overtake queued refreshes: the lane
+        check re-runs before every PUT. The lock is ~ns against the ~ms
+        PUT it brackets."""
+        cond = self._conds[i]
+        hi, lo = self._hi_shards[i], self._lo_shards[i]
         while True:
             with cond:
-                while not shard and not self._stopped:
+                while not hi and not lo and not self._stopped:
                     cond.wait(0.2)
-                if self._stopped and not shard:
+                if self._stopped and not hi and not lo:
                     return
-                items = list(shard.items())
-                shard.clear()
+                lane = hi if hi else lo
+                key = next(iter(lane))  # dicts preserve insertion order
+                slot = lane.pop(key)
                 self._busy[i] = True
             try:
-                for _key, (kind, obj) in items:
-                    self._put_with_retry(kind, obj)
+                self._put_with_retry(i, key, slot)
             finally:
                 with cond:
                     self._busy[i] = False
                     cond.notify_all()  # wake flush()
 
-    def _put_with_retry(self, kind: str, obj) -> None:
-        delay = 0.01
-        for attempt in range(self._max_retries + 1):
+    def _restage(self, i: int, key: str, slot) -> bool:
+        """Put a failed refresh back at the tail of its lane so queued
+        flips go first; keeps the slot's retry budget. False when a newer
+        submit claimed the key meanwhile (newest-wins: this older object
+        is obsolete — drop it silently)."""
+        cond = self._conds[i]
+        with cond:
+            if key in self._hi_shards[i] or key in self._lo_shards[i]:
+                return False
+            lane = self._hi_shards[i] if slot[3] else self._lo_shards[i]
+            lane[key] = slot
+            return True
+
+    def _put_with_retry(self, i: int, key: str, slot) -> None:
+        kind, obj, ts, flip, attempts = slot
+        for attempt in range(attempts, self._max_retries + 1):
+            delay = min(0.01 * (2 ** attempt), 0.5)
             try:
                 self._writer._put(kind, obj)
                 self._count(kind, "ok")
+                if self._lag is not None and ts is not None:
+                    self._lag.observe(kind, time.monotonic() - ts, flip)
                 return
             except NotFoundError:
                 # the object was deleted while its status sat queued —
@@ -1199,18 +1284,25 @@ class AsyncStatusCommitter:
                     pass  # retry PUTs with the stale RV; bounded anyway
                 if self._stopped:
                     break
+                # a failing REFRESH must not head-of-line block queued
+                # flips: hand the shard back with the retry budget intact
+                # and let the worker drain the priority lane first
+                if not flip and self._hi_shards[i]:
+                    if self._restage(i, key, (kind, obj, ts, flip, attempt + 1)):
+                        return
                 # client-go's RetryOnConflict backs off too: under a
                 # persistent conflict (two writers fighting) immediate
                 # GET+PUT pairs multiply apiserver load exactly when it is
                 # already contended
                 time.sleep(delay)
-                delay = min(delay * 2, 0.5)
             except Exception:
                 self._count(kind, "retry")
                 if self._stopped:
                     break
+                if not flip and self._hi_shards[i]:
+                    if self._restage(i, key, (kind, obj, ts, flip, attempt + 1)):
+                        return
                 time.sleep(delay)
-                delay = min(delay * 2, 0.5)
         self._count(kind, "dropped")
         logger.warning(
             "dropping status publication for %s %s after %d attempts "

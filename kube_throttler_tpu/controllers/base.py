@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from datetime import timedelta
 from typing import Callable, Dict, List, Optional
 
@@ -69,6 +70,10 @@ class ControllerBase:
         # {store_key: id(status)} of writes in flight — see
         # _commit_reconcile_plans (self-echo suppression)
         self._inflight_status_echoes: Dict[str, int] = {}
+        # StatusLagMetrics for LOCAL publication (set by the plugin when a
+        # registry exists); remote publication is observed by the async
+        # committer at PUT completion instead
+        self.lag_metrics = None
         if self.resync_interval is not None:
             self.workqueue.add_after(RESYNC_KEY, self.resync_interval)
 
@@ -134,7 +139,7 @@ class ControllerBase:
 
     # ------------------------------------------------- batched-drain commit
 
-    def _commit_reconcile_plans(self, plans, now, errors) -> None:
+    def _commit_reconcile_plans(self, plans, now, errors, flip_keys=frozenset()) -> None:
         """Phases 2+3 of a batched reconcile drain, shared by both kinds'
         controllers (they differ only in writer methods and key forms).
 
@@ -150,11 +155,36 @@ class ControllerBase:
         window stays one PUT wide, exactly like the pre-batch code — a
         drain of slow PUTs must not delay key #1's unreserve to the end.
 
+        ``flip_keys`` (queue keys) are statuses whose ``throttled`` flags
+        or ``calculatedThreshold`` changed — the two-lane split: they are
+        committed FIRST in every path (batch write order == store event
+        dispatch order == watch order; interleaved PUTs go flips-first),
+        and a lane-aware writer (the AsyncStatusCommitter) additionally
+        routes them to its priority lane. Per-key ordering is unaffected:
+        a key appears in ``plans`` once, so reordering between keys can
+        never reorder writes of the same key.
+
         Controllers provide ``_write_status(thr)``,
         ``_batch_write_statuses(thrs) -> {store_key: obj|Exception} | None``
-        (None ⇒ unsupported), and ``_store_key(thr)``.
+        (None ⇒ unsupported), ``_store_key(thr)``, and
+        ``_prioritized_batch_attr`` (the lane-aware writer method name).
         """
+        if flip_keys:
+            plans = [p for p in plans if p[0] in flip_keys] + [
+                p for p in plans if p[0] not in flip_keys
+            ]
         changed = {key: new for key, _, new, _ in plans if new is not None}
+        # event→publication lag inputs, keyed by STORE key: the enqueue
+        # timestamp of the event that made each written key dirty
+        event_ts: Dict[str, float] = {}
+        flip_store_keys = set()
+        for key, new in changed.items():
+            sk = self._store_key(new)
+            ts = self.workqueue.claim_ts(key)
+            if ts is not None:
+                event_ts[sk] = ts
+            if key in flip_keys:
+                flip_store_keys.add(sk)
         # self-echo suppression: the store dispatches our own MODIFIED echo
         # synchronously INSIDE the write below, and _on_throttle_event
         # re-enqueued the key on every one — at drain saturation ~half of
@@ -167,10 +197,20 @@ class ControllerBase:
         me = threading.get_ident()
         for new in changed.values():
             self._inflight_status_echoes[self._store_key(new)] = (me, id(new.status))
+        async_lanes = False
         try:
-            batched = (
-                self._batch_write_statuses(list(changed.values())) if changed else {}
-            )
+            if not changed:
+                batched = {}
+            else:
+                pri = getattr(self.status_writer, self._prioritized_batch_attr, None)
+                if pri is not None:
+                    # lane-aware writer (AsyncStatusCommitter): flips take
+                    # the priority PUT lane; it observes the lag histograms
+                    # itself at PUT completion (publication is async here)
+                    batched = pri(list(changed.values()), flip_store_keys, event_ts)
+                    async_lanes = True
+                else:
+                    batched = self._batch_write_statuses(list(changed.values()))
         finally:
             for new in changed.values():
                 self._inflight_status_echoes.pop(self._store_key(new), None)
@@ -179,10 +219,19 @@ class ControllerBase:
                 try:
                     if new_thr is not None:
                         self._write_status(new_thr)
+                        self._observe_lag(
+                            event_ts, flip_store_keys, self._store_key(new_thr)
+                        )
                     self._post_write(key, thr, new_thr, unreserve_pods, now)
                 except Exception as e:  # noqa: BLE001 — requeued per key
                     errors[key] = e
             return
+        if not async_lanes and batched and self.lag_metrics is not None:
+            # local batched publication: the write above made every status
+            # visible (store event dispatched inside the write, flips first)
+            for sk, r in batched.items():
+                if not isinstance(r, Exception):
+                    self._observe_lag(event_ts, flip_store_keys, sk)
         store_to_queue = {self._store_key(new): key for key, new in changed.items()}
         write_errors = {
             store_to_queue.get(k, k): r
@@ -197,6 +246,17 @@ class ControllerBase:
                 self._post_write(key, thr, new_thr, unreserve_pods, now)
             except Exception as e:  # noqa: BLE001 — requeued per key
                 errors[key] = e
+
+    def _observe_lag(self, event_ts, flip_store_keys, store_key) -> None:
+        if self.lag_metrics is None:
+            return
+        ts = event_ts.get(store_key)
+        if ts is not None:
+            self.lag_metrics.observe(
+                self.target_kind,
+                time.monotonic() - ts,
+                store_key in flip_store_keys,
+            )
 
     def _post_write(self, key, thr, new_thr, unreserve_pods, now) -> None:
         """Per-key work that must follow the status write: metrics record,

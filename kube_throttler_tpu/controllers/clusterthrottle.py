@@ -150,6 +150,7 @@ class ClusterThrottleController(ControllerBase):
             return {}
         errors: Dict[str, Exception] = {}
         used_map = None
+        flips: dict = {}
         dm = self.device_manager
         if dm is not None:
             # on breaker-open/failure reconcile falls to the host walk
@@ -163,10 +164,18 @@ class ClusterThrottleController(ControllerBase):
                 self.KIND,
                 [t.key for t in thrs.values()],
                 reserved,
+                flips_out=flips,
             )
+        promote = flips.get("promote")
+        if promote:
+            # classification-delta flips outside this drain: queue-front
+            # promotion (see ThrottleController.reconcile_batch)
+            self.workqueue.add_all_priority(promote)
+        drained_flips = flips.get("drained", frozenset())
         # three-phase drain, mirroring ThrottleController.reconcile_batch:
         # compute → one batched status write → per-key post-write work
         plans = []  # (key, thr, new_thr | None, unreserve_list)
+        flip_keys = set()
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
@@ -183,11 +192,24 @@ class ClusterThrottleController(ControllerBase):
                     if new_status != thr.status
                     else None
                 )
+                if new_thr is not None and (
+                    thr.key in drained_flips
+                    or new_status.calculated_threshold
+                    is not thr.status.calculated_threshold
+                    or (
+                        used_map is None
+                        and new_status.throttled != thr.status.throttled
+                    )
+                ):
+                    flip_keys.add(key)
                 plans.append((key, thr, new_thr, unreserve_pods))
             except Exception as e:
                 errors[key] = e
-        self._commit_reconcile_plans(plans, now, errors)
+        self._commit_reconcile_plans(plans, now, errors, flip_keys=flip_keys)
         return errors
+
+    # lane-aware batch writer method (AsyncStatusCommitter duck type)
+    _prioritized_batch_attr = "update_cluster_throttle_statuses_prioritized"
 
     def _write_status(self, thr: ClusterThrottle) -> None:
         self.status_writer.update_cluster_throttle_status(thr)

@@ -150,6 +150,7 @@ class ThrottleController(ControllerBase):
             return {}
         errors: Dict[str, Exception] = {}
         used_map = None
+        flips: dict = {}
         dm = self.device_manager
         if dm is not None:
             # on breaker-open/failure this batch reconciles via the host
@@ -157,10 +158,20 @@ class ThrottleController(ControllerBase):
             # device), so statuses keep converging through a device outage
             reserved = {key: self.cache.reserved_pod_keys(key) for key in thrs}
             used_map = dm.guarded(
-                "reconcile", dm.aggregate_used_for, self.KIND, list(thrs), reserved
+                "reconcile", dm.aggregate_used_for, self.KIND, list(thrs),
+                reserved, flips_out=flips,
             )
+        promote = flips.get("promote")
+        if promote:
+            # keys OUTSIDE this drain whose published throttled flags
+            # disagree with the fresh aggregates (the classification
+            # delta): jump them to the queue front so their flip publishes
+            # next drain instead of after a full refresh-backlog cycle
+            self.workqueue.add_all_priority(promote)
+        drained_flips = flips.get("drained", frozenset())
         # phase 1: pure status computation + the unreserve sets
         plans = []  # (key, thr, new_thr | None, unreserve_list)
+        flip_keys = set()
         for key, thr in thrs.items():
             try:
                 if used_map is not None:
@@ -177,13 +188,32 @@ class ThrottleController(ControllerBase):
                     if new_status != thr.status
                     else None
                 )
+                if new_thr is not None and (
+                    thr.key in drained_flips
+                    # _planned_status reuses the status object when the
+                    # calculated threshold is unchanged, so identity is the
+                    # zero-cost change check
+                    or new_status.calculated_threshold
+                    is not thr.status.calculated_threshold
+                    # host-walk fallback (breaker open): no classification
+                    # delta — fall back to the direct flag compare
+                    or (
+                        used_map is None
+                        and new_status.throttled != thr.status.throttled
+                    )
+                ):
+                    flip_keys.add(key)
                 plans.append((key, thr, new_thr, unreserve_pods))
             except Exception as e:
                 errors[key] = e
         # phases 2+3: batched write + post-write work (base helper; remote
         # mode interleaves per key so the double-count window stays one PUT)
-        self._commit_reconcile_plans(plans, now, errors)
+        self._commit_reconcile_plans(plans, now, errors, flip_keys=flip_keys)
         return errors
+
+    # lane-aware batch writer method (the AsyncStatusCommitter's duck type);
+    # resolved by the base commit helper, absent on the plain Store
+    _prioritized_batch_attr = "update_throttle_statuses_prioritized"
 
     def _write_status(self, thr: Throttle) -> None:
         self.status_writer.update_throttle_status(thr)

@@ -14,6 +14,21 @@ DefaultControllerRateLimiter):
   (5ms · 2^fails, capped at 1000s — client-go's ItemExponentialFailureRateLimiter
   defaults) reset by Forget on success.
 
+Two extensions client-go does not have, both serving the two-lane status
+pipeline (flip-first publication):
+
+- **priority lane** (``add_priority`` / ``add_all_priority``): a second
+  FIFO drained before the normal one. Promoting an item already queued
+  normal MOVES it (an item is only ever queued once — dedup is
+  lane-global); promoting an item in processing re-queues it into the
+  priority lane at Done(). Used for throttles whose ``status.throttled``
+  flag is about to flip: they overtake the value-only refresh backlog,
+  which at full scale is the difference between ~100ms and multi-second
+  flip publication.
+- **enqueue timestamps** (``claim_ts``): the wall (monotonic) time of the
+  FIRST add since the item was last handed out, claimed by the consumer at
+  commit time — the "event" end of the event→publication lag histograms.
+
 The delay waker sleeps on a condition variable until the EARLIEST delayed
 deadline (no unconditional polling — an idle daemon makes zero wakeups);
 ``add_after`` re-arms it, and a FakeClock jump notifies it via the clock's
@@ -24,6 +39,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from datetime import timedelta
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -47,10 +63,18 @@ class RateLimitingQueue:
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._waker_cond = threading.Condition(self._lock)
-        self._queue: List[str] = []  # FIFO of ready items
+        self._queue: List[str] = []  # FIFO of ready items (normal lane)
+        self._queue_hi: List[str] = []  # priority lane, drained first
+        self._hi: Set[str] = set()  # members of _queue_hi
+        # promoted while processing: done() re-queues into the hi lane
+        self._hi_pending: Set[str] = set()
         self._dirty: Set[str] = set()
         self._processing: Set[str] = set()
         self._failures: Dict[str, int] = {}
+        # item → monotonic time of the first add since it was last handed
+        # out (get/try_get move it to _claim_ts; claim_ts pops it)
+        self._enqueue_ts: Dict[str, float] = {}
+        self._claim_ts: Dict[str, float] = {}
         self._delayed: List[Tuple[float, int, str]] = []  # (ready_ts, seq, item)
         self._seq = 0
         self._shutdown = False
@@ -72,6 +96,7 @@ class RateLimitingQueue:
             if item in self._dirty:
                 return
             self._dirty.add(item)
+            self._enqueue_ts.setdefault(item, time.monotonic())
             if item in self._processing:
                 return  # re-queued by done()
             self._queue.append(item)
@@ -85,10 +110,12 @@ class RateLimitingQueue:
             if self._shutdown:
                 return
             added = False
+            now = time.monotonic()
             for item in items:
                 if item in self._dirty:
                     continue
                 self._dirty.add(item)
+                self._enqueue_ts.setdefault(item, now)
                 if item in self._processing:
                     continue  # re-queued by done()
                 self._queue.append(item)
@@ -96,38 +123,99 @@ class RateLimitingQueue:
             if added:
                 self._cond.notify()
 
+    def add_priority(self, item: str) -> None:
+        self.add_all_priority((item,))
+
+    def add_all_priority(self, items) -> None:
+        """Add/promote items into the priority lane (one lock hold). An
+        item already queued normal MOVES — the single-queued-once dedup
+        invariant is lane-global, which is also what makes per-key
+        ordering trivial (an item is never drained twice for one add). An
+        item in processing is re-queued into the hi lane by done()."""
+        with self._cond:
+            if self._shutdown:
+                return
+            move: Set[str] = set()
+            added = False
+            now = time.monotonic()
+            for item in items:
+                if item in self._hi:
+                    continue  # already prioritized
+                if item in self._dirty:
+                    if item in self._processing:
+                        self._hi_pending.add(item)
+                        continue
+                    move.add(item)  # queued normal: relocate below
+                else:
+                    self._dirty.add(item)
+                    self._enqueue_ts.setdefault(item, now)
+                    if item in self._processing:
+                        self._hi_pending.add(item)
+                        continue
+                self._hi.add(item)
+                self._queue_hi.append(item)
+                added = True
+            if move:
+                # one filter pass relocates every promoted normal-lane item
+                self._queue = [i for i in self._queue if i not in move]
+            if added:
+                self._cond.notify()
+
+    def _pop_ready(self) -> Optional[str]:
+        """Caller holds the lock. Priority lane first."""
+        if self._queue_hi:
+            item = self._queue_hi.pop(0)
+            self._hi.discard(item)
+        elif self._queue:
+            item = self._queue.pop(0)
+        else:
+            return None
+        self._processing.add(item)
+        self._dirty.discard(item)
+        ts = self._enqueue_ts.pop(item, None)
+        if ts is not None:
+            self._claim_ts[item] = ts
+        return item
+
     def get(self, timeout: Optional[float] = None) -> str:
         """Blocks until an item is available. Raises ShutDown."""
         with self._cond:
-            while not self._queue and not self._shutdown:
+            while not (self._queue or self._queue_hi) and not self._shutdown:
                 # untimed callers still wake on every add/done/shutdown
                 # notify; the 1s re-check is only a lost-wakeup safety net
                 if not self._cond.wait(timeout=timeout if timeout is not None else 1.0):
                     if timeout is not None:
                         raise TimeoutError
-            if self._shutdown and not self._queue:
+            if self._shutdown and not (self._queue or self._queue_hi):
                 raise ShutDown
-            item = self._queue.pop(0)
-            self._processing.add(item)
-            self._dirty.discard(item)
-            return item
+            return self._pop_ready()
 
     def try_get(self) -> Optional[str]:
         """Non-blocking get: an immediately-ready item or None (batch drain)."""
         with self._cond:
-            if not self._queue:
-                return None
-            item = self._queue.pop(0)
-            self._processing.add(item)
-            self._dirty.discard(item)
-            return item
+            return self._pop_ready()
+
+    def claim_ts(self, item: str) -> Optional[float]:
+        """Monotonic time of the first add that made the in-flight ``item``
+        dirty (pops it — one lag sample per hand-out). The consumer calls
+        this at commit time to observe event→publication lag."""
+        with self._cond:
+            return self._claim_ts.pop(item, None)
 
     def done(self, item: str) -> None:
         with self._cond:
             self._processing.discard(item)
+            self._claim_ts.pop(item, None)  # unclaimed: drop, don't leak
             if item in self._dirty:
-                self._queue.append(item)
+                if item in self._hi_pending:
+                    self._hi_pending.discard(item)
+                    self._hi.add(item)
+                    self._queue_hi.append(item)
+                else:
+                    self._queue.append(item)
                 self._cond.notify()
+            else:
+                self._hi_pending.discard(item)
 
     # -- delay / rate limiting --------------------------------------------
 
@@ -171,7 +259,7 @@ class RateLimitingQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._queue)
+            return len(self._queue) + len(self._queue_hi)
 
     # -- internals ---------------------------------------------------------
 
@@ -192,6 +280,7 @@ class RateLimitingQueue:
                     _, _, item = heapq.heappop(self._delayed)
                     if item not in self._dirty:
                         self._dirty.add(item)
+                        self._enqueue_ts.setdefault(item, time.monotonic())
                         if item not in self._processing:
                             self._queue.append(item)
                             self._cond.notify()

@@ -350,6 +350,53 @@ class _KindRecorder:
             self._record_requests(self.calculated_requests, base, calc)
 
 
+class StatusLagMetrics:
+    """The two-lane status pipeline's latency histograms.
+
+    - ``kube_throttler_status_lag_seconds`` — event → publication for EVERY
+      status write (total lag: the time from the store/watch event that
+      made a key dirty to its status being visible — written to the local
+      store, or the PUT completing on the wire);
+    - ``kube_throttler_status_flip_lag_seconds`` — the same lag restricted
+      to FLIP publications: statuses whose ``throttled`` flags or
+      ``calculatedThreshold`` changed. Flips are the only status bits that
+      change admission verdicts, so their tail is the one that bounds how
+      stale scheduling decisions can be (the reference publishes per-key
+      inside reconcile, throttle_controller.go:157-173, so its flip lag IS
+      its total lag; ours diverge because refreshes batch).
+
+    ``path`` distinguishes the local batched store commit (``local``) from
+    the remote async committer's PUT completion (``remote``)."""
+
+    # status publication spans ~100µs (local batch write) to multi-second
+    # backlog tails; anchor the buckets around the <150ms flip target
+    BUCKETS = (
+        1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+        0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    )
+
+    def __init__(self, registry: Registry, path: str):
+        self._path = path
+        self.total = registry.histogram_vec(
+            "kube_throttler_status_lag_seconds",
+            "event to status-publication lag (all status writes)",
+            ["kind", "path"],
+            buckets=self.BUCKETS,
+        )
+        self.flip = registry.histogram_vec(
+            "kube_throttler_status_flip_lag_seconds",
+            "event to status-publication lag for throttled/calculatedThreshold flips",
+            ["kind", "path"],
+            buckets=self.BUCKETS,
+        )
+
+    def observe(self, kind: str, lag_s: float, flip: bool) -> None:
+        key = (kind, self._path)
+        self.total.observe_key(key, lag_s)
+        if flip:
+            self.flip.observe_key(key, lag_s)
+
+
 _BREAKER_STATE_VALUES = {"closed": 0.0, "open": 1.0, "half-open": 2.0}
 
 

@@ -269,7 +269,15 @@ def _gather_statuses(state, pods, cols, on_equal, step3_on_equal):
 # of ≤ KT_GATHER_CHUNK_ELEMS padded elements (R × P_block × K_padded) run
 # under lax.map: one compiled block body, device-serial blocks, bit-
 # identical statuses. 64M elems ≈ 256M per u32 operand ≈ ~1.5G peak.
-_GATHER_CHUNK_ELEMS = int(os.environ.get("KT_GATHER_CHUNK_ELEMS", str(64 * 1024 * 1024)))
+try:
+    _GATHER_CHUNK_ELEMS = int(
+        os.environ.get("KT_GATHER_CHUNK_ELEMS", str(64 * 1024 * 1024))
+    )
+except ValueError:
+    # a malformed override must not kill module import (the tpu_watch.py
+    # KT_TUNNEL_PROBE_PORT guard, for the same reason); fall back to the
+    # 64M default
+    _GATHER_CHUNK_ELEMS = 64 * 1024 * 1024
 
 
 def _gather_statuses_blocked(state, pods, cols, on_equal, step3_on_equal):

@@ -21,6 +21,7 @@ from ..health import Health
 from ..metrics import (
     ClusterThrottleMetricsRecorder,
     Registry,
+    StatusLagMetrics,
     ThrottleMetricsRecorder,
     register_breaker_metrics,
     register_watch_metrics,
@@ -133,6 +134,11 @@ class KubeThrottler:
             }
         self.throttle_ctr.tracer = self.tracer
         self.cluster_throttle_ctr.tracer = self.tracer
+        # local-path flip/total status-lag histograms; a lane-aware remote
+        # writer (AsyncStatusCommitter) observes the "remote" path itself
+        lag_metrics = StatusLagMetrics(self.metrics_registry, "local")
+        self.throttle_ctr.lag_metrics = lag_metrics
+        self.cluster_throttle_ctr.lag_metrics = lag_metrics
         register_watch_metrics(self.metrics_registry)
         # /readyz component registry (health.py): the daemon surface serves
         # its snapshot; the CLI adds journal/reflector components when they

@@ -446,9 +446,9 @@ class TestIndexBackedCollections:
         dm = plugin.device_manager
         orig = dm.aggregate_used_for
 
-        def spy(kind, keys, reserved=None):
+        def spy(kind, keys, reserved=None, flips_out=None):
             calls.append((kind, tuple(sorted(keys))))
-            return orig(kind, keys, reserved)
+            return orig(kind, keys, reserved, flips_out=flips_out)
 
         dm.aggregate_used_for = spy
         for i in range(20):

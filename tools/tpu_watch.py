@@ -141,6 +141,14 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true", help="run bench --quick instead of full scale")
     args = ap.parse_args()
 
+    # state the configured pre-probe port once: a silently wrong port (env
+    # typo, rotated tunnel) otherwise just reads as "backend down" for up
+    # to FULL_PROBE_EVERY-1 intervals with nothing in the log to diagnose
+    log(
+        f"pre-probe port {TUNNEL_PORT} "
+        f"(KT_TUNNEL_PROBE_PORT={os.environ.get('KT_TUNNEL_PROBE_PORT', 'unset')}); "
+        f"full jax probe every {FULL_PROBE_EVERY} attempts regardless"
+    )
     deadline = time.monotonic() + args.deadline_s
     attempt = 0
     while time.monotonic() < deadline:
