@@ -13,9 +13,9 @@ test:            ## unit + kernel + integration tiers (8-device virtual CPU mesh
 test-stress:     ## only the stress/concurrency tier
 	$(PY) -m pytest tests/test_stress.py -q
 
-lint:            ## syntax + import sanity over the package
+lint:            ## static analyzer (lock discipline, JAX purity, registries) + syntax sanity
 	$(PY) -m compileall -q kube_throttler_tpu tools bench.py __graft_entry__.py
-	$(PY) -c "import kube_throttler_tpu"
+	$(PY) -m kube_throttler_tpu.analysis
 
 gen:             ## regenerate deploy/crd.yaml from the typed API model
 	$(PY) tools/gen_crd.py

@@ -1,0 +1,60 @@
+"""Repo-native static analyzer: lock discipline, JAX trace purity, and
+string-keyed registry consistency.
+
+Run as ``python -m kube_throttler_tpu.analysis`` (or ``make lint``).
+Checkers:
+
+- ``guarded``   — guarded-by attribute discipline (guarded.py)
+- ``lockorder`` — static lock-acquisition order graph (lockgraph.py)
+- ``purity``    — JAX trace purity over ops/ and parallel/ (purity.py)
+- ``registry``  — fault-site and metric-name registries (registry.py)
+
+The runtime counterpart — the instrumented-lock assassin enabled by
+``KT_LOCK_ASSERT=1`` — lives in ``kube_throttler_tpu.utils.lockorder``.
+See docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from . import guarded, lockgraph, purity, registry
+from .core import Finding, Module, apply_baseline, load_baseline, load_package
+
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "lockorder_allow.txt")
+
+CHECKERS = ("guarded", "lockorder", "purity", "registry")
+
+
+def run_checks(
+    modules: Sequence[Module],
+    checks: Sequence[str] = CHECKERS,
+    allowlist_path: Optional[str] = DEFAULT_ALLOWLIST,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    if "guarded" in checks:
+        findings.extend(guarded.check(modules))
+    if "lockorder" in checks:
+        findings.extend(lockgraph.check(modules, allowlist_path=allowlist_path))
+    if "purity" in checks:
+        findings.extend(purity.check(modules))
+    if "registry" in checks:
+        findings.extend(registry.check(modules))
+    findings.sort(key=lambda f: (f.relpath or f.path, f.line, f.checker, f.message))
+    return findings
+
+
+def run_repo(
+    root: str = PACKAGE_ROOT,
+    checks: Sequence[str] = CHECKERS,
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+    allowlist_path: Optional[str] = DEFAULT_ALLOWLIST,
+):
+    """(new, waived, stale) findings for the package at ``root``."""
+    modules = load_package(root)
+    findings = run_checks(modules, checks, allowlist_path)
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    return apply_baseline(findings, baseline)

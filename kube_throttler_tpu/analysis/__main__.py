@@ -1,0 +1,92 @@
+"""CLI for the repo-native static analyzer.
+
+Exit status: 0 when every finding is either absent or waived in the
+baseline; 1 when new findings exist (they are printed ``path:line:
+[checker] message``). Stale baseline entries (waivers whose finding no
+longer exists) are reported as warnings so they get deleted, but do not
+fail the run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import (
+    CHECKERS,
+    DEFAULT_ALLOWLIST,
+    DEFAULT_BASELINE,
+    PACKAGE_ROOT,
+    run_checks,
+)
+from .core import apply_baseline, load_baseline, load_package
+from .lockgraph import build_edges
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kube_throttler_tpu.analysis",
+        description="lock discipline / JAX purity / registry static analyzer",
+    )
+    ap.add_argument("--root", default=PACKAGE_ROOT, help="package root to analyze")
+    ap.add_argument(
+        "--checks",
+        default=",".join(CHECKERS),
+        help=f"comma-separated subset of: {', '.join(CHECKERS)}",
+    )
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding (ignore the baseline)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="append new findings to the baseline with TODO justifications",
+    )
+    ap.add_argument(
+        "--dump-lock-graph",
+        action="store_true",
+        help="print the raw acquired-while-holding edges and exit",
+    )
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    checks = tuple(c.strip() for c in args.checks.split(",") if c.strip())
+    bad = [c for c in checks if c not in CHECKERS]
+    if bad:
+        ap.error(f"unknown checker(s): {', '.join(bad)}")
+
+    modules = load_package(args.root)
+    if args.dump_lock_graph:
+        for (a, b), (path, line, ctx) in sorted(build_edges(modules).items()):
+            print(f"{a} -> {b}    # {path}:{line} ({ctx})")
+        return 0
+
+    findings = run_checks(modules, checks, allowlist_path=args.allowlist)
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, waived, stale = apply_baseline(findings, baseline)
+
+    for f in new:
+        print(f.render())
+    if args.write_baseline and new:
+        with open(args.baseline, "a", encoding="utf-8") as fh:
+            for f in new:
+                fh.write(f"{f.key()}  # TODO: justify or fix\n")
+        print(f"wrote {len(new)} new waiver(s) to {args.baseline}", file=sys.stderr)
+        return 0
+    if not args.quiet:
+        for k in stale:
+            print(f"warning: stale baseline entry (delete it): {k}", file=sys.stderr)
+        print(
+            f"analysis: {len(new)} new finding(s), {len(waived)} waived, "
+            f"{len(stale)} stale waiver(s) over {len(modules)} file(s)",
+            file=sys.stderr,
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

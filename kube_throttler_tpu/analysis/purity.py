@@ -1,0 +1,347 @@
+"""Checker 3: JAX trace purity.
+
+Entry points are the traced bodies in ``ops/`` and ``parallel/``:
+
+- functions decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``;
+- local functions handed to ``shard_map(...)`` (first positional arg);
+- kernels handed to ``pl.pallas_call(...)``.
+
+From each entry the checker computes the statically-resolvable call
+graph (module-level defs, ``from ..x import y`` aliases inside the
+analyzed set) and flags, anywhere reachable:
+
+- host-effect calls: ``time.*``, ``random.*`` / ``np.random.*``,
+  ``threading.*``, Prometheus metric mutation (``observe``,
+  ``observe_key``, ``inc``, ``set_key`` — ``.set`` is exempt because
+  ``x.at[i].set(v)`` is the JAX functional update), and fault-plan hits
+  (``*.check(site)`` / ``*.maybe_raise(site)`` on a ``faults`` object) —
+  any of these inside a traced body either silently bakes a tracer-time
+  value into the compiled program or mutates host state once per COMPILE
+  instead of once per call;
+- Python ``if``/``while`` branching on a known-traced parameter of the
+  entry (parameters minus ``static_argnames``): structure checks
+  (``x is None``, ``x.shape``/``ndim``/``dtype``, ``len(x)``,
+  ``isinstance``) are exempt — those are trace-time Python values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Module, literal_str, unparse
+
+_HOST_MODULES = {"time", "random", "threading"}
+_METRIC_MUTATORS = {"observe", "observe_key", "inc", "set_key"}
+_FAULT_METHODS = {"check", "maybe_raise"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _decorator_jit_static(dec: ast.AST) -> Optional[Tuple[bool, Set[str]]]:
+    """(is_jit, static_argnames) if the decorator applies jax.jit."""
+    text = unparse(dec)
+    if "jit" not in text:
+        return None
+    static: Set[str] = set()
+    if isinstance(dec, ast.Call):
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                try:
+                    val = ast.literal_eval(kw.value)
+                except ValueError:
+                    val = ()
+                if isinstance(val, str):
+                    static = {val}
+                else:
+                    static = {str(v) for v in val}
+    # match jax.jit / jit / partial(jax.jit, ...)
+    if text in ("jax.jit", "jit") or text.startswith(("jax.jit(", "jit(", "partial(jax.jit", "functools.partial(jax.jit", "partial(jit")):
+        return True, static
+    return None
+
+
+class _FnIndex:
+    """Module-level function defs + import aliases for call resolution."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.defs: Dict[Tuple[str, str], Tuple[Module, ast.FunctionDef]] = {}
+        self.aliases: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        by_name: Dict[str, List[Tuple[str, str]]] = {}
+        for m in modules:
+            self.aliases.setdefault(m.modname, {})
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    key = (m.modname, node.name)
+                    self.defs[key] = (m, node)
+                    by_name.setdefault(node.name, []).append(key)
+        for m in modules:
+            amap = self.aliases[m.modname]
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ImportFrom):
+                    for alias in node.names:
+                        local = alias.asname or alias.name
+                        # resolve by bare function name across the analyzed
+                        # set (package-relative imports; unique names only)
+                        cands = by_name.get(alias.name, [])
+                        if len(cands) == 1:
+                            amap[local] = cands[0]
+                        elif len(cands) > 1:
+                            # prefer a module whose name matches the import tail
+                            tail = (node.module or "").split(".")[-1]
+                            matched = [c for c in cands if c[0].split(".")[-1] == tail]
+                            if len(matched) == 1:
+                                amap[local] = matched[0]
+
+    def resolve(self, modname: str, name: str) -> Optional[Tuple[str, str]]:
+        if (modname, name) in self.defs:
+            return (modname, name)
+        return self.aliases.get(modname, {}).get(name)
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        # strip a `.__wrapped__` unjitted-body access: full_update_step
+        # .__wrapped__(...) calls the same def
+        if f.attr == "__wrapped__":
+            return f.value.id
+        return None
+    return None
+
+
+def _entry_points(
+    modules: Sequence[Module],
+) -> List[Tuple[Module, ast.FunctionDef, Set[str], str]]:
+    """(module, fn, static_argnames, why) for every traced entry."""
+    out = []
+    seen: Set[int] = set()
+    for m in modules:
+        local_defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(node.name, node)
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    res = _decorator_jit_static(dec)
+                    if res:
+                        if id(node) not in seen:
+                            seen.add(id(node))
+                            out.append((m, node, res[1], "@jax.jit"))
+                        break
+            elif isinstance(node, ast.Call):
+                name = None
+                f = node.func
+                if isinstance(f, ast.Name):
+                    name = f.id
+                elif isinstance(f, ast.Attribute):
+                    name = f.attr
+                if name in ("shard_map", "pallas_call") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in local_defs:
+                        fn = local_defs[arg.id]
+                        if id(fn) not in seen:
+                            seen.add(id(fn))
+                            out.append((m, fn, set(), name))
+    return out
+
+
+def _banned_calls(module: Module, fn: ast.FunctionDef, where: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            # time.monotonic(), random.random(), threading.Lock() ...
+            if isinstance(base, ast.Name) and base.id in _HOST_MODULES:
+                findings.append(
+                    Finding(
+                        checker="purity",
+                        path=module.path,
+                        relpath=module.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"host call {base.id}.{f.attr}() inside traced "
+                            f"body {where}"
+                        ),
+                    )
+                )
+                continue
+            # np.random.* / numpy.random.*
+            if (
+                isinstance(base, ast.Attribute)
+                and base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id in ("np", "numpy")
+            ):
+                findings.append(
+                    Finding(
+                        checker="purity",
+                        path=module.path,
+                        relpath=module.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"host call np.random.{f.attr}() inside traced "
+                            f"body {where}"
+                        ),
+                    )
+                )
+                continue
+            if f.attr in _METRIC_MUTATORS:
+                findings.append(
+                    Finding(
+                        checker="purity",
+                        path=module.path,
+                        relpath=module.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"metric mutation .{f.attr}() inside traced "
+                            f"body {where}"
+                        ),
+                    )
+                )
+                continue
+            if f.attr in _FAULT_METHODS and "faults" in unparse(base):
+                findings.append(
+                    Finding(
+                        checker="purity",
+                        path=module.path,
+                        relpath=module.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"fault-plan hit .{f.attr}() inside traced "
+                            f"body {where}"
+                        ),
+                    )
+                )
+    return findings
+
+
+def _traced_branch_findings(
+    module: Module, fn: ast.FunctionDef, static: Set[str], where: str
+) -> List[Finding]:
+    params = {
+        a.arg
+        for a in list(fn.args.args)
+        + list(fn.args.posonlyargs)
+        + list(fn.args.kwonlyargs)
+        if a.arg not in ("self", "cls")
+    }
+    traced = params - static
+    if not traced:
+        return []
+
+    findings: List[Finding] = []
+
+    def names_in_test(test: ast.AST) -> Set[str]:
+        """Traced param names used as VALUES in the test (structure-only
+        uses — .shape/.ndim/.dtype, len(), is None, isinstance — are
+        stripped before collection)."""
+        hits: Set[str] = set()
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, ast.Attribute):
+                if node.attr in _STATIC_ATTRS:
+                    return  # x.shape / x.req.shape etc: static at trace time
+                walk(node.value)
+                return
+            if isinstance(node, ast.Subscript):
+                walk(node.value)
+                walk(node.slice)
+                return
+            if isinstance(node, ast.Call):
+                fname = (
+                    node.func.id
+                    if isinstance(node.func, ast.Name)
+                    else getattr(node.func, "attr", "")
+                )
+                if fname in ("len", "isinstance", "getattr", "hasattr", "type"):
+                    return
+                for a in node.args:
+                    walk(a)
+                for kw in node.keywords:
+                    walk(kw.value)
+                return
+            if isinstance(node, ast.Compare):
+                # `x is None` / `x is not None`: python-structure check
+                if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                    return
+                walk(node.left)
+                for c in node.comparators:
+                    walk(c)
+                return
+            if isinstance(node, ast.Name):
+                if node.id in traced:
+                    hits.add(node.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(test)
+        return hits
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            hits = names_in_test(node.test)
+            if hits:
+                kw = "if" if isinstance(node, ast.If) else "while"
+                findings.append(
+                    Finding(
+                        checker="purity",
+                        path=module.path,
+                        relpath=module.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"Python {kw} on traced parameter(s) "
+                            f"{', '.join(sorted(hits))} in {where} — use "
+                            "jnp.where/lax.cond, or mark the arg static"
+                        ),
+                    )
+                )
+    return findings
+
+
+def check(modules: Sequence[Module]) -> List[Finding]:
+    scoped = [
+        m
+        for m in modules
+        if m.relpath.replace("\\", "/").startswith(("ops/", "parallel/"))
+    ] or list(modules)
+    index = _FnIndex(scoped)
+    entries = _entry_points(scoped)
+
+    findings: List[Finding] = []
+    visited: Set[Tuple[str, str]] = set()
+
+    def reach(module: Module, fn: ast.FunctionDef, why: str) -> None:
+        key = (module.modname, fn.name)
+        if key in visited:
+            return
+        visited.add(key)
+        where = f"{module.modname}.{fn.name} (via {why})"
+        findings.extend(_banned_calls(module, fn, where))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name is None:
+                    continue
+                resolved = index.resolve(module.modname, name)
+                if resolved is not None:
+                    callee_mod, callee_fn = index.defs[resolved]
+                    reach(callee_mod, callee_fn, why)
+
+    for module, fn, static, why in entries:
+        where = f"{module.modname}.{fn.name} ({why})"
+        findings.extend(_traced_branch_findings(module, fn, static, where))
+        reach(module, fn, why)
+    # dedup: one function reachable from several entries reports once per
+    # site (visited-set keeps bodies single-visit; entries may still share
+    # a first visit — identical keys collapse at baseline level anyway)
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.key(), f.line), f)
+    return list(uniq.values())

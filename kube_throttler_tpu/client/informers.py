@@ -23,6 +23,7 @@ from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Set
 
 from ..engine.store import Event, EventType, Store, key_of
+from ..utils.lockorder import assert_held, make_lock, make_rlock
 
 Handler = Callable[[Event], None]
 
@@ -30,8 +31,13 @@ Handler = Callable[[Event], None]
 class Indexer:
     """Keyed object cache with named secondary indexes."""
 
+    GUARDED_BY = {
+        "_objects": "self._lock",
+        "_indices": "self._lock",
+    }
+
     def __init__(self, index_funcs: Optional[Dict[str, Callable[[object], List[str]]]] = None):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("informers.indexer")
         self._objects: Dict[str, object] = {}
         self._index_funcs = index_funcs or {}
         # index name -> index value -> set of object keys
@@ -39,7 +45,8 @@ class Indexer:
             name: defaultdict(set) for name in self._index_funcs
         }
 
-    def _unindex(self, key: str, obj: object) -> None:
+    def _unindex_locked(self, key: str, obj: object) -> None:
+        assert_held(self._lock, "Indexer._unindex_locked")
         for name, fn in self._index_funcs.items():
             for value in fn(obj):
                 bucket = self._indices[name].get(value)
@@ -48,7 +55,8 @@ class Indexer:
                     if not bucket:
                         del self._indices[name][value]
 
-    def _index(self, key: str, obj: object) -> None:
+    def _index_locked(self, key: str, obj: object) -> None:
+        assert_held(self._lock, "Indexer._index_locked")
         for name, fn in self._index_funcs.items():
             for value in fn(obj):
                 self._indices[name][value].add(key)
@@ -57,15 +65,15 @@ class Indexer:
         with self._lock:
             old = self._objects.get(key)
             if old is not None:
-                self._unindex(key, old)
+                self._unindex_locked(key, old)
             self._objects[key] = obj
-            self._index(key, obj)
+            self._index_locked(key, obj)
 
     def delete(self, key: str) -> None:
         with self._lock:
             old = self._objects.pop(key, None)
             if old is not None:
-                self._unindex(key, old)
+                self._unindex_locked(key, old)
 
     def get(self, key: str):
         with self._lock:
@@ -101,6 +109,8 @@ class SharedIndexInformer:
     """One shared informer for one kind; handlers added late get a replay of
     the cache as synthetic ADDED events (cache-sync semantics)."""
 
+    GUARDED_BY = {"_handlers": "self._lock"}
+
     def __init__(self, store: Store, kind: str, resync_period: float) -> None:
         self._store = store
         self.kind = kind
@@ -110,7 +120,7 @@ class SharedIndexInformer:
             index_funcs[NAMESPACE_INDEX] = lambda obj: [obj.namespace]
         self.indexer = Indexer(index_funcs)
         self._handlers: List[Handler] = []
-        self._lock = threading.RLock()
+        self._lock = make_rlock(f"informers.{kind}.handlers")
         # ALL handler deliveries (store events and resync) serialize through
         # this lock — client-go's contract is per-listener serial delivery,
         # and without it the resync thread could interleave with a mutator
@@ -118,7 +128,7 @@ class SharedIndexInformer:
         # Lock order is store-lock → dispatch-lock (store events arrive
         # holding the store lock); handlers must therefore never mutate the
         # store synchronously — enqueue only, like informer handlers.
-        self._dispatch_lock = threading.RLock()
+        self._dispatch_lock = make_rlock(f"informers.{kind}.dispatch")
         self._synced = threading.Event()
         self._stop: Optional[threading.Event] = None
         self._resync_thread: Optional[threading.Thread] = None
@@ -221,10 +231,16 @@ class SharedInformerFactory:
 
     DEFAULT_RESYNC = 300.0  # 5 minutes (plugin.go:77)
 
+    GUARDED_BY = {
+        "_informers": "self._lock",
+        "_started": "self._lock",
+        "_shutdown": "self._lock",
+    }
+
     def __init__(self, store: Store, resync_period: float = DEFAULT_RESYNC) -> None:
         self._store = store
         self._resync = resync_period
-        self._lock = threading.Lock()
+        self._lock = make_lock("informers.factory")
         self._informers: Dict[str, SharedIndexInformer] = {}
         self._stop = threading.Event()
         self._started = False

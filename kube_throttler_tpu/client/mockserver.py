@@ -46,6 +46,7 @@ from queue import Empty, Queue
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from ..utils.lockorder import guard_attrs, make_lock
 from ..api.serialization import (
     cluster_throttle_from_dict,
     object_to_dict,
@@ -77,9 +78,24 @@ _EVENTS_RE = re.compile(
 )
 
 
+@guard_attrs
 class MockApiServer:
     """In-process apiserver double. ``start()`` binds an ephemeral port;
     ``server.url`` is the client-facing base URL."""
+
+    # event logs, watch fan-out lists, lease/event docs, and continue
+    # tokens are touched from every handler thread — all under the one
+    # server lock. (self.store has its own lock; the two nest
+    # store-inside-server only at the consistent-snapshot sites.)
+    GUARDED_BY = {
+        "_logs": "self._lock",
+        "_dropped_rv": "self._lock",
+        "_watchers": "self._lock",
+        "_leases": "self._lock",
+        "_lease_rv": "self._lock",
+        "_events": "self._lock",
+        "_continues": "self._lock",
+    }
 
     def __init__(
         self,
@@ -95,7 +111,7 @@ class MockApiServer:
         self._port = port
         self.token = token
         self.bookmark_interval = bookmark_interval
-        self._lock = threading.Lock()
+        self._lock = make_lock("mockserver")
         # per-kind bounded event log: deque of (rv, type_str, obj_dict)
         self._logs: Dict[str, deque] = {
             kind: deque(maxlen=log_size) for kind in COLLECTION_PATHS

@@ -54,6 +54,7 @@ from ..api.serialization import (
 )
 from ..api.types import ClusterThrottle, Throttle
 from ..engine.store import ConflictError, NotFoundError, Store, key_of
+from ..utils.lockorder import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -262,8 +263,8 @@ class Backoff:
         self.cap = float(cap)
         self.factor = float(factor)
         self._rng = rng or random.Random()
-        self._attempts = 0
-        self._lock = threading.Lock()
+        self._attempts = 0  #: guarded-by: self._lock
+        self._lock = make_lock("transport.backoff")
 
     @property
     def attempts(self) -> int:
@@ -295,9 +296,9 @@ class _TokenBucket:
             raise ValueError(f"qps must be > 0 and burst >= 1 (got {qps}, {burst})")
         self.qps = float(qps)
         self.burst = float(burst)
-        self._tokens = float(burst)
-        self._stamp = time.monotonic()
-        self._lock = threading.Lock()
+        self._tokens = float(burst)  #: guarded-by: self._lock
+        self._stamp = time.monotonic()  #: guarded-by: self._lock
+        self._lock = make_lock("transport.tokenbucket")
 
     def take(self) -> None:
         while True:
@@ -676,7 +677,10 @@ class RemoteVersions:
     Store assigns its own local versions and the apiserver requires the
     REMOTE one on updates."""
 
-    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: threading.Lock = field(
+        default_factory=lambda: make_lock("transport.remoteversions")
+    )
+    #: guarded-by: self._lock
     _versions: Dict[Tuple[str, str], str] = field(default_factory=dict)
 
     def set(self, kind: str, key: str, rv: str) -> None:
@@ -1078,6 +1082,15 @@ class AsyncStatusCommitter:
     aggregate snapshot the status was computed from is already coherent —
     matching the batched local-store commit semantics rather than the
     reference's write-then-continue."""
+
+    # per-shard lanes and the busy flags move under that shard's condition;
+    # the deliberate lock-free reads (pending(), the retry path's
+    # lane-pressure hints) are waived in the analyzer baseline
+    GUARDED_BY = {
+        "_hi_shards": "self._conds",
+        "_lo_shards": "self._conds",
+        "_busy": "self._conds",
+    }
 
     def __init__(self, writer: "RemoteStatusWriter", workers: int = 4,
                  metrics_registry=None, max_retries: int = 4):

@@ -26,6 +26,7 @@ import threading
 import weakref
 from typing import Callable, Iterator, Optional
 
+from ..utils.lockorder import make_lock
 from ..engine.store import Event, EventType, Store
 
 
@@ -50,7 +51,7 @@ class Watch:
     # live instances tracked weakly so an abandoned, never-stopped watch
     # doesn't pin the stats forever
     _live: "weakref.WeakSet[Watch]" = weakref.WeakSet()
-    _stats_lock = threading.Lock()
+    _stats_lock = make_lock("watch.stats")
     _dropped_total = 0
 
     def __init__(

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.tracing import NoopTracer
+from ..utils.lockorder import make_lock, make_rlock
 from ..api.pod import Pod
 from ..api.types import ClusterThrottle, ResourceAmount, Throttle
 from ..quantity import to_milli
@@ -1153,6 +1154,18 @@ class _KindState:
 class DeviceStateManager:
     """Wires both kinds' staging to a Store and serves batched checks."""
 
+    # Static-analyzer guard table (see docs/STATIC_ANALYSIS.md). Only the
+    # breaker state machine is listed: the _KindState staging planes are
+    # guarded by THIS manager's main lock but live on another object (out
+    # of the per-class convention's reach), and _event_affected /
+    # _sharded_steps are deliberately lock-free (single-writer hint /
+    # idempotent compile cache — see their comments).
+    GUARDED_BY = {
+        "_breaker_open": "self._breaker_lock",
+        "_probe_inflight": "self._breaker_lock",
+        "_device_down_until": "self._breaker_lock",
+    }
+
     def __init__(
         self,
         store: Store,
@@ -1164,7 +1177,7 @@ class DeviceStateManager:
         self.throttler_name = throttler_name
         self.target_scheduler_name = target_scheduler_name
         self.dims = dims or DimRegistry()
-        self._lock = threading.RLock()
+        self._lock = make_rlock("devicestate.main")
         self.tracer = NoopTracer()  # set by the plugin; times device checks
         # check_pod uses the indexed hot path up to this many affected
         # throttles, the dense [1,T] sweep beyond (tunable for tests)
@@ -1183,8 +1196,8 @@ class DeviceStateManager:
         # under these, so the reconcile's device dispatches never hold the
         # main lock (lock order: agg → main; nothing takes main → agg)
         self._agg_locks = {
-            "throttle": threading.Lock(),
-            "clusterthrottle": threading.Lock(),
+            "throttle": make_lock("devicestate.agg.throttle"),
+            "clusterthrottle": make_lock("devicestate.agg.clusterthrottle"),
         }
         # compiled shard_map steps for full_tick_sharded, keyed
         # (mesh, on_equal, step3) — rebuilding the jit wrapper per call
@@ -1208,7 +1221,7 @@ class DeviceStateManager:
         # cooldown period across every serving thread.
         self.device_retry_cooldown = 30.0
         self._device_down_until = 0.0
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = make_lock("devicestate.breaker")
         self._breaker_open = False  # False = closed; half-open is derived
         self._probe_inflight = False
         self._monotonic = None  # test injection point; defaults to time.monotonic
@@ -1851,7 +1864,7 @@ class DeviceStateManager:
                     # scheduler retries of the same Pending pod skip the
                     # O(T) evaluation entirely; NOT a Python loop over T)
                     with ks.index._lock:  # noqa: SLF001 — same-package access
-                        row = ks.index.match_row_cached(pod) & ks.index._thr_valid
+                        row = ks.index.match_row_cached_locked(pod) & ks.index._thr_valid
                     mask_row = np.zeros((1, ks.tcap), dtype=bool)
                     mask_row[0, : row.shape[0]] = row[: ks.tcap]
 
@@ -1978,7 +1991,7 @@ class DeviceStateManager:
                     cols = np.nonzero(ks.index.mask[prow, :tcap])[0]
                 else:
                     with ks.index._lock:  # noqa: SLF001 — same-package access
-                        rowm = ks.index.match_row_cached(pod) & ks.index._thr_valid
+                        rowm = ks.index.match_row_cached_locked(pod) & ks.index._thr_valid
                     cols = np.nonzero(rowm[:tcap])[0]
                 rows.append((row_req, row_present))
                 colss.append(cols.astype(np.int32))
@@ -2152,9 +2165,15 @@ class DeviceStateManager:
             sharded_full_update,
             sharded_full_update_gather,
         )
+        from ..utils.jaxcompat import require_shard_map
 
         dp, tp = (mesh.shape["pods"], mesh.shape["throttles"])
         single = dp == 1 and tp == 1
+        if not single:
+            # fail now with a clear env message, not mid-compile inside a
+            # cache miss (shard_map's import location drifts across jax
+            # versions — utils/jaxcompat.py owns the spelling)
+            require_shard_map()
         now_ns = jnp.asarray(
             _datetime_to_ns(now or datetime.now(timezone.utc)), dtype=jnp.int64
         )

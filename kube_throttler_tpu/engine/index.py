@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
 
 import numpy as np
 
+from ..utils.lockorder import assert_held, guard_attrs, make_rlock
 from ..api.pod import Namespace, Pod
 from ..api.types import (
     ClusterThrottle,
@@ -76,8 +77,37 @@ def _simple_terms(thr: AnyThrottle) -> Optional[List[Tuple[Dict[str, str], Dict[
     return terms
 
 
+@guard_attrs
 class SelectorIndex:
     """One index instance per kind (mirroring the two controllers)."""
+
+    # every row/column plane, interner, and cache below moves only under
+    # the single per-index RLock; `*_locked` helpers run with it held
+    # (callers outside this class take it explicitly — see
+    # devicestate's `with ks.index._lock:` probe path)
+    GUARDED_BY = {
+        "_probe_cache": "self._lock",
+        "_gen": "self._lock",
+        "_pod_rows": "self._lock",
+        "_row_pods": "self._lock",
+        "_row_prev": "self._lock",
+        "_free_rows": "self._lock",
+        "_pcap": "self._lock",
+        "_pod_valid": "self._lock",
+        "_pod_ns": "self._lock",
+        "_pod_ns_exists": "self._lock",
+        "_pod_label": "self._lock",
+        "_ns_label": "self._lock",
+        "_thr_cols": "self._lock",
+        "_col_thrs": "self._lock",
+        "_col_keys": "self._lock",
+        "_free_cols": "self._lock",
+        "_tcap": "self._lock",
+        "_thr_valid": "self._lock",
+        "_namespaces": "self._lock",
+        "_ns_label_ids": "self._lock",
+        "mask": "self._lock",
+    }
 
     def __init__(
         self,
@@ -88,7 +118,7 @@ class SelectorIndex:
     ):
         assert kind in ("throttle", "clusterthrottle")
         self.kind = kind
-        self._lock = threading.RLock()
+        self._lock = make_rlock(f"index.{kind}")
 
         self._values = _Interner()
         self._ns_ids = _Interner()
@@ -151,14 +181,15 @@ class SelectorIndex:
 
     # ------------------------------------------------------------------ pods
 
-    def _pod_col_array(self, store: Dict[str, np.ndarray], key: str) -> np.ndarray:
+    def _pod_col_array_locked(self, store: Dict[str, np.ndarray], key: str) -> np.ndarray:
         arr = store.get(key)
         if arr is None:
             arr = np.full(self._pcap, _MISSING, dtype=np.int32)
             store[key] = arr
         return arr
 
-    def _grow_pods(self) -> None:
+    def _grow_pods_locked(self) -> None:
+        assert_held(self._lock, "SelectorIndex._grow_pods_locked")
         new_cap = self._pcap * 2
         self._pod_valid = np.resize(self._pod_valid, new_cap)
         self._pod_valid[self._pcap :] = False
@@ -188,7 +219,7 @@ class SelectorIndex:
                 else:
                     row = len(self._pod_rows)
                     while row >= self._pcap:
-                        self._grow_pods()
+                        self._grow_pods_locked()
                 self._pod_rows[pod.key] = row
             prev = self._row_pods.get(row)
             if prev is not None and prev is not pod:
@@ -214,7 +245,7 @@ class SelectorIndex:
 
             seen: Set[str] = set()
             for key, value in pod.labels.items():
-                self._pod_col_array(self._pod_label, key)[row] = self._values.id_of(value)
+                self._pod_col_array_locked(self._pod_label, key)[row] = self._values.id_of(value)
                 seen.add(key)
             for key, arr in self._pod_label.items():
                 if key not in seen:
@@ -224,13 +255,13 @@ class SelectorIndex:
             ns_labels = ns.labels if ns else {}
             seen = set()
             for key, value in ns_labels.items():
-                self._pod_col_array(self._ns_label, key)[row] = self._values.id_of(value)
+                self._pod_col_array_locked(self._ns_label, key)[row] = self._values.id_of(value)
                 seen.add(key)
             for key, arr in self._ns_label.items():
                 if key not in seen:
                     arr[row] = _MISSING
 
-            self._recompute_row(row)
+            self._recompute_row_locked(row)
             return row
 
     def remove_pod(self, pod_key: str) -> None:
@@ -258,15 +289,15 @@ class SelectorIndex:
                 else:
                     col = len(self._thr_cols)
                     while col >= self._tcap:
-                        self._grow_throttles()
+                        self._grow_throttles_locked()
                 self._thr_cols[key] = col
             self._col_thrs[col] = thr
             self._col_keys[col] = key
             self._thr_valid[col] = True
             self._row_prev = None  # compiled columns changed
             if self._native is not None:
-                self._native_sync_col(col, thr)
-            self._recompute_col(col)
+                self._native_sync_col_locked(col, thr)
+            self._recompute_col_locked(col)
             return col
 
     def refresh_throttle_object(self, thr: AnyThrottle) -> Optional[int]:
@@ -281,7 +312,7 @@ class SelectorIndex:
             self._col_thrs[col] = thr
             return col
 
-    def _grow_throttles(self) -> None:
+    def _grow_throttles_locked(self) -> None:
         new_cap = self._tcap * 2
         grown_valid = np.zeros(new_cap, dtype=bool)
         grown_valid[: self._tcap] = self._thr_valid
@@ -331,12 +362,12 @@ class SelectorIndex:
                 pod = self._row_pods[row]
                 seen: Set[str] = set()
                 for key, value in ns.labels.items():
-                    self._pod_col_array(self._ns_label, key)[row] = self._values.id_of(value)
+                    self._pod_col_array_locked(self._ns_label, key)[row] = self._values.id_of(value)
                     seen.add(key)
                 for key, arr in self._ns_label.items():
                     if key not in seen:
                         arr[row] = _MISSING
-                self._recompute_row(int(row))
+                self._recompute_row_locked(int(row))
 
     def remove_namespace(self, name: str) -> None:
         """Namespace deletion: its pods can no longer match any
@@ -356,14 +387,14 @@ class SelectorIndex:
             rows = np.nonzero(self._pod_valid & (self._pod_ns == ns_id))[0]
             self._pod_ns_exists[rows] = False
             # every match path returns False for an absent Namespace (native
-            # gate ktnative.cpp ns_exists; _match_one/_eval_general ns None),
+            # gate ktnative.cpp ns_exists; _match_one_locked/_eval_general_locked ns None),
             # so the rows' recompute result is provably all-False — clear
             # vectorized instead of O(rows × T) selector evaluations
             self.mask[rows, :] = False
 
     # ------------------------------------------------------------- recompute
 
-    def _term_col_match(self, pairs: Dict[str, str], store: Dict[str, np.ndarray]) -> np.ndarray:
+    def _term_col_match_locked(self, pairs: Dict[str, str], store: Dict[str, np.ndarray]) -> np.ndarray:
         """Vectorized: which pods satisfy all (key,value) pairs."""
         out = self._pod_valid.copy()
         for key, value in pairs.items():
@@ -374,12 +405,12 @@ class SelectorIndex:
             out &= arr == self._values.id_of(value)
         return out
 
-    def _selector_col_match(self, selector, store: Dict[str, np.ndarray]) -> np.ndarray:
+    def _selector_col_match_locked(self, selector, store: Dict[str, np.ndarray]) -> np.ndarray:
         """Vectorized column evaluation of one LabelSelector over interned
         label arrays — matchLabels AND matchExpressions, mirroring
         LabelSelector.matches (api/types.py:303-322). The caller validates
         the selector first (invalid → general tier)."""
-        out = self._term_col_match(selector.match_labels, store)
+        out = self._term_col_match_locked(selector.match_labels, store)
         for req in selector.match_expressions:
             arr = store.get(req.key)
             present = (
@@ -402,7 +433,7 @@ class SelectorIndex:
                 out &= ~present
         return out
 
-    def _recompute_col(self, col: int) -> None:
+    def _recompute_col_locked(self, col: int) -> None:
         thr = self._col_thrs[col]
         try:
             # vectorized tier covers the full valid selector surface
@@ -414,17 +445,17 @@ class SelectorIndex:
                     term.namespace_selector.validate()
             match = np.zeros(self._pcap, dtype=bool)
             for term in thr.spec.selector.selector_terms:
-                m = self._selector_col_match(term.pod_selector, self._pod_label)
+                m = self._selector_col_match_locked(term.pod_selector, self._pod_label)
                 if self.kind == "clusterthrottle":
                     m &= self._pod_ns_exists  # unknown namespace → no match
-                    m &= self._selector_col_match(
+                    m &= self._selector_col_match_locked(
                         term.namespace_selector, self._ns_label
                     )
                 match |= m
         except SelectorError:
             match = np.zeros(self._pcap, dtype=bool)
             for key, row in self._pod_rows.items():
-                match[row] = self._eval_general(thr, self._row_pods[row])
+                match[row] = self._eval_general_locked(thr, self._row_pods[row])
         if isinstance(thr, Throttle):
             match &= self._pod_ns == self._ns_ids.id_of(thr.namespace)
         self.mask[:, col] = match
@@ -436,11 +467,11 @@ class SelectorIndex:
         "DoesNotExist": NativeRowEngine.OP_DOES_NOT_EXIST,
     }
 
-    def _native_reqs(self, selector) -> List[Tuple[int, int, Tuple[int, ...]]]:
+    def _native_reqs_locked(self, selector) -> List[Tuple[int, int, Tuple[int, ...]]]:
         """Compile one LabelSelector to native requirements; raises
         SelectorError for invalid selectors (the caller routes those to the
         general tier, which preserves the exact error-confinement
-        semantics of _eval_general)."""
+        semantics of _eval_general_locked)."""
         selector.validate()
         reqs = [
             (
@@ -460,7 +491,7 @@ class SelectorIndex:
             )
         return reqs
 
-    def _native_sync_col(self, col: int, thr: AnyThrottle) -> None:
+    def _native_sync_col_locked(self, col: int, thr: AnyThrottle) -> None:
         """Compile a throttle's selector into the native engine's column —
         matchLabels AND matchExpressions (In/NotIn/Exists/DoesNotExist);
         only selectors that fail validation stay on the Python general
@@ -470,9 +501,9 @@ class SelectorIndex:
         try:
             terms = []
             for term in thr.spec.selector.selector_terms:
-                pr = self._native_reqs(term.pod_selector)
+                pr = self._native_reqs_locked(term.pod_selector)
                 nr = (
-                    self._native_reqs(term.namespace_selector)
+                    self._native_reqs_locked(term.namespace_selector)
                     if isinstance(thr, ClusterThrottle)
                     else []
                 )
@@ -482,7 +513,7 @@ class SelectorIndex:
             return
         self._native.set_col(col, thr_ns, terms)
 
-    def _match_row_arbitrary(self, pod: Pod) -> np.ndarray:
+    def _match_row_arbitrary_locked(self, pod: Pod) -> np.ndarray:
         """Evaluate a pod (not necessarily stored) against every compiled
         column → bool[tcap]. Native C++ tier when available."""
         if self._native is not None:
@@ -503,29 +534,30 @@ class SelectorIndex:
             out = np.zeros(self._tcap, dtype=bool)
             out[: len(match)] = match.astype(bool)
             for col in np.nonzero(general)[0]:
-                out[col] = self._eval_general(self._col_thrs[int(col)], pod)
+                out[col] = self._eval_general_locked(self._col_thrs[int(col)], pod)
             return out
         out = np.zeros(self._tcap, dtype=bool)
         for key, col in self._thr_cols.items():
-            out[col] = self._match_one(self._col_thrs[col], pod)
+            out[col] = self._match_one_locked(self._col_thrs[col], pod)
         return out
 
     _PROBE_CACHE_MAX = 4096
 
-    def match_row_cached(self, pod: Pod) -> np.ndarray:
-        """``_match_row_arbitrary`` behind a (namespace, labels)-keyed LRU.
+    def match_row_cached_locked(self, pod: Pod) -> np.ndarray:
+        """``_match_row_arbitrary_locked`` behind a (namespace, labels)-keyed LRU.
 
         Caller must hold ``_lock``. The returned array is SHARED with the
         cache — treat it as read-only. Correctness: a selector match reads
-        nothing of the pod beyond namespace + labels (``_match_one``), and
+        nothing of the pod beyond namespace + labels (``_match_one_locked``), and
         ``_gen`` is bumped by every column or namespace mutation, so a hit
         can never serve a stale compiled-column evaluation."""
+        assert_held(self._lock, "SelectorIndex.match_row_cached_locked")
         key = (pod.namespace, frozenset(pod.labels.items()))
         hit = self._probe_cache.get(key)
         if hit is not None and hit[0] == self._gen:
             self._probe_cache.move_to_end(key)
             return hit[1]
-        row = self._match_row_arbitrary(pod)
+        row = self._match_row_arbitrary_locked(pod)
         self._probe_cache[key] = (self._gen, row)
         # assignment to an existing (gen-stale) key keeps its old LRU slot;
         # a just-refreshed hot entry must not be the next eviction victim
@@ -534,10 +566,10 @@ class SelectorIndex:
             self._probe_cache.popitem(last=False)
         return row
 
-    def _recompute_row(self, row: int) -> None:
-        self.mask[row, :] = self._match_row_arbitrary(self._row_pods[row])
+    def _recompute_row_locked(self, row: int) -> None:
+        self.mask[row, :] = self._match_row_arbitrary_locked(self._row_pods[row])
 
-    def _match_one(self, thr: AnyThrottle, pod: Pod) -> bool:
+    def _match_one_locked(self, thr: AnyThrottle, pod: Pod) -> bool:
         """Single-pair oracle used by row recompute AND external callers
         (e.g. the not-yet-indexed-pod fallback) — it must apply the FULL
         affected-throttle predicate, including Throttle namespace equality
@@ -564,9 +596,9 @@ class SelectorIndex:
                     else:
                         return True
             return False
-        return self._eval_general(thr, pod)
+        return self._eval_general_locked(thr, pod)
 
-    def _eval_general(self, thr: AnyThrottle, pod: Pod) -> bool:
+    def _eval_general_locked(self, thr: AnyThrottle, pod: Pod) -> bool:
         try:
             if isinstance(thr, Throttle):
                 return thr.spec.selector.matches_to_pod(pod)
@@ -617,7 +649,7 @@ class SelectorIndex:
                     # processed: its row was saved before the overwrite
                     cols = np.nonzero(prev[2] & self._thr_valid[: prev[2].shape[0]])[0]
                 else:
-                    cols = np.nonzero(self.match_row_cached(pod) & self._thr_valid)[0]
+                    cols = np.nonzero(self.match_row_cached_locked(pod) & self._thr_valid)[0]
             ck = self._col_keys
             return [ck[c] for c in cols.tolist() if c in ck]
 
@@ -664,4 +696,5 @@ class SelectorIndex:
 
     @property
     def capacities(self) -> Tuple[int, int]:
-        return self._pcap, self._tcap
+        with self._lock:
+            return self._pcap, self._tcap

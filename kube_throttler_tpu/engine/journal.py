@@ -32,10 +32,10 @@ import json
 import logging
 import os
 import tempfile
-import threading
 from typing import Optional, Tuple
 
 from ..api.serialization import object_from_dict, object_to_dict
+from ..utils.lockorder import assert_held, guard_attrs, make_lock
 from .store import Event, EventType, Store
 
 logger = logging.getLogger(__name__)
@@ -44,8 +44,17 @@ logger = logging.getLogger(__name__)
 _KIND_ORDER = {"Namespace": 0, "Throttle": 1, "ClusterThrottle": 1, "Pod": 2}
 
 
+@guard_attrs
 class StoreJournal:
     """Attach with :func:`attach`; detach via :meth:`close`."""
+
+    # the live-append file handle and its line counter move only under the
+    # journal lock (the robustness counters are single-writer ints read by
+    # health probes — unguarded on purpose)
+    GUARDED_BY = {
+        "_file": "self._lock",
+        "_lines": "self._lock",
+    }
 
     def __init__(
         self, store: Store, path: str, compact_after: int = 100_000, faults=None
@@ -54,7 +63,7 @@ class StoreJournal:
         self.path = path
         self.compact_after = compact_after
         self.faults = faults
-        self._lock = threading.Lock()
+        self._lock = make_lock("journal")
         self._lines = 0
         self._file = None
         # robustness counters (health probe + tests read these)
@@ -213,7 +222,9 @@ class StoreJournal:
 
     def _compact_locked(self) -> None:
         """Rewrite the journal as a snapshot of the CURRENT store contents
-        (ADDED lines, namespaces first), atomically."""
+        (ADDED lines, namespaces first), atomically. Caller holds the
+        journal lock (asserted under KT_LOCK_ASSERT=1)."""
+        assert_held(self._lock, "StoreJournal._compact_locked")
         objs = []
         for ns in self.store.list_namespaces():
             objs.append(("Namespace", ns))
@@ -258,9 +269,17 @@ class StoreJournal:
         """Force a compaction now (operational hook + the chaos soak's
         heal-the-log step): the journal becomes a clean snapshot of the
         live store, erasing any torn/corrupt interior lines."""
-        with self._lock:
-            if self._file is not None:
-                self._compact_locked()
+        # store lock FIRST — the same order as the dispatch path
+        # (store._dispatch_locked -> _on_event -> journal lock). Taking
+        # only the journal lock here and letting _compact_locked's
+        # store.list_* acquire the store lock underneath was an ABBA
+        # inversion against concurrent writers (found by KT_LOCK_ASSERT),
+        # and it could also lose a concurrent event: one appended to the
+        # old file after the snapshot was cut would vanish at rotation.
+        with self.store._lock:  # noqa: SLF001 — same-package access
+            with self._lock:
+                if self._file is not None:
+                    self._compact_locked()
 
     def health_state(self) -> Tuple[str, dict]:
         """Health-component contract (health.py): degraded while any
@@ -300,8 +319,11 @@ def attach(
     if truncate_at is not None:
         with open(path, "r+b") as f:
             f.truncate(truncate_at)
-    journal._file = open(path, "a", encoding="utf-8")
-    journal._lines = n
+    # under the lock although pre-publication: _file/_lines are declared
+    # guarded, and the runtime guard (KT_LOCK_ASSERT=1) checks rebinds
+    with journal._lock:
+        journal._file = open(path, "a", encoding="utf-8")
+        journal._lines = n
     for kind in Store.KINDS:
         store.add_event_handler(kind, journal._on_event, replay=False)
     return journal

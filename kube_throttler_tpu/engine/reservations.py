@@ -14,8 +14,7 @@ Unreserve) — the reserve-until-observed handshake (SURVEY §3.3).
 
 from __future__ import annotations
 
-import threading
-
+from ..utils.lockorder import make_rlock
 from ..utils.tracing import vlog
 from typing import Dict, Iterable, Optional, Set, Tuple
 
@@ -24,13 +23,23 @@ from ..api.types import ResourceAmount, resource_amount_of_pod
 
 
 class ReservedResourceAmounts:
+    # the top-level cache map is guarded by the global lock; the per-key
+    # pod maps inside it are guarded by the hashed key locks (lock order:
+    # key lock -> global lock, never the reverse)
+    GUARDED_BY = {"_cache": "self._lock"}
+
     def __init__(self, num_key_mutex: int = 128):
-        self._lock = threading.RLock()
-        self._key_locks = [threading.RLock() for _ in range(max(1, num_key_mutex))]
+        self._lock = make_rlock("reservations.global")
+        # hashed per-throttle-key mutexes share one name: distinct slots
+        # are never nested (one hash bucket per operation), so a shared
+        # name loses no order information
+        self._key_locks = [
+            make_rlock("reservations.key") for _ in range(max(1, num_key_mutex))
+        ]
         # throttle key -> pod key -> amount
         self._cache: Dict[str, Dict[str, ResourceAmount]] = {}
 
-    def _key_lock(self, key: str) -> threading.RLock:
+    def _key_lock(self, key: str):
         return self._key_locks[hash(key) % len(self._key_locks)]
 
     def _pod_map(self, throttle_key: str) -> Dict[str, ResourceAmount]:

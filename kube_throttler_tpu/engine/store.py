@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional, Union
 
 from ..api.pod import Namespace, Pod
 from ..api.types import ClusterThrottle, Throttle
+from ..utils.lockorder import assert_held, make_rlock
 
 KObject = Union[Pod, Namespace, Throttle, ClusterThrottle]
 
@@ -70,8 +71,17 @@ class Store:
 
     KINDS = ("Pod", "Namespace", "Throttle", "ClusterThrottle")
 
+    # everything below mutates only under the store lock; dispatch also
+    # runs inside it (lock order store -> handler-internal, see _create)
+    GUARDED_BY = {
+        "_rv": "self._lock",
+        "_objects": "self._lock",
+        "_versions": "self._lock",
+        "_handlers": "self._lock",
+    }
+
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store")
         self._rv = 0
         self._objects: Dict[str, Dict[str, KObject]] = {k: {} for k in self.KINDS}
         self._versions: Dict[str, Dict[str, int]] = {k: {} for k in self.KINDS}
@@ -100,7 +110,10 @@ class Store:
             except ValueError:
                 pass
 
-    def _dispatch(self, event: Event) -> None:
+    def _dispatch_locked(self, event: Event) -> None:
+        """Caller holds the store lock (see the NOTE below — dispatch runs
+        inside it by design; asserted under KT_LOCK_ASSERT=1)."""
+        assert_held(self._lock, "Store._dispatch_locked")
         for handler in list(self._handlers[event.kind]):
             handler(event)
 
@@ -122,7 +135,7 @@ class Store:
             self._rv += 1
             self._objects[kind][key] = obj
             self._versions[kind][key] = self._rv
-            self._dispatch(Event(EventType.ADDED, kind, obj))
+            self._dispatch_locked(Event(EventType.ADDED, kind, obj))
         return obj
 
     def _update(self, kind: str, obj: KObject) -> KObject:
@@ -134,7 +147,7 @@ class Store:
             self._rv += 1
             self._objects[kind][key] = obj
             self._versions[kind][key] = self._rv
-            self._dispatch(Event(EventType.MODIFIED, kind, obj, old_obj=old))
+            self._dispatch_locked(Event(EventType.MODIFIED, kind, obj, old_obj=old))
         return obj
 
     def _delete(self, kind: str, key: str) -> KObject:
@@ -144,7 +157,7 @@ class Store:
                 raise NotFoundError(f"{kind} {key!r} not found")
             self._versions[kind].pop(key, None)
             self._rv += 1
-            self._dispatch(Event(EventType.DELETED, kind, old))
+            self._dispatch_locked(Event(EventType.DELETED, kind, old))
         return old
 
     def _get(self, kind: str, key: str) -> KObject:
@@ -277,7 +290,7 @@ class Store:
             self._rv += 1
             self._objects["Throttle"][key] = updated
             self._versions["Throttle"][key] = self._rv
-            self._dispatch(Event(EventType.MODIFIED, "Throttle", updated, old_obj=current))
+            self._dispatch_locked(Event(EventType.MODIFIED, "Throttle", updated, old_obj=current))
         return updated
 
     def _update_statuses_locked(self, kind: str, thrs) -> Dict[str, object]:
@@ -300,7 +313,7 @@ class Store:
                     self._rv += 1
                     self._objects[kind][key] = updated
                     self._versions[kind][key] = self._rv
-                    self._dispatch(
+                    self._dispatch_locked(
                         Event(EventType.MODIFIED, kind, updated, old_obj=current)
                     )
                     out[key] = updated
@@ -330,7 +343,7 @@ class Store:
             self._rv += 1
             self._objects["ClusterThrottle"][key] = updated
             self._versions["ClusterThrottle"][key] = self._rv
-            self._dispatch(
+            self._dispatch_locked(
                 Event(EventType.MODIFIED, "ClusterThrottle", updated, old_obj=current)
             )
         return updated

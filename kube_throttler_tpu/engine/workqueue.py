@@ -44,6 +44,7 @@ from datetime import timedelta
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..utils.clock import Clock, RealClock
+from ..utils.lockorder import assert_held, guard_attrs, make_rlock
 
 _BASE_DELAY = 0.005  # 5ms
 _MAX_DELAY = 1000.0  # 1000s
@@ -53,14 +54,32 @@ class ShutDown(Exception):
     pass
 
 
+@guard_attrs
 class RateLimitingQueue:
+    # every queue structure below moves only under the single shared lock
+    # (held directly or via either condition); see docs/STATIC_ANALYSIS.md
+    GUARDED_BY = {
+        "_queue": "self._lock",
+        "_queue_hi": "self._lock",
+        "_hi": "self._lock",
+        "_hi_pending": "self._lock",
+        "_dirty": "self._lock",
+        "_processing": "self._lock",
+        "_failures": "self._lock",
+        "_enqueue_ts": "self._lock",
+        "_claim_ts": "self._lock",
+        "_delayed": "self._lock",
+        "_seq": "self._lock",
+        "_shutdown": "self._lock",
+    }
+
     def __init__(self, name: str = "", clock: Optional[Clock] = None):
         self.name = name
         self._clock = clock or RealClock()
         # consumers (get) and the delay waker wait on separate conditions
         # over ONE shared lock, so add()/done() can notify exactly one
         # consumer without waking (or losing the wakeup to) the waker
-        self._lock = threading.RLock()
+        self._lock = make_rlock(f"workqueue.{name or 'unnamed'}")
         self._cond = threading.Condition(self._lock)
         self._waker_cond = threading.Condition(self._lock)
         self._queue: List[str] = []  # FIFO of ready items (normal lane)
@@ -161,8 +180,10 @@ class RateLimitingQueue:
             if added:
                 self._cond.notify()
 
-    def _pop_ready(self) -> Optional[str]:
-        """Caller holds the lock. Priority lane first."""
+    def _pop_ready_locked(self) -> Optional[str]:
+        """Caller holds the lock (the `_locked` contract — asserted under
+        KT_LOCK_ASSERT=1). Priority lane first."""
+        assert_held(self._lock, "RateLimitingQueue._pop_ready_locked")
         if self._queue_hi:
             item = self._queue_hi.pop(0)
             self._hi.discard(item)
@@ -188,12 +209,12 @@ class RateLimitingQueue:
                         raise TimeoutError
             if self._shutdown and not (self._queue or self._queue_hi):
                 raise ShutDown
-            return self._pop_ready()
+            return self._pop_ready_locked()
 
     def try_get(self) -> Optional[str]:
         """Non-blocking get: an immediately-ready item or None (batch drain)."""
         with self._cond:
-            return self._pop_ready()
+            return self._pop_ready_locked()
 
     def claim_ts(self, item: str) -> Optional[float]:
         """Monotonic time of the first add that made the in-flight ``item``

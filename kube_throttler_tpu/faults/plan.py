@@ -60,6 +60,33 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..utils.lockorder import guard_attrs, make_lock
+
+
+# The instrumented sites in-tree (the table above, as code). This is the
+# registry the static analyzer's `registry` checker enforces: every literal
+# site passed to check()/maybe_raise() must be a member, and every
+# FaultRule site pattern must fnmatch at least one member — an
+# unregistered site string silently never fires, which is exactly the
+# drift class this exists to catch. Keep it a plain literal set (the
+# analyzer reads it from the AST without importing this module).
+KNOWN_SITES = frozenset(
+    {
+        "transport.request",
+        "transport.put.conflict",
+        "transport.watch.open",
+        "transport.watch.read",
+        "journal.append",
+        "journal.fsync",
+        "device.dispatch",
+        "mock.list",
+        "mock.watch.cut",
+        "mock.watch.gone",
+        "mock.status.conflict",
+        "mock.status.error",
+    }
+)
+
 
 class FaultInjected(Exception):
     """Default exception raised at a firing fault point with mode
@@ -121,6 +148,7 @@ def _decision(seed: int, rule_idx: int, site: str, hit: int) -> float:
     return int.from_bytes(digest[:8], "big") / 2**64
 
 
+@guard_attrs
 class FaultPlan:
     """A seeded set of fault rules plus the per-site hit/firing bookkeeping.
 
@@ -128,10 +156,16 @@ class FaultPlan:
     docstring), so the per-site fault sequence is reproducible from the
     seed alone."""
 
+    GUARDED_BY = {
+        "_hits": "self._lock",
+        "_fired": "self._lock",
+        "history": "self._lock",
+    }
+
     def __init__(self, seed: int = 0):
         self.seed = int(seed)
         self._rules: List[FaultRule] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.plan")
         self._hits: Dict[str, int] = {}
         self._fired: Dict[Tuple[int, str], int] = {}  # (rule idx, site) → count
         # site → [(hit, mode)] — the reproducibility witness

@@ -25,8 +25,9 @@ never RPCs.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, Tuple, Union
+
+from .utils.lockorder import guard_attrs, make_lock
 
 # probe return: "ok" | ("ok", {...detail}) — detail optional
 ProbeResult = Union[str, Tuple[str, dict]]
@@ -36,11 +37,14 @@ _SEVERITY = {"ok": 0, "degraded": 1, "down": 2}
 STATES = tuple(_SEVERITY)
 
 
+@guard_attrs
 class Health:
     """Registry of component probes + aggregate snapshot."""
 
+    GUARDED_BY = {"_probes": "self._lock"}
+
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("health")
         self._probes: Dict[str, Probe] = {}
 
     def register(self, component: str, probe: Probe) -> None:

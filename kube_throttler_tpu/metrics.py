@@ -23,21 +23,74 @@ metrics endpoint; here ``Registry.exposition()`` backs the daemon's
 from __future__ import annotations
 
 import math
-import threading
+
 from bisect import bisect_left as _bucket_index  # smallest i: buckets[i] >= v
 from fractions import Fraction
 from functools import lru_cache
 from typing import Dict, Optional, Sequence, Tuple, Union
 
+from .utils.lockorder import guard_attrs, make_lock
 from .api.types import ClusterThrottle, IsResourceAmountThrottled, ResourceAmount, Throttle
 
+# Every metric family this process may expose, declared in one place.
+# The static analyzer's `registry` checker enforces that any literal name
+# passed to gauge_vec/counter_vec/histogram_vec anywhere in the package is
+# a member — an inline name that drifts from this set is a family no
+# dashboard or alert will ever find. The per-kind families built from
+# f-strings in _KindRecorder are enumerated explicitly below. Keep this a
+# plain literal (the analyzer reads it from the AST without importing).
+METRIC_NAMES = frozenset(
+    {
+        # _KindRecorder: 8 families x 2 kinds (f"{kind}_{suffix}")
+        "throttle_spec_threshold_resourceCounts",
+        "throttle_spec_threshold_resourceRequests",
+        "throttle_status_throttled_resourceCounts",
+        "throttle_status_throttled_resourceRequests",
+        "throttle_status_used_resourceCounts",
+        "throttle_status_used_resourceRequests",
+        "throttle_status_calculated_threshold_resourceCounts",
+        "throttle_status_calculated_threshold_resourceRequests",
+        "clusterthrottle_spec_threshold_resourceCounts",
+        "clusterthrottle_spec_threshold_resourceRequests",
+        "clusterthrottle_status_throttled_resourceCounts",
+        "clusterthrottle_status_throttled_resourceRequests",
+        "clusterthrottle_status_used_resourceCounts",
+        "clusterthrottle_status_used_resourceRequests",
+        "clusterthrottle_status_calculated_threshold_resourceCounts",
+        "clusterthrottle_status_calculated_threshold_resourceRequests",
+        # two-lane status pipeline lag histograms (StatusLagMetrics)
+        "kube_throttler_status_lag_seconds",
+        "kube_throttler_status_flip_lag_seconds",
+        # device circuit breaker (register_breaker_metrics)
+        "kube_throttler_device_breaker_state",
+        # watch fan-out health (register_watch_metrics)
+        "kube_throttler_watch_streams_open",
+        "kube_throttler_watch_queue_depth",
+        "kube_throttler_watch_overflow_total",
+        # reflector counters (client/transport.py ReflectorMetrics)
+        "kube_throttler_reflector_lists_total",
+        "kube_throttler_reflector_watches_total",
+        "kube_throttler_reflector_events_total",
+        "kube_throttler_reflector_gone_total",
+        # async status committer (client/transport.py)
+        "kube_throttler_remote_status_commit_total",
+        # device-fallback counter (plugin/plugin.py)
+        "kube_throttler_device_fallback_total",
+        # phase-latency tracing histogram (utils/tracing.py)
+        "kube_throttler_phase_duration_seconds",
+    }
+)
 
+
+@guard_attrs
 class GaugeVec:
+    GUARDED_BY = {"_values": "self._lock"}
+
     def __init__(self, name: str, help_text: str, label_names: Sequence[str]):
         self.name = name
         self.help = help_text
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"metrics.family.{name}")
         self._values: Dict[Tuple[str, ...], float] = {}
 
     def set(self, labels: Dict[str, str], value: float) -> None:
@@ -71,10 +124,13 @@ class CounterVec(GaugeVec):
             self._values[key] = self._values.get(key, 0.0) + delta
 
 
+@guard_attrs
 class HistogramVec:
     """Prometheus histogram family: cumulative buckets + _sum/_count per
     label set. Backs the per-phase latency tracing (SURVEY §5's TPU-native
     tracing equivalent — the reference has only klog levels)."""
+
+    GUARDED_BY = {"_series": "self._lock"}
 
     # le boundaries tuned for scheduling-phase latencies: 10µs .. 10s
     DEFAULT_BUCKETS = (
@@ -93,7 +149,7 @@ class HistogramVec:
         self.help = help_text
         self.label_names = tuple(label_names)
         self.buckets = tuple(buckets) if buckets is not None else self.DEFAULT_BUCKETS
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"metrics.family.{name}")
         # key -> (bucket counts, sum, count)
         self._series: Dict[Tuple[str, ...], list] = {}
 
@@ -136,9 +192,17 @@ class HistogramVec:
         return out
 
 
+@guard_attrs
 class Registry:
+    GUARDED_BY = {
+        "_gauges": "self._lock",
+        "_counters": "self._lock",
+        "_histograms": "self._lock",
+        "_pre_expose": "self._lock",
+    }
+
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
         self._gauges: Dict[str, GaugeVec] = {}
         self._counters: Dict[str, CounterVec] = {}
         self._histograms: Dict[str, HistogramVec] = {}
@@ -245,8 +309,11 @@ def _quantity_metric_value(resource: str, q: Fraction) -> float:
     return float(math.ceil(q))
 
 
+@guard_attrs
 class _KindRecorder:
     """One kind's 8 gauge families."""
+
+    GUARDED_BY = {"_pending": "self._pending_lock"}
 
     def __init__(self, kind_prefix: str, label_names: Sequence[str], registry: Registry):
         mk = registry.gauge_vec
@@ -256,8 +323,8 @@ class _KindRecorder:
         # deferred-record buffer: latest object per label set, flushed by
         # the registry's pre-exposition hook (see record())
         self._pending: Dict[Tuple[str, ...], object] = {}
-        self._pending_lock = threading.Lock()
-        self._flush_lock = threading.Lock()
+        self._pending_lock = make_lock(f"metrics.pending.{kind_prefix}")
+        self._flush_lock = make_lock(f"metrics.flush.{kind_prefix}")
         registry.register_pre_expose(self._flush)
         self.spec_counts = mk(
             f"{k}_spec_threshold_resourceCounts",

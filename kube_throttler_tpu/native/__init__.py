@@ -34,7 +34,9 @@ _SRC = _PKG_DIR / "ktnative.cpp"
 _SO = _PKG_DIR / "_ktnative.so"
 
 _lib: Optional[ctypes.CDLL] = None
-_load_lock = threading.Lock()
+from ..utils.lockorder import make_lock as _make_lock
+
+_load_lock = _make_lock("native.load")
 _load_attempted = False
 
 _i32p = ctypes.POINTER(ctypes.c_int32)

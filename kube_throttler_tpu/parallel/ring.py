@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jaxcompat import shard_map
+
 from ..ops.aggregate import throttled_flags
 from ..ops.check import CHECK_ACTIVE, CHECK_INSUFFICIENT, CHECK_POD_EXCEEDS, _classify
 from ..ops.overrides import OverrideSchedule, calculate_thresholds
@@ -145,7 +147,7 @@ def ring_full_update(mesh: Mesh, *, on_equal: bool = False, step3_on_equal: bool
     sched_specs = uniform_sched_specs(ring)
     pods_specs = uniform_pods_specs(ring)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         _sweep,
         mesh=mesh,
         in_specs=(

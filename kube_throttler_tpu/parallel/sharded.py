@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jaxcompat import shard_map
+
 from dataclasses import fields as _dc_fields
 
 from ..ops.aggregate import aggregate_used, throttled_flags
@@ -283,7 +285,7 @@ def sharded_full_update_gather(
             pod_axis="pods", thr_axis="throttles",
         )
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         _step,
         mesh=mesh,
         in_specs=(
@@ -330,7 +332,7 @@ def sharded_apply_deltas(mesh: Mesh):
             used_cnt, used_req, contrib, local_ids, sign, pod_req, pod_present
         )
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         _apply,
         mesh=mesh,
         in_specs=(thr_spec, thr_spec, thr_spec, P(), P(), P(), P()),
@@ -360,7 +362,7 @@ def sharded_full_update(mesh: Mesh, *, on_equal: bool = False, step3_on_equal: b
             pod_axis="pods", thr_axis="throttles",
         )
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         _step,
         mesh=mesh,
         in_specs=(

@@ -47,6 +47,7 @@ import time
 from typing import List, Optional
 
 from ..api.pod import Pod
+from ..utils.lockorder import guard_attrs, make_lock
 from .framework import Status, StatusCode
 
 
@@ -59,12 +60,18 @@ class _Entry:
         self.status: Optional[Status] = None
 
 
+@guard_attrs
 class PreFilterCoalescer:
+    GUARDED_BY = {
+        "_queue": "self._lock",
+        "_leader_active": "self._lock",
+    }
+
     def __init__(self, plugin, window_s: float = 0.0, max_batch: int = 64):
         self._plugin = plugin
         self._window = window_s
         self._max_batch = max_batch
-        self._lock = threading.Lock()
+        self._lock = make_lock("plugin.coalescer")
         self._queue: List[_Entry] = []
         self._leader_active = False
 

@@ -9,9 +9,10 @@ event-recorder interface (plugin.go:190-201).
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 from enum import Enum
+
+from ..utils.lockorder import guard_attrs, make_lock
 from typing import Dict, List, Optional, Tuple
 
 
@@ -56,6 +57,7 @@ class EventRecorder:
         raise NotImplementedError
 
 
+@guard_attrs
 class RecordingEventRecorder(EventRecorder):
     """Stores emitted events (the integration tier asserts on them the way
     the reference asserts on FailedScheduling / ResourceRequestsExceeds…
@@ -66,8 +68,13 @@ class RecordingEventRecorder(EventRecorder):
     ``max_events`` with oldest-first eviction — a daemon retrying one stuck
     pod every flush interval must not grow memory without bound."""
 
+    GUARDED_BY = {
+        "events": "self._lock",
+        "counts": "self._lock",
+    }
+
     def __init__(self, max_events: int = 10_000) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("plugin.event_recorder")
         self._max_events = max_events
         self.events: List[PodEvent] = []
         self.counts: Dict[PodEvent, int] = {}

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 from .api.pod import Pod
 from .quantity import parse_quantity
 from .resourcelist import add as rl_add, pod_request_resource_list, sub as rl_sub
+from .utils.lockorder import assert_held, guard_attrs, make_condition, make_lock
 from .utils.tracing import vlog
 from .engine.store import Event, EventType, Store
 from .plugin.plugin import KubeThrottler
@@ -67,6 +68,7 @@ class _QueuedPod:
     not_before: float = 0.0  # monotonic gate for backoff
 
 
+@guard_attrs
 class Scheduler:
     """Single-threaded scheduling loop over the store's pending pods.
 
@@ -75,6 +77,17 @@ class Scheduler:
     """
 
     FAILED_SCHEDULING = "FailedScheduling"
+
+    # queue state and node-occupancy ledgers move only under the single
+    # scheduler lock (always taken through the `_cv` condition over it)
+    GUARDED_BY = {
+        "_active": "self._cv",
+        "_unschedulable": "self._cv",
+        "_queued_keys": "self._cv",
+        "_wake_gen": "self._cv",
+        "_bound_per_node": "self._cv",
+        "_alloc_used": "self._cv",
+    }
 
     def __init__(
         self,
@@ -102,8 +115,8 @@ class Scheduler:
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
 
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock("scheduler")
+        self._cv = make_condition(self._lock)
         self._active: List[_QueuedPod] = []
         self._unschedulable: Dict[str, _QueuedPod] = {}
         self._queued_keys: set = set()
@@ -131,9 +144,10 @@ class Scheduler:
 
     # -- queue management --------------------------------------------------
 
-    def _track_usage(self, node_name: Optional[str], pod: Optional[Pod], sign: int) -> None:
+    def _track_usage_locked(self, node_name: Optional[str], pod: Optional[Pod], sign: int) -> None:
         """Adjust a node's used-resources ledger — no-op for resource-blind
         nodes, keeping the hot event path free of Fraction work."""
+        assert_held(self._lock, "Scheduler._track_usage_locked")
         if pod is None or node_name is None or self._alloc_cap.get(node_name) is None:
             return
         (rl_add if sign > 0 else rl_sub)(
@@ -141,14 +155,17 @@ class Scheduler:
         )
 
     def _is_schedulable_target(self, pod: Pod) -> bool:
+        # reads only immutable-after-init config (self._target) + the pod —
+        # deliberately callable with or without the scheduler lock
         return (
             pod.spec.scheduler_name == self._target
             and not pod.is_scheduled()
             and pod.is_not_finished()
         )
 
-    def _occupies_node(self, pod: Optional[Pod]) -> Optional[str]:
+    def _occupies_node_locked(self, pod: Optional[Pod]) -> Optional[str]:
         """Node name this pod holds a slot on, or None."""
+        assert_held(self._lock, "Scheduler._occupies_node_locked")
         if pod is None or not pod.is_scheduled() or not pod.is_not_finished():
             return None
         return pod.spec.node_name if pod.spec.node_name in self._bound_per_node else None
@@ -157,10 +174,10 @@ class Scheduler:
         pod = event.obj
         if event.type == EventType.DELETED:
             with self._cv:
-                freed = self._occupies_node(pod)
+                freed = self._occupies_node_locked(pod)
                 if freed is not None:
                     self._bound_per_node[freed] -= 1
-                    self._track_usage(freed, pod, -1)
+                    self._track_usage_locked(freed, pod, -1)
                 self._queued_keys.discard(pod.key)
                 self._unschedulable.pop(pod.key, None)
                 self._active = [q for q in self._active if q.key != pod.key]
@@ -170,10 +187,10 @@ class Scheduler:
             return
         if event.type == EventType.ADDED:
             with self._cv:
-                held = self._occupies_node(pod)
+                held = self._occupies_node_locked(pod)
                 if held is not None:
                     self._bound_per_node[held] += 1
-                    self._track_usage(held, pod, +1)
+                    self._track_usage_locked(held, pod, +1)
                 elif self._is_schedulable_target(pod) and pod.key not in self._queued_keys:
                     self._queued_keys.add(pod.key)
                     self._active.append(_QueuedPod(pod.key))
@@ -183,15 +200,15 @@ class Scheduler:
         # AND in-place request edits (same node, different requests), then
         # treat the change as a requeue hint for unschedulable pods
         with self._cv:
-            before = self._occupies_node(event.old_obj)
-            after = self._occupies_node(pod)
+            before = self._occupies_node_locked(event.old_obj)
+            after = self._occupies_node_locked(pod)
             if before != after:
                 if before is not None:
                     self._bound_per_node[before] -= 1
                 if after is not None:
                     self._bound_per_node[after] += 1
-            self._track_usage(before, event.old_obj, -1)
-            self._track_usage(after, pod, +1)
+            self._track_usage_locked(before, event.old_obj, -1)
+            self._track_usage_locked(after, pod, +1)
         self._wake_unschedulable()
 
     def _on_cluster_event(self, event: Event) -> None:
@@ -219,10 +236,11 @@ class Scheduler:
 
     # -- the scheduling cycle ---------------------------------------------
 
-    def _fits_resources(self, node: Node, req) -> bool:
+    def _fits_resources_locked(self, node: Node, req) -> bool:
         """NodeResourcesFit: every requested dimension must be declared in
         the node's allocatable and leave headroom. Resource-blind when the
         node declares no allocatable."""
+        assert_held(self._lock, "Scheduler._fits_resources_locked")
         cap = self._alloc_cap[node.name]
         if cap is None:
             return True
@@ -239,7 +257,7 @@ class Scheduler:
         req = pod_request_resource_list(pod)
         with self._cv:
             for node in self.nodes:
-                if self._bound_per_node[node.name] < node.max_pods and self._fits_resources(
+                if self._bound_per_node[node.name] < node.max_pods and self._fits_resources_locked(
                     node, req
                 ):
                     return node

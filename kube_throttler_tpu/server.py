@@ -31,6 +31,7 @@ from dataclasses import replace
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from .utils.lockorder import make_lock
 from .api.pod import Namespace
 from .api.serialization import object_from_dict
 from .api.types import ClusterThrottle, Throttle
@@ -86,7 +87,7 @@ class ThrottlerHTTPServer:
         # serializes get-then-update pod mutations (re-apply, bind): the
         # handler pool is threaded and a lost update here silently unbinds
         # a running pod
-        self._pod_write_lock = threading.Lock()
+        self._pod_write_lock = make_lock("server.pod_write")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -4,8 +4,9 @@ plugin.go:97/109)."""
 
 from __future__ import annotations
 
-import threading
 from datetime import datetime, timedelta, timezone
+
+from .lockorder import guard_attrs, make_condition, make_lock
 
 
 class Clock:
@@ -27,12 +28,15 @@ class RealClock(Clock):
         return datetime.now(timezone.utc)
 
 
+@guard_attrs
 class FakeClock(Clock):
     """Settable clock for tests; ``advance`` wakes subscribed waiters."""
 
+    GUARDED_BY = {"_now": "self._cond", "_listeners": "self._cond"}
+
     def __init__(self, start: datetime):
         self._now = start
-        self._cond = threading.Condition()
+        self._cond = make_condition(make_lock("utils.fakeclock"))
         self._listeners = []
 
     def now(self) -> datetime:

@@ -1,0 +1,39 @@
+"""Version-drift shims for the installed jax.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace (and the experimental module was later removed). The
+multi-chip paths (parallel/sharded.py, parallel/ring.py, and the tick
+orchestration in engine/devicestate.py) must compile against whichever
+spelling the installed jax provides, so they import the symbol from here
+instead of hard-coding either location.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    # modern spelling; getattr would trip jax's accelerated-deprecation
+    # shim on versions where the name is only a stub, so import eagerly
+    # and fall back on AttributeError either way
+    shard_map = jax.shard_map  # type: ignore[attr-defined]
+except AttributeError:
+    try:
+        from jax.experimental.shard_map import shard_map  # type: ignore
+    except ImportError:  # pragma: no cover - neither spelling available
+        shard_map = None
+
+HAS_SHARD_MAP = shard_map is not None
+
+
+def require_shard_map():
+    """The installed jax's shard_map, or a clear error naming both
+    spellings (callers otherwise surface an AttributeError deep inside a
+    compile cache miss)."""
+    if shard_map is None:  # pragma: no cover - env-dependent
+        raise RuntimeError(
+            "this jax provides neither jax.shard_map nor "
+            "jax.experimental.shard_map.shard_map; multi-chip sharded "
+            "paths need one of them"
+        )
+    return shard_map

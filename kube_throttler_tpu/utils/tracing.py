@@ -23,13 +23,14 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 import time
 from typing import Dict, Iterator, Optional
 
+from .lockorder import make_lock
+
 logger = logging.getLogger("kube_throttler_tpu")
 
-_verbosity_lock = threading.Lock()
+_verbosity_lock = make_lock("tracing.verbosity")
 _verbosity = 0
 
 

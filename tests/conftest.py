@@ -7,6 +7,12 @@ TPU path uses).
 
 import os
 
+# Runtime lock-order assassin (utils/lockorder.py): on for the whole
+# suite so the chaos/soak tiers double as a race detector. Must be set
+# before any kube_throttler_tpu import — module- and class-level locks
+# are created at import time. Opt out per-run with KT_LOCK_ASSERT=0.
+os.environ.setdefault("KT_LOCK_ASSERT", "1")
+
 # force, not setdefault: the ambient environment points JAX_PLATFORMS at real
 # TPU hardware AND preloads jax via sitecustomize, so the env var alone is
 # too late — jax.config must be updated before the first backend init

@@ -1,0 +1,520 @@
+"""Static-analyzer test tier: each checker must fire on a seeded fixture
+violation (with the right file:line) and stay silent on the real repo.
+
+Fixtures are tiny source trees written to tmp_path and analyzed through
+the same ``load_package``/``run_checks`` pipeline the CLI uses, so the
+tests exercise path scoping and baseline handling too — not just the AST
+visitors. The final tier-1 gate shells out to ``python -m
+kube_throttler_tpu.analysis`` exactly the way ``make lint`` does.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kube_throttler_tpu.analysis import run_checks, run_repo
+from kube_throttler_tpu.analysis.__main__ import main as analysis_main
+from kube_throttler_tpu.analysis.core import (
+    apply_baseline,
+    load_baseline,
+    load_package,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_tree(root, files):
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def findings_for(root, checks, allowlist_path=None):
+    return run_checks(load_package(str(root)), checks, allowlist_path=allowlist_path)
+
+
+# ------------------------------------------------------------------ guarded
+
+
+class TestGuardedBy:
+    def test_unguarded_write_fires_with_line(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class Box:
+                    GUARDED_BY = {"_items": "self._lock"}
+
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._items = []
+
+                    def ok(self):
+                        with self._lock:
+                            self._items.append(1)
+
+                    def bad(self):
+                        self._items.append(2)
+                '''
+            },
+        )
+        found = findings_for(root, ("guarded",))
+        assert len(found) == 1
+        f = found[0]
+        assert f.checker == "guarded"
+        assert f.relpath == "mod.py"
+        assert f.line == 16  # the self._items read in bad()
+        assert "_items" in f.message and "Box.bad" in f.message
+
+    def test_inline_annotation_and_locked_suffix(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0  #: guarded-by: self._lock
+
+                    def _bump_locked(self):
+                        self._n += 1  # caller-holds-lock contract: no finding
+
+                    def bad(self):
+                        return self._n
+                '''
+            },
+        )
+        found = findings_for(root, ("guarded",))
+        assert [f.line for f in found] == [13]
+        assert "Box.bad" in found[0].message
+
+    def test_condition_alias_satisfies_lock_guard(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class Q:
+                    GUARDED_BY = {"_q": "self._lock"}
+
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cv = threading.Condition(self._lock)
+                        self._q = []
+
+                    def put(self, x):
+                        with self._cv:  # holding the condition IS holding the lock
+                            self._q.append(x)
+                '''
+            },
+        )
+        assert findings_for(root, ("guarded",)) == []
+
+
+# ---------------------------------------------------------------- lockorder
+
+
+_CYCLE_SRC = {
+    "mod.py": '''\
+    import threading
+
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def ba(self):
+            with self._b:
+                with self._a:
+                    pass
+    '''
+}
+
+
+class TestLockOrder:
+    def test_cycle_fires(self, tmp_path):
+        found = findings_for(write_tree(tmp_path, _CYCLE_SRC), ("lockorder",))
+        cycles = [f for f in found if "cycle" in f.message]
+        assert len(cycles) == 1
+        assert "mod.AB._a" in cycles[0].message and "mod.AB._b" in cycles[0].message
+        assert cycles[0].relpath == "mod.py"
+
+    def test_allowlist_silences_vetted_edge(self, tmp_path):
+        root = write_tree(tmp_path, _CYCLE_SRC)
+        allow = tmp_path / "allow.txt"
+        # removing either direction breaks the 2-cycle
+        allow.write_text("mod.AB._b -> mod.AB._a  # vetted: ba() only runs in tests\n")
+        found = findings_for(root, ("lockorder",), allowlist_path=str(allow))
+        assert [f for f in found if "cycle" in f.message] == []
+
+    def test_nonreentrant_self_reacquire_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class S:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+                '''
+            },
+        )
+        found = findings_for(root, ("lockorder",))
+        assert any("re-acquired while held" in f.message for f in found)
+
+    def test_rlock_self_nesting_is_fine(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class R:
+                    def __init__(self):
+                        self._lock = threading.RLock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+                '''
+            },
+        )
+        assert findings_for(root, ("lockorder",)) == []
+
+
+# ------------------------------------------------------------------- purity
+
+
+class TestPurity:
+    def test_host_call_in_jitted_fn(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/k.py": '''\
+                import time
+
+                import jax
+
+
+                @jax.jit
+                def tick(x):
+                    t = time.monotonic()
+                    return x + t
+                ''',
+            },
+        )
+        found = findings_for(root, ("purity",))
+        assert len(found) == 1
+        assert found[0].line == 8
+        assert "time.monotonic()" in found[0].message
+
+    def test_host_call_reachable_through_helper(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/k.py": '''\
+                import random
+
+                import jax
+
+
+                def helper(x):
+                    return x * random.random()
+
+
+                @jax.jit
+                def entry(x):
+                    return helper(x)
+                ''',
+            },
+        )
+        found = findings_for(root, ("purity",))
+        assert len(found) == 1
+        assert "random.random()" in found[0].message
+        assert found[0].line == 7
+
+    def test_branch_on_traced_param(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/k.py": '''\
+                import jax
+
+
+                @jax.jit
+                def f(x, n):
+                    if n > 3:
+                        return x
+                    return -x
+                ''',
+            },
+        )
+        found = findings_for(root, ("purity",))
+        assert len(found) == 1
+        assert found[0].line == 6
+        assert "Python if on traced parameter(s) n" in found[0].message
+
+    def test_static_argnames_and_structure_checks_exempt(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/k.py": '''\
+                from functools import partial
+
+                import jax
+
+
+                @partial(jax.jit, static_argnames=("n",))
+                def f(x, n, y=None):
+                    if n > 3:            # static arg: fine
+                        return x
+                    if y is None:        # structure check: fine
+                        return x
+                    if x.shape[0] > 2:   # trace-time shape: fine
+                        return x
+                    return -x
+                ''',
+            },
+        )
+        assert findings_for(root, ("purity",)) == []
+
+    def test_shard_map_body_checked(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "parallel/s.py": '''\
+                import threading
+
+                from somewhere import shard_map
+
+
+                def build(mesh):
+                    def _body(a):
+                        threading.Lock()
+                        return a
+
+                    return shard_map(_body, mesh=mesh, in_specs=(), out_specs=())
+                ''',
+            },
+        )
+        found = findings_for(root, ("purity",))
+        assert len(found) == 1
+        assert "threading.Lock()" in found[0].message
+
+
+# ----------------------------------------------------------------- registry
+
+
+_REGISTRY_BASE = {
+    "faults/plan.py": '''\
+    KNOWN_SITES = frozenset({"transport.request", "journal.append"})
+    ''',
+    "metrics.py": '''\
+    METRIC_NAMES = frozenset({"kube_throttler_good_total"})
+    ''',
+}
+
+
+class TestRegistry:
+    def test_unregistered_fault_site(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                **_REGISTRY_BASE,
+                "mod.py": '''\
+                def f(self):
+                    self.faults.check("transport.request")
+                    self.faults.check("transport.typo")
+                ''',
+            },
+        )
+        found = findings_for(root, ("registry",))
+        assert len(found) == 1
+        assert found[0].line == 3
+        assert "transport.typo" in found[0].message
+
+    def test_faultrule_pattern_must_match_some_site(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                **_REGISTRY_BASE,
+                "mod.py": '''\
+                from faults import FaultRule
+
+                ok = FaultRule(site="transport.*")
+                bad = FaultRule(site="watch.*")
+                ''',
+            },
+        )
+        found = findings_for(root, ("registry",))
+        assert len(found) == 1
+        assert found[0].line == 4
+        assert "watch.*" in found[0].message
+
+    def test_undeclared_metric_name(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                **_REGISTRY_BASE,
+                "mod.py": '''\
+                def setup(registry):
+                    registry.gauge_vec("kube_throttler_good_total", "h", ["a"])
+                    registry.counter_vec("kube_throttler_drifted_total", "h", ["a"])
+                ''',
+            },
+        )
+        found = findings_for(root, ("registry",))
+        assert len(found) == 1
+        assert found[0].line == 3
+        assert "kube_throttler_drifted_total" in found[0].message
+
+    def test_missing_registry_declarations_flagged(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "faults/plan.py": "SITES = None\n",
+                "metrics.py": "x = 1\n",
+            },
+        )
+        messages = "\n".join(f.message for f in findings_for(root, ("registry",)))
+        assert "KNOWN_SITES" in messages
+        assert "METRIC_NAMES" in messages
+
+
+# ------------------------------------------------------- baseline mechanics
+
+
+class TestBaseline:
+    def test_waived_findings_do_not_fail(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class Box:
+                    GUARDED_BY = {"_items": "self._lock"}
+
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._items = []
+
+                    def bad(self):
+                        return self._items
+                '''
+            },
+        )
+        found = findings_for(root, ("guarded",))
+        assert len(found) == 1
+        baseline_path = tmp_path / "baseline.txt"
+        baseline_path.write_text(f"{found[0].key()}  # vetted lock-free read\n")
+        baseline = load_baseline(str(baseline_path))
+        new, waived, stale = apply_baseline(found, baseline)
+        assert new == [] and len(waived) == 1 and stale == []
+
+    def test_stale_waivers_reported(self, tmp_path):
+        baseline = {"guarded|gone.py|read of '_x' outside its lock in G.f": "old"}
+        new, waived, stale = apply_baseline([], baseline)
+        assert new == [] and waived == [] and len(stale) == 1
+
+    def test_key_is_line_stable(self, tmp_path):
+        """Shifting a violation by a line must not change its baseline key."""
+
+        body = textwrap.dedent(
+            '''\
+            import threading
+
+
+            class Box:
+                GUARDED_BY = {"_items": "self._lock"}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def bad(self):
+                    return self._items
+            '''
+        )
+
+        def tree(prefix_lines):
+            return {"mod.py": "# pad\n" * prefix_lines + body}
+
+        a = findings_for(write_tree(tmp_path / "a", tree(0)), ("guarded",))
+        b = findings_for(write_tree(tmp_path / "b", tree(3)), ("guarded",))
+        assert a[0].line != b[0].line
+        assert a[0].key() == b[0].key()
+
+
+# ----------------------------------------------------------- CLI / repo gate
+
+
+class TestCli:
+    def test_cli_nonzero_on_seeded_violation(self, tmp_path):
+        root = write_tree(tmp_path, _CYCLE_SRC)
+        empty_baseline = tmp_path / "baseline.txt"
+        empty_baseline.write_text("")
+        rc = analysis_main(
+            ["--root", root, "--baseline", str(empty_baseline), "-q"]
+        )
+        assert rc == 1
+
+    def test_cli_zero_on_clean_tree(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": "x = 1\n"})
+        rc = analysis_main(["--root", root, "--no-baseline", "-q"])
+        assert rc == 0
+
+    def test_repo_is_clean_inprocess(self):
+        """The real package must analyze clean against the checked-in
+        baseline, and every baseline waiver must still be live."""
+        new, waived, stale = run_repo()
+        assert new == [], "\n".join(f.render() for f in new)
+        assert stale == [], f"stale baseline waivers: {stale}"
+
+    def test_repo_gate_subprocess(self):
+        """Tier-1 regression gate: exactly what `make lint` runs."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "kube_throttler_tpu.analysis"],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr

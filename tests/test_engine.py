@@ -266,7 +266,7 @@ class TestSelectorIndex:
 
     @pytest.mark.parametrize("kind", ["throttle", "clusterthrottle"])
     def test_probe_cache_tracks_mutations(self, kind):
-        """match_row_cached must never serve a stale compiled-column
+        """match_row_cached_locked must never serve a stale compiled-column
         evaluation: interleave probe queries (repeating (ns,labels) keys,
         so hits DO occur) with throttle/namespace churn and diff every
         result against the uncached evaluation."""
@@ -284,8 +284,8 @@ class TestSelectorIndex:
                 labels=rng.choice(labels_pool),
             )
             with index._lock:
-                got = index.match_row_cached(pod).copy()
-                want = index._match_row_arbitrary(pod)
+                got = index.match_row_cached_locked(pod).copy()
+                want = index._match_row_arbitrary_locked(pod)
             np.testing.assert_array_equal(got, want)
 
         mk_throttle = (

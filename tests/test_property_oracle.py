@@ -13,6 +13,11 @@ variants.
 from __future__ import annotations
 
 import numpy as np
+import pytest
+
+# requirements-ci.txt lists hypothesis, but ad-hoc dev environments may
+# lack it — skip at collection instead of erroring the whole session
+pytest.importorskip("hypothesis")
 from hypothesis import assume, given, settings, strategies as st
 
 from kube_throttler_tpu import quantity as qt
