@@ -131,7 +131,35 @@ def main(argv: Optional[list] = None) -> int:
         help="standalone durability: journal every watch event to "
         "<dir>/store.journal and replay it on startup, so specs AND written "
         "statuses survive a restart (ignored with --kubeconfig, where the "
-        "apiserver is the state of record and reflectors rebuild the cache)",
+        "apiserver is the state of record and reflectors rebuild the cache). "
+        "Startup runs the crash-recovery pipeline (newest valid snapshot + "
+        "journal tail, engine/recovery.py) and shutdown writes a final "
+        "snapshot",
+    )
+    serve.add_argument(
+        "--snapshot-every",
+        type=int,
+        default=5000,
+        help="with --data-dir: cut a full state snapshot every N journaled "
+        "events (atomic, checksummed; recovery replays only the journal "
+        "tail past it); 0 disables the journal-size trigger (shutdown "
+        "snapshots still happen)",
+    )
+    serve.add_argument(
+        "--snapshot-keep",
+        type=int,
+        default=3,
+        help="with --data-dir: retain the newest N snapshots (older ones "
+        "are checksum-verified fallbacks when the newest is torn)",
+    )
+    serve.add_argument(
+        "--reservation-ttl",
+        default="",
+        help="expire scheduler-cycle reservations after this Go-style "
+        'duration (e.g. "5m"): a scheduler that dies between Reserve and '
+        "Bind stops pinning capacity; crash recovery rebases remaining "
+        "TTLs. Empty = reservations live until observed/unreserved "
+        "(reference semantics)",
     )
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
     serve.add_argument(
@@ -204,6 +232,8 @@ def main(argv: Optional[list] = None) -> int:
         config["controllerThrediness"] = args.controller_threadiness
     if args.num_key_mutex:
         config["numKeyMutex"] = args.num_key_mutex
+    if args.reservation_ttl:
+        config["reservationTTL"] = args.reservation_ttl
 
     try:
         plugin_args = decode_plugin_args(config)
@@ -327,6 +357,8 @@ def main(argv: Optional[list] = None) -> int:
     store = Store()
     session = None
     journal = None
+    recovery = None
+    snapshotter = None
     from .metrics import Registry
 
     metrics_registry = Registry()  # shared: reflector metrics + the 16 families
@@ -348,16 +380,29 @@ def main(argv: Optional[list] = None) -> int:
         session.start()  # blocks until every reflector listed once
     else:
         if args.data_dir:
-            from .engine.journal import attach as attach_journal
+            from .engine.recovery import RecoveryManager
+            from .engine.snapshot import SnapshotManager
 
             os.makedirs(args.data_dir, exist_ok=True)
-            journal_path = os.path.join(args.data_dir, "store.journal")
-            # attach BEFORE the plugin registers handlers: replay fills the
-            # store silently; the plugin's cache-sync replay then delivers
-            # the recovered objects to the device mirror and controllers
-            journal = attach_journal(store, journal_path)
-            print(f"journal: {journal_path} ({len(store.list_pods())} pods, "
-                  f"{len(store.list_throttles())} throttles recovered)", flush=True)
+            # recovery runs BEFORE the plugin registers handlers: snapshot
+            # restore + journal tail replay fill the store silently; the
+            # plugin's cache-sync replay then delivers the recovered
+            # objects to the device mirror and controllers
+            recovery = RecoveryManager(args.data_dir)
+            journal = recovery.recover_store(store)
+            snapshotter = SnapshotManager(
+                args.data_dir, store, keep=args.snapshot_keep
+            )
+            r = recovery.report
+            print(
+                f"recovery: mode={r.journal_mode} "
+                f"snapshot={r.snapshot_seq if r.snapshot_seq is not None else '-'} "
+                f"({r.snapshot_objects} objects) + {r.journal_lines_replayed} "
+                f"journal events in {r.duration_s:.3f}s "
+                f"({len(store.list_pods())} pods, "
+                f"{len(store.list_throttles())} throttles recovered)",
+                flush=True,
+            )
         if store.get_namespace("default") is None:
             store.create_namespace(Namespace("default"))
     plugin = KubeThrottler(
@@ -401,6 +446,45 @@ def main(argv: Optional[list] = None) -> int:
         session.register_health(plugin.health)
     if journal is not None:
         plugin.health.register("journal", journal.health_state)
+    if recovery is not None:
+        # the rest of the crash-safety wiring needs the plugin: reservation
+        # ledgers live on the controllers, and the first-relist reconcile
+        # compares the rebuilt device planes against the informer caches
+        reservation_caches = {
+            "throttle": plugin.throttle_ctr.cache,
+            "clusterthrottle": plugin.cluster_throttle_ctr.cache,
+        }
+        recovery.restore_reservations(
+            reservation_caches,
+            on_change=(
+                (lambda kind, key: plugin.device_manager.on_reservation_change(
+                    kind, key, reservation_caches[kind]
+                ))
+                if plugin.device_manager is not None
+                else None
+            ),
+        )
+        diverged = recovery.reconcile(
+            plugin.informers,
+            device_manager=plugin.device_manager,
+            enqueue={
+                "throttle": plugin.throttle_ctr.enqueue,
+                "clusterthrottle": plugin.cluster_throttle_ctr.enqueue,
+            },
+        )
+        if diverged:
+            print(
+                f"recovery: {diverged} plane divergence(s) re-enqueued for "
+                "repair", flush=True,
+            )
+        snapshotter.reservations = reservation_caches
+        snapshotter.device_manager = plugin.device_manager
+        snapshotter.bind_journal(journal, every_lines=args.snapshot_every)
+        plugin.health.register("recovery", recovery.health_state)
+        plugin.health.register("snapshot", snapshotter.health_state)
+        from .metrics import register_recovery_metrics
+
+        register_recovery_metrics(metrics_registry, snapshotter, recovery)
     scheduler = None
     if args.nodes > 0:
         from .scheduler import Node, Scheduler
@@ -454,18 +538,32 @@ def main(argv: Optional[list] = None) -> int:
     )
 
     stop.wait()
+    # graceful shutdown (docs/robustness.md "Crash safety & recovery"):
+    # 1. flip /readyz to down so probes stop routing traffic here, then
+    #    stop the intake surfaces (HTTP daemon, wire apiserver, scheduler);
+    # 2. drain the controllers and flush the two-lane status committer's
+    #    queued flips — a flip left queued is an admission-relevant status
+    #    the cluster never saw;
+    # 3. fsync the journal and write a final snapshot, so the next start
+    #    recovers via the fast tail path with zero replay.
+    server.mark_draining()
     if gc_hygiene is not None:
         gc_hygiene.stop()
-    server.stop()
     if wire is not None:
         wire.stop()
     if scheduler is not None:
         scheduler.stop()
     if session is not None:
+        committer = getattr(session, "status_committer", None)
+        if committer is not None:
+            committer.flush()
         session.stop()
     plugin.stop()
+    if snapshotter is not None:
+        snapshotter.write(reason="shutdown")
     if journal is not None:
-        journal.close()
+        journal.close()  # flush + fsync
+    server.stop()
     if elector is not None:
         elector.release()
     return 0

@@ -96,6 +96,12 @@ class Indexer:
         with self._lock:
             return list(self._objects.keys())
 
+    def snapshot(self) -> Dict[str, object]:
+        """Keyed copy of the cache under one lock hold (recovery's
+        first-relist reconcile walks this rather than the raw store)."""
+        with self._lock:
+            return dict(self._objects)
+
     def by_index(self, index_name: str, value: str) -> List[object]:
         with self._lock:
             keys = self._indices[index_name].get(value, set())
@@ -170,6 +176,13 @@ class SharedIndexInformer:
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
+
+    def snapshot_objects(self) -> Dict[str, object]:
+        """The informer cache as ``{key: object}`` — the "first relist"
+        view recovery reconciles recovered state against (engine/recovery
+        reads through the informer, not the raw store, so informer-mirror
+        drift is part of what the divergence counter would catch)."""
+        return self.indexer.snapshot()
 
     def run(self, stop: threading.Event) -> None:
         """Start the resync loop (no-op when resync_period == 0)."""

@@ -58,6 +58,7 @@ class ClusterThrottleController(ControllerBase):
         listers=None,
         informers=None,
         status_writer=None,
+        reservation_ttl=None,
     ):
         """See ThrottleController.__init__ for the listers / informers /
         status_writer contract (plugin.go:76-88 composition)."""
@@ -74,7 +75,11 @@ class ClusterThrottleController(ControllerBase):
         self.listers = listers
         self.informers = informers
         self.status_writer = status_writer if status_writer is not None else store
-        self.cache = ReservedResourceAmounts(num_key_mutex)
+        # reservation ledger shares the controller clock so TTL expiry is
+        # deterministic under FakeClock tests and rebases correctly on
+        # crash recovery (engine/recovery.py)
+        self.cache = ReservedResourceAmounts(num_key_mutex, clock=self.clock)
+        self.reservation_ttl = reservation_ttl
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
@@ -315,7 +320,7 @@ class ClusterThrottleController(ControllerBase):
             self.reserve_on_throttle(pod, thr)
 
     def reserve_on_throttle(self, pod: Pod, thr: ClusterThrottle) -> bool:
-        added = self.cache.add_pod(thr.key, pod)
+        added = self.cache.add_pod(thr.key, pod, ttl=self.reservation_ttl)
         if added and self.device_manager is not None:
             self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
         return added

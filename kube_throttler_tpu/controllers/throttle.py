@@ -52,6 +52,7 @@ class ThrottleController(ControllerBase):
         listers=None,
         informers=None,
         status_writer=None,
+        reservation_ttl=None,
     ):
         """``listers`` (client.listers.Listers) routes every read through the
         indexer-backed lister layer and ``informers`` (SharedInformerFactory)
@@ -74,7 +75,11 @@ class ThrottleController(ControllerBase):
         self.listers = listers
         self.informers = informers
         self.status_writer = status_writer if status_writer is not None else store
-        self.cache = ReservedResourceAmounts(num_key_mutex)
+        # reservation ledger shares the controller clock so TTL expiry is
+        # deterministic under FakeClock tests and rebases correctly on
+        # crash recovery (engine/recovery.py)
+        self.cache = ReservedResourceAmounts(num_key_mutex, clock=self.clock)
+        self.reservation_ttl = reservation_ttl
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
@@ -299,7 +304,7 @@ class ThrottleController(ControllerBase):
             self.reserve_on_throttle(pod, thr)
 
     def reserve_on_throttle(self, pod: Pod, thr: Throttle) -> bool:
-        added = self.cache.add_pod(thr.key, pod)
+        added = self.cache.add_pod(thr.key, pod, ttl=self.reservation_ttl)
         if added and self.device_manager is not None:
             self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
         return added

@@ -1568,6 +1568,36 @@ class DeviceStateManager:
     def _kind(self, kind: str) -> _KindState:
         return self.throttle if kind == "throttle" else self.clusterthrottle
 
+    def published_flags(self) -> Dict[str, Dict[str, dict]]:
+        """Per-key decode of the published ``st_*`` planes: ``{kind:
+        {throttle_key: {"pod": bool, "requests": {resource: bool}}}}`` —
+        the last PUBLISHED throttled flags each live column carries.
+        Snapshots record this (engine/snapshot.py) and recovery compares
+        the rebuilt planes against the restored statuses with it
+        (engine/recovery.py's divergence oracle).
+
+        Reads the planes lock-free like flip_candidate_cols: call under
+        the store lock (the snapshot path does — every plane writer is a
+        store handler) or with ingest quiescent (the recovery path)."""
+        names = self.dims.names
+        out: Dict[str, Dict[str, dict]] = {}
+        for kind in ("throttle", "clusterthrottle"):
+            ks = self._kind(kind)
+            per_key: Dict[str, dict] = {}
+            cnt = ks.st_cnt_throttled
+            pres, req = ks.st_req_flag_present, ks.st_req_throttled
+            r = min(len(names), pres.shape[1])
+            for key, col in ks.index.throttle_cols_snapshot().items():
+                if col is None or col >= cnt.shape[0]:  # pragma: no cover — racing growth
+                    continue
+                requests = {
+                    names[j]: bool(req[col, j])
+                    for j in np.nonzero(pres[col, :r])[0]
+                }
+                per_key[key] = {"pod": bool(cnt[col]), "requests": requests}
+            out[kind] = per_key
+        return out
+
     # -- index-backed collection queries (replace the O(T)/O(P) store scans
     # of throttle_controller.go:221-269) ----------------------------------
 

@@ -694,6 +694,12 @@ class SelectorIndex:
         with self._lock:
             return self._thr_cols.get(throttle_key)
 
+    def throttle_cols_snapshot(self) -> Dict[str, int]:
+        """One-lock-hold copy of the live throttle-key → column map (the
+        snapshot/recovery plane walk iterates it outside the lock)."""
+        with self._lock:
+            return dict(self._thr_cols)
+
     @property
     def capacities(self) -> Tuple[int, int]:
         with self._lock:

@@ -11,23 +11,42 @@ every watch event as a JSON line and replays it on startup, making
 
 Format: one ``{"type": ..., "kind": ..., "object": {...}}`` per line —
 deliberately the watch wire-event shape (client/transport.py), so the
-journal doubles as a replayable watch stream. A truncated trailing line
-(crash mid-write) is tolerated and truncated away; a corrupted INTERIOR
-line (torn write followed by later appends, bit rot) is skipped and
-counted — startup must not abort on one bad line when every event after it
-is intact. When the live log exceeds ``compact_after`` lines it is
-compacted to a snapshot of ADDED events (written to a temp file, atomically
-renamed); a failed compaction (fsync/rename error) is logged and retried a
-window later — it never breaks the store's dispatch.
+journal doubles as a replayable watch stream. Corruption handling on
+replay distinguishes two cases:
+
+- a torn FINAL line (crash between ``write()`` and the newline) is the
+  legal crash artifact — it is silently truncated away and counted in
+  ``torn_tails`` without degrading health;
+- a corrupted INTERIOR line (torn write followed by later appends, bit
+  rot) — and any extra bad lines in a trailing corrupt run beyond the
+  final one — is skipped and counted in ``replay_skipped``: startup must
+  not abort on one bad line when every event after it is intact, and the
+  health probe surfaces the loss.
+
+When the live log exceeds ``compact_after`` lines it is compacted to a
+snapshot of ADDED events (written to a temp file, atomically renamed); a
+failed compaction (fsync/rename error) is logged and retried a window
+later — it never breaks the store's dispatch.
+
+The journal also maintains a running ``(byte offset, sha256)`` of its
+content, exposed via :meth:`position`. Snapshots (engine/snapshot.py)
+record that pair at cut time; recovery (engine/recovery.py) verifies the
+prefix hash to decide whether the on-disk journal is a strict superset of
+the snapshot (replay only the tail from ``start_offset``) or has been
+compacted since (replay from genesis instead). ``set_snapshotter`` arms a
+journal-size snapshot trigger fired every ``snapshot_every`` appended
+lines (outside the journal lock, inside the store's dispatch).
 
 Fault injection (faults/plan.py): site ``journal.append`` supports mode
 ``torn`` (write half the line, no newline — the next append turns it into
 interior corruption) and ``error`` (drop the write); site ``journal.fsync``
-fails the compaction fsync.
+fails the compaction fsync. The ``crash.journal.*`` sites SIGKILL the
+process at the worst instants (see the crash harness, tools/crashtest.py).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -35,6 +54,7 @@ import tempfile
 from typing import Optional, Tuple
 
 from ..api.serialization import object_from_dict, object_to_dict
+from ..faults.plan import maybe_crash
 from ..utils.lockorder import assert_held, guard_attrs, make_lock
 from .store import Event, EventType, Store
 
@@ -44,16 +64,43 @@ logger = logging.getLogger(__name__)
 _KIND_ORDER = {"Namespace": 0, "Throttle": 1, "ClusterThrottle": 1, "Pod": 2}
 
 
+def hash_prefix(path: str, length: int):
+    """sha256 object over the first ``length`` bytes of ``path``, or None
+    when the file is missing or shorter than ``length`` (the prefix a
+    snapshot recorded no longer exists as-is). Recovery compares its
+    hexdigest against the snapshot's recorded journal hash, and on a match
+    seeds :func:`attach`'s ``resume_hash`` with the returned object so the
+    running hash stays continuous across the tail replay."""
+    if length < 0 or not os.path.exists(path):
+        return None
+    h = hashlib.sha256()
+    remaining = length
+    with open(path, "rb") as f:
+        while remaining > 0:
+            chunk = f.read(min(1 << 20, remaining))
+            if not chunk:
+                return None  # file shorter than the recorded offset
+            h.update(chunk)
+            remaining -= len(chunk)
+    return h
+
+
 @guard_attrs
 class StoreJournal:
     """Attach with :func:`attach`; detach via :meth:`close`."""
 
-    # the live-append file handle and its line counter move only under the
-    # journal lock (the robustness counters are single-writer ints read by
-    # health probes — unguarded on purpose)
+    # the live-append file handle, its line counter, and the running
+    # content position move only under the journal lock (the robustness
+    # counters are single-writer ints read by health probes — unguarded on
+    # purpose)
     GUARDED_BY = {
         "_file": "self._lock",
         "_lines": "self._lock",
+        "_bytes": "self._lock",
+        "_sha": "self._lock",
+        "_snapshotter": "self._lock",
+        "snapshot_every": "self._lock",
+        "_lines_since_snapshot": "self._lock",
     }
 
     def __init__(
@@ -66,38 +113,65 @@ class StoreJournal:
         self._lock = make_lock("journal")
         self._lines = 0
         self._file = None
+        # running position of the journal content: byte length + sha256 of
+        # everything up to it (seeded by attach() from the replay)
+        self._bytes = 0
+        self._sha = hashlib.sha256()
+        # journal-size snapshot trigger (engine/snapshot.py binds these)
+        self._snapshotter = None
+        self.snapshot_every = 0
+        self._lines_since_snapshot = 0
         # robustness counters (health probe + tests read these)
         self.replay_skipped = 0  # corrupted interior lines skipped on replay
+        self.torn_tails = 0  # torn final lines truncated (normal crash artifact)
         self.write_errors = 0  # appends dropped (injected/real write failure)
         self.torn_writes = 0  # injected torn appends
         self.compact_failures = 0  # compactions aborted (old log kept)
+        self.replayed_events = 0  # events applied by the last replay
 
     # -- replay -------------------------------------------------------------
 
-    def _replay(self) -> Tuple[int, Optional[int]]:
-        """Apply journaled events to the (empty) store. Returns
-        ``(applied, truncate_at)``: the event count, and — when the file
-        ends in a run of corrupt lines (crash mid-write) — the byte offset
-        of the end of the last GOOD line. The caller MUST truncate there
-        before appending: appending past a corrupt tail would strand every
-        later event behind the gap on all future replays (silent loss of
+    def _replay(
+        self, start_offset: int = 0, resume_hash=None
+    ) -> Tuple[int, Optional[int], int, "hashlib._Hash"]:
+        """Apply journaled events (from ``start_offset``) to the store.
+        Returns ``(applied, truncate_at, end_bytes, end_sha)``: the event
+        count, the byte offset to truncate at when the file ends in a
+        corrupt run (else None), and the byte length + sha256 of the
+        journal content that remains valid. The caller MUST truncate before
+        appending: appending past a corrupt tail would strand every later
+        event behind the gap on all future replays (silent loss of
         post-crash history).
 
-        Corrupt INTERIOR lines — bad lines with good lines after them (a
-        torn write the process survived, bit rot) — are skipped and counted
-        in ``replay_skipped``, each logged with its line number. Aborting
-        on them would trade one lost event for the whole post-gap history;
-        replay applies everything that parses and lets the counter/health
-        probe surface the gap."""
+        Corruption classification:
+
+        - a bad line with ANY write after it — a later line, or even just
+          its own terminating newline — cannot be the crash-mid-write
+          artifact: it is real corruption, skipped and counted in
+          ``replay_skipped`` with its line number. Aborting would trade
+          one lost event for the whole post-gap history, so it stays in
+          the file (and is re-counted on every replay until a compaction
+          heals the log).
+        - only a FINAL line with no terminating newline is the legal
+          crash-mid-write artifact: truncated silently, counted in
+          ``torn_tails`` (health stays ok)."""
+        h = resume_hash.copy() if resume_hash is not None else hashlib.sha256()
         if not os.path.exists(self.path):
-            return 0, None
+            return 0, None, start_offset, h
         applied = 0
-        offset = 0  # byte offset after the current line
-        good_end = 0  # byte offset after the last good line
+        offset = start_offset  # byte offset after the current line
+        last_line_start = start_offset
+        h_before_last = h.copy()  # content hash up to last_line_start
+        last_newline = True
         bad_run: list = []  # (lineno, error) since the last good line
         with open(self.path, "rb") as f:
+            f.seek(start_offset)
             for lineno, raw in enumerate(f, 1):
+                last_line_start = offset
+                h_before_last = h.copy()
                 offset += len(raw)
+                h.update(raw)
+                last_newline = raw.endswith(b"\n")
                 line = raw.strip()
                 if not line:
                     continue  # blank line: harmless, neither good nor bad
@@ -123,16 +197,34 @@ class StoreJournal:
                         self.path, bad_lineno, err,
                     )
                 bad_run = []
-                good_end = offset
-        if bad_run:
-            # trailing corrupt run (crash mid-write): truncate it away
-            logger.warning(
-                "journal %s: dropping %d corrupt trailing line(s) from "
-                "line %d (%s); truncating",
-                self.path, len(bad_run), bad_run[0][0], bad_run[0][1],
+        if bad_run and not last_newline:
+            # the torn final line (no newline = the write never finished):
+            # truncate it alone, silently. Bad lines ahead of it in the
+            # run are newline-terminated — genuine corruption, counted,
+            # and left in place like interior corruption.
+            for bad_lineno, err in bad_run[:-1]:
+                self.replay_skipped += 1
+                logger.warning(
+                    "journal %s: skipping corrupted line %d (%s)",
+                    self.path, bad_lineno, err,
+                )
+            self.torn_tails += 1
+            logger.debug(
+                "journal %s: truncating torn final line %d (%s) — normal "
+                "crash artifact",
+                self.path, bad_run[-1][0], bad_run[-1][1],
             )
-            return applied, good_end
-        return applied, None
+            return applied, last_line_start, last_line_start, h_before_last
+        for bad_lineno, err in bad_run:
+            # trailing but newline-terminated: a write landed after the
+            # corruption, so this is interior-class corruption that merely
+            # has no good line after it YET
+            self.replay_skipped += 1
+            logger.warning(
+                "journal %s: skipping corrupted line %d (%s)",
+                self.path, bad_lineno, err,
+            )
+        return applied, None, offset, h
 
     def _apply(self, event: dict) -> None:
         kind = event["kind"]
@@ -184,9 +276,26 @@ class StoreJournal:
             }
         )
         fault = self.faults.check("journal.append") if self.faults is not None else None
+        # crash points OUTSIDE the lock (SIGKILL never returns, but keeping
+        # lock holds minimal keeps the site placement honest): before the
+        # line hits the file at all, and the torn-then-die artifact
+        maybe_crash(self.faults, "crash.journal.append")
+        crash_torn = (
+            self.faults.check("crash.journal.torn")
+            if self.faults is not None
+            else None
+        )
+        snapshotter = None
         with self._lock:
             if self._file is None:
                 return
+            if crash_torn is not None and crash_torn.mode == "kill":
+                # the canonical crash-mid-write artifact: half the line,
+                # no newline, then the process dies. Recovery must treat
+                # this as a normal torn tail (truncate, stay healthy).
+                self._file.write(line[: max(1, len(line) // 2)])
+                self._file.flush()
+                crash_torn.kill()
             if fault is not None and fault.mode == "error":
                 # simulated failed write: the event never reaches the log
                 # (the gap is what replay-convergence soaks must tolerate)
@@ -197,13 +306,19 @@ class StoreJournal:
                 # onto the fragment, producing one corrupt interior line —
                 # the exact artifact a crash between write() and the
                 # newline leaves behind
-                self._file.write(line[: max(1, len(line) // 2)])
+                frag = line[: max(1, len(line) // 2)].encode("utf-8")
+                self._file.write(frag.decode("utf-8"))
                 self._file.flush()
+                self._sha.update(frag)
+                self._bytes += len(frag)
                 self.torn_writes += 1
                 self._lines += 1
                 return
+            data = (line + "\n").encode("utf-8")
             self._file.write(line + "\n")
             self._file.flush()
+            self._sha.update(data)
+            self._bytes += len(data)
             self._lines += 1
             if self._lines >= self.compact_after:
                 try:
@@ -219,6 +334,17 @@ class StoreJournal:
                         "uncompacted log and retrying later",
                         self.path, exc_info=True,
                     )
+            if self._snapshotter is not None and self.snapshot_every > 0:
+                self._lines_since_snapshot += 1
+                if self._lines_since_snapshot >= self.snapshot_every:
+                    self._lines_since_snapshot = 0
+                    snapshotter = self._snapshotter
+        if snapshotter is not None:
+            # journal-size snapshot trigger, OUTSIDE the journal lock (the
+            # snapshot writer re-reads the journal position itself). We are
+            # still inside the store's dispatch, so the store lock is held
+            # (reentrant) and the cut is consistent with the event stream.
+            snapshotter.snapshot_on_journal_trigger()
 
     def _compact_locked(self) -> None:
         """Rewrite the journal as a snapshot of the CURRENT store contents
@@ -237,15 +363,20 @@ class StoreJournal:
         fd, tmp = tempfile.mkstemp(
             dir=os.path.dirname(self.path) or ".", suffix=".journal"
         )
+        new_sha = hashlib.sha256()
+        new_bytes = 0
         try:
             with os.fdopen(fd, "w", encoding="utf-8") as f:
                 for kind, obj in objs:
-                    f.write(
+                    data = (
                         json.dumps(
                             {"type": "ADDED", "kind": kind, "object": object_to_dict(obj)}
                         )
                         + "\n"
-                    )
+                    ).encode("utf-8")
+                    f.write(data.decode("utf-8"))
+                    new_sha.update(data)
+                    new_bytes += len(data)
                 f.flush()
                 if self.faults is not None:
                     self.faults.maybe_raise(
@@ -260,9 +391,14 @@ class StoreJournal:
             except OSError:
                 pass
             raise
+        # the instant a crash invalidates every snapshot's recorded journal
+        # offset (recovery must fall back to genesis replay of this file)
+        maybe_crash(self.faults, "crash.journal.compact")
         self._file.close()
         self._file = open(self.path, "a", encoding="utf-8")
         self._lines = len(objs)
+        self._sha = new_sha
+        self._bytes = new_bytes
         logger.info("journal %s compacted to %d objects", self.path, len(objs))
 
     def compact(self) -> None:
@@ -281,12 +417,32 @@ class StoreJournal:
                 if self._file is not None:
                     self._compact_locked()
 
+    # -- position / snapshot trigger ---------------------------------------
+
+    def position(self) -> Tuple[int, str]:
+        """``(byte offset, sha256 hexdigest)`` of the journal content up to
+        now — the tail-replay anchor a snapshot records at cut time."""
+        with self._lock:
+            return self._bytes, self._sha.hexdigest()
+
+    def set_snapshotter(self, snapshotter, every_lines: int) -> None:
+        """Arm the journal-size snapshot trigger: every ``every_lines``
+        appended lines, ``snapshotter.snapshot_on_journal_trigger()`` runs
+        (outside the journal lock, inside the store's dispatch)."""
+        with self._lock:
+            self._snapshotter = snapshotter
+            self.snapshot_every = int(every_lines)
+            self._lines_since_snapshot = 0
+
     def health_state(self) -> Tuple[str, dict]:
         """Health-component contract (health.py): degraded while any
         corruption/write-loss counter is nonzero — the journal still works,
-        but an operator should know recovery was lossy."""
+        but an operator should know recovery was lossy. A truncated torn
+        FINAL line (``torn_tails``) is the normal crash artifact and does
+        NOT degrade; it is surfaced in the detail only."""
         detail = {
             "replaySkipped": self.replay_skipped,
+            "tornTails": self.torn_tails,
             "writeErrors": self.write_errors,
             "compactFailures": self.compact_failures,
         }
@@ -299,18 +455,38 @@ class StoreJournal:
         with self._lock:
             if self._file is not None:
                 self._file.flush()
+                try:
+                    # graceful shutdown fsyncs the log: a clean SIGTERM exit
+                    # must leave nothing in OS buffers a power cut could eat
+                    os.fsync(self._file.fileno())
+                except OSError:  # pragma: no cover — fsync of a closed fd race
+                    pass
                 self._file.close()
                 self._file = None
 
 
 def attach(
-    store: Store, path: str, compact_after: int = 100_000, faults=None
+    store: Store,
+    path: str,
+    compact_after: int = 100_000,
+    faults=None,
+    start_offset: int = 0,
+    resume_hash=None,
 ) -> StoreJournal:
-    """Replay ``path`` into the (freshly constructed, empty) store, then
-    journal every subsequent event to it. Must run BEFORE other handlers
-    are registered so replayed events don't double-dispatch."""
+    """Replay ``path`` into the store, then journal every subsequent event
+    to it. Must run BEFORE other handlers are registered so replayed events
+    don't double-dispatch.
+
+    ``start_offset``/``resume_hash`` are the tail-replay form recovery uses
+    after restoring a snapshot: replay only the bytes past ``start_offset``
+    (the caller has verified, via :func:`hash_prefix`, that the prefix
+    matches the snapshot's recorded hash, and hands the prefix hash object
+    over so the running content hash stays continuous)."""
     journal = StoreJournal(store, path, compact_after=compact_after, faults=faults)
-    n, truncate_at = journal._replay()
+    n, truncate_at, end_bytes, end_sha = journal._replay(
+        start_offset=start_offset, resume_hash=resume_hash
+    )
+    journal.replayed_events = n
     if n:
         logger.info(
             "journal %s: replayed %d events (%d corrupted line(s) skipped)",
@@ -319,11 +495,25 @@ def attach(
     if truncate_at is not None:
         with open(path, "r+b") as f:
             f.truncate(truncate_at)
-    # under the lock although pre-publication: _file/_lines are declared
-    # guarded, and the runtime guard (KT_LOCK_ASSERT=1) checks rebinds
+    elif end_bytes > start_offset and os.path.exists(path):
+        # a final line that PARSED but lacks its newline (crash after the
+        # payload byte, before the terminator): keep the event, repair the
+        # terminator — otherwise the next append would concatenate onto it
+        # and corrupt both
+        with open(path, "rb") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) != b"\n":
+                with open(path, "ab") as g:
+                    g.write(b"\n")
+                end_sha.update(b"\n")
+                end_bytes += 1
+    # under the lock although pre-publication: these are declared guarded,
+    # and the runtime guard (KT_LOCK_ASSERT=1) checks rebinds
     with journal._lock:
         journal._file = open(path, "a", encoding="utf-8")
         journal._lines = n
+        journal._bytes = end_bytes
+        journal._sha = end_sha
     for kind in Store.KINDS:
         store.add_event_handler(kind, journal._on_event, replay=False)
     return journal

@@ -352,6 +352,15 @@ class Store:
         with self._lock:
             return self._versions[kind][key]
 
+    def advance_resource_version_to(self, rv: int) -> None:
+        """Raise the global resourceVersion floor (never lowers it).
+        Snapshot restore uses this so recovered state keeps pre-crash RV
+        continuity — post-recovery writes must never reuse a version an
+        old client already observed."""
+        with self._lock:
+            if rv > self._rv:
+                self._rv = int(rv)
+
     @property
     def latest_resource_version(self) -> int:
         """The highest resourceVersion assigned so far (the list RV a

@@ -42,6 +42,18 @@ journal.append              mode "torn" writes half the line (interior
                             the write
 journal.fsync               OSError during compaction fsync
 device.dispatch             dispatch raises (opens the circuit breaker)
+crash.journal.append        SIGKILL before the event's journal line is
+                            written (event reached the store, not the log)
+crash.journal.torn          half the line is written+flushed, then SIGKILL
+                            (the canonical torn-final-line crash artifact)
+crash.journal.compact       SIGKILL right after the compacted log replaces
+                            the live one (snapshot journal offsets stale)
+crash.snapshot.begin        SIGKILL before a snapshot write starts
+crash.snapshot.tmp_partial  SIGKILL with half the snapshot tmp file flushed
+crash.snapshot.pre_rename   SIGKILL after tmp fsync, before the atomic
+                            rename (orphan tmp left behind)
+crash.snapshot.post_rename  SIGKILL after the rename, before pruning
+crash.snapshot.prune        SIGKILL mid-prune of superseded snapshots
 mock.list                   mockserver LIST answers 500 ("error"), 410
                             ("gone"), or stalls ("delay")
 mock.watch.cut              mockserver cuts the watch stream mid-flight
@@ -49,12 +61,20 @@ mock.watch.gone             mockserver emits a 410 ERROR event mid-stream
 mock.status.conflict        mockserver 409s a status PUT
 mock.status.error           mockserver 500s a status PUT
 ==========================  ==================================================
+
+The ``crash.*`` family is the SIGKILL crash-point harness
+(tools/crashtest.py): a rule with mode ``"kill"`` makes the process die by
+uncatchable SIGKILL at that exact instant — no atexit, no flush, no
+``finally`` — so recovery (engine/recovery.py) is exercised against the
+worst on-disk artifacts each instant can leave behind.
 """
 
 from __future__ import annotations
 
 import fnmatch
 import hashlib
+import os
+import signal
 import threading
 import time
 from dataclasses import dataclass, field
@@ -79,6 +99,14 @@ KNOWN_SITES = frozenset(
         "journal.append",
         "journal.fsync",
         "device.dispatch",
+        "crash.journal.append",
+        "crash.journal.torn",
+        "crash.journal.compact",
+        "crash.snapshot.begin",
+        "crash.snapshot.tmp_partial",
+        "crash.snapshot.pre_rename",
+        "crash.snapshot.post_rename",
+        "crash.snapshot.prune",
         "mock.list",
         "mock.watch.cut",
         "mock.watch.gone",
@@ -112,6 +140,12 @@ class FiredFault:
     def sleep(self) -> None:
         if self.delay > 0:
             time.sleep(self.delay)
+
+    def kill(self) -> None:
+        """Die by SIGKILL right here — uncatchable, no cleanup handlers, no
+        buffered-file flushes. The crash harness's seeded worst-instant
+        process death (mode ``"kill"`` at a ``crash.*`` site)."""
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 @dataclass
@@ -277,3 +311,17 @@ class FaultPlan:
             self._hits.clear()
             self._fired.clear()
             self.history.clear()
+
+
+def maybe_crash(plan: Optional[FaultPlan], site: str) -> None:
+    """Crash-point hook: count a hit at ``site`` and, if a rule with mode
+    ``"kill"`` fires, SIGKILL the process on the spot. Instrumented code
+    sprinkles these at the instants whose on-disk artifacts recovery must
+    survive (mid-snapshot rename, between journal append and fsync, ...).
+    Production passes ``plan=None`` — a single ``is None`` branch."""
+    if plan is None:
+        return
+    fault = plan.check(site)
+    if fault is not None and fault.mode == "kill":
+        fault.sleep()
+        fault.kill()

@@ -78,6 +78,14 @@ METRIC_NAMES = frozenset(
         "kube_throttler_device_fallback_total",
         # phase-latency tracing histogram (utils/tracing.py)
         "kube_throttler_phase_duration_seconds",
+        # crash-safety layer (register_recovery_metrics): snapshot cadence
+        # + the last recovery's shape (engine/snapshot.py, engine/recovery.py)
+        "kube_throttler_snapshot_total",
+        "kube_throttler_snapshot_failures_total",
+        "kube_throttler_snapshot_age_seconds",
+        "kube_throttler_recovery_duration_seconds",
+        "kube_throttler_recovery_journal_lines_replayed",
+        "kube_throttler_recovery_divergence_total",
     }
 )
 
@@ -483,6 +491,60 @@ def register_breaker_metrics(registry: Registry, device_manager) -> GaugeVec:
         )
     )
     return gauge
+
+
+def register_recovery_metrics(
+    registry: Registry, snapshot_manager=None, recovery_manager=None
+) -> None:
+    """Crash-safety observability: snapshot cadence (age/written/failed)
+    from the SnapshotManager and the last recovery's duration, replayed
+    journal lines, and plane divergences from the RecoveryManager. All fed
+    at scrape time from the managers' single-writer stats — the snapshot
+    and recovery paths never touch a metric family on their own."""
+    snap_total = registry.counter_vec(
+        "kube_throttler_snapshot_total", "snapshots written by this process", []
+    )
+    snap_failed = registry.counter_vec(
+        "kube_throttler_snapshot_failures_total",
+        "snapshot writes that failed (journal remains the recovery source)",
+        [],
+    )
+    snap_age = registry.gauge_vec(
+        "kube_throttler_snapshot_age_seconds",
+        "seconds since the last snapshot written by this process "
+        "(-1 before the first one)",
+        [],
+    )
+    rec_duration = registry.gauge_vec(
+        "kube_throttler_recovery_duration_seconds",
+        "wall time of the startup recovery (snapshot restore + journal replay)",
+        [],
+    )
+    rec_lines = registry.gauge_vec(
+        "kube_throttler_recovery_journal_lines_replayed",
+        "journal events replayed by the startup recovery",
+        [],
+    )
+    rec_divergence = registry.counter_vec(
+        "kube_throttler_recovery_divergence_total",
+        "published-plane vs restored-status mismatches found (and repaired) "
+        "by the recovery reconcile",
+        [],
+    )
+
+    def flush() -> None:
+        if snapshot_manager is not None:
+            snap_total.set_key((), float(snapshot_manager.snapshots_written))
+            snap_failed.set_key((), float(snapshot_manager.snapshot_failures))
+            age = snapshot_manager.snapshot_age_seconds()
+            snap_age.set_key((), -1.0 if age is None else age)
+        if recovery_manager is not None:
+            r = recovery_manager.report
+            rec_duration.set_key((), r.duration_s)
+            rec_lines.set_key((), float(r.journal_lines_replayed))
+            rec_divergence.set_key((), float(r.divergences))
+
+    registry.register_pre_expose(flush)
 
 
 def register_watch_metrics(registry: Registry) -> None:

@@ -23,7 +23,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 from datetime import timedelta
-from typing import Any, Mapping
+from typing import Any, Mapping, Optional
 
 DEFAULT_RECONCILE_TEMPORARY_THRESHOLD_INTERVAL = timedelta(seconds=15)
 
@@ -38,6 +38,12 @@ class KubeThrottlerPluginArgs:
     )
     controller_threadiness: int = 0
     num_key_mutex: int = 0
+    # optional expiry for scheduler-cycle reservations (None = the
+    # reference's reserve-until-observed lifetime): a scheduler that dies
+    # between Reserve and Bind must not pin capacity forever, and crash
+    # recovery rebases the remaining budget on restore
+    # (engine/reservations.py)
+    reservation_ttl: Optional[timedelta] = None
 
 
 def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
@@ -70,6 +76,18 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
     if threadiness == 0:
         threadiness = os.cpu_count() or 1
 
+    raw_ttl = config.get("reservationTTL", 0)
+    if isinstance(raw_ttl, str) and raw_ttl:
+        reservation_ttl = _parse_go_duration(raw_ttl)
+    elif isinstance(raw_ttl, (int, float)) and raw_ttl:
+        reservation_ttl = timedelta(seconds=float(raw_ttl))
+    else:
+        reservation_ttl = None
+    if reservation_ttl is not None and reservation_ttl <= timedelta(0):
+        # zero/negative would expire every reservation at birth — the
+        # admission inequality's `reserved` term silently vanishes
+        raise ValueError(f"reservationTTL must be positive: {raw_ttl!r}")
+
     return KubeThrottlerPluginArgs(
         name=name,
         target_scheduler_name=target,
@@ -77,6 +95,7 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
         reconcile_temporary_threshold_interval=interval,
         controller_threadiness=threadiness,
         num_key_mutex=int(config.get("numKeyMutex", 0) or 0) or 128,
+        reservation_ttl=reservation_ttl,
     )
 
 

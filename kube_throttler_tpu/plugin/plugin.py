@@ -102,6 +102,7 @@ class KubeThrottler:
             listers=self.listers,
             informers=self.informers,
             status_writer=status_writer,
+            reservation_ttl=args.reservation_ttl,
         )
         self.cluster_throttle_ctr = ClusterThrottleController(
             throttler_name=args.name,
@@ -116,6 +117,7 @@ class KubeThrottler:
             listers=self.listers,
             informers=self.informers,
             status_writer=status_writer,
+            reservation_ttl=args.reservation_ttl,
         )
         if self.device_manager is not None:
             self.device_manager.tracer = self.tracer

@@ -88,6 +88,12 @@ class ThrottlerHTTPServer:
         # handler pool is threaded and a lost update here silently unbinds
         # a running pod
         self._pod_write_lock = make_lock("server.pod_write")
+        # graceful-shutdown flag (single writer: the SIGTERM path). While
+        # set, /readyz answers 503 "down" so the load balancer / kubelet
+        # drains this instance before the final snapshot + journal fsync;
+        # /healthz stays 200 — the process is alive and must not be killed
+        # mid-flush.
+        self._draining = False
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -170,6 +176,12 @@ class ThrottlerHTTPServer:
             # kept for existing probes.
             dm = self.plugin.device_manager
             snap = self.plugin.health.snapshot()
+            if self._draining:
+                snap["state"] = "down"
+                snap["components"]["shutdown"] = {
+                    "state": "down",
+                    "reason": "draining (SIGTERM received)",
+                }
             body = {
                 "ok": snap["state"] != "down",
                 "state": snap["state"],
@@ -343,6 +355,12 @@ class ThrottlerHTTPServer:
         h._send(200, {"deleted": f"{kind}/{key}"})
 
     # ------------------------------------------------------------ lifecycle
+
+    def mark_draining(self) -> None:
+        """Flip /readyz to 503 (graceful shutdown step 1) while keeping the
+        server up: in-flight and stray requests still get answers during
+        the drain window, but probes stop routing new traffic here."""
+        self._draining = True
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)

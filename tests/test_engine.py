@@ -756,3 +756,86 @@ def test_self_echo_suppression_is_thread_scoped():
 
     ctr._inflight_status_echoes.clear()
     assert ctr._is_self_status_echo(event) is False  # marker gone
+
+
+class TestReservationTTL:
+    """TTL'd reservations under a frozen clock: expiry, snapshot's
+    remaining-budget serialization, and restore's
+    charge-elapsed-then-rebase rule (never resurrect expired entries)."""
+
+    T0 = datetime(2026, 8, 4, tzinfo=timezone.utc)
+
+    def _cache(self, clock):
+        return ReservedResourceAmounts(4, clock=clock)
+
+    def test_ttl_expiry_is_clock_driven(self):
+        clock = FakeClock(self.T0)
+        cache = self._cache(clock)
+        cache.add_pod("ns/t1", make_pod("p1"), ttl=30.0)
+        cache.add_pod("ns/t1", make_pod("p2"))  # no TTL: reference lifetime
+        amount, keys = cache.reserved_resource_amount("ns/t1")
+        assert keys == {"default/p1", "default/p2"}
+        assert amount.resource_counts == 2
+        clock.advance(timedelta(seconds=29))
+        assert cache.reserved_pod_keys("ns/t1") == {"default/p1", "default/p2"}
+        clock.advance(timedelta(seconds=2))  # past p1's deadline
+        amount, keys = cache.reserved_resource_amount("ns/t1")
+        assert keys == {"default/p2"}
+        assert amount.resource_counts == 1
+        assert cache.expired_total == 1
+
+    def test_re_add_refreshes_and_clears_deadlines(self):
+        clock = FakeClock(self.T0)
+        cache = self._cache(clock)
+        cache.add_pod("ns/t1", make_pod("p1"), ttl=10.0)
+        clock.advance(timedelta(seconds=8))
+        cache.add_pod("ns/t1", make_pod("p1"))  # re-reserve WITHOUT a TTL
+        clock.advance(timedelta(seconds=1000))
+        assert cache.reserved_pod_keys("ns/t1") == {"default/p1"}
+
+    def test_snapshot_serializes_remaining_budget_and_omits_expired(self):
+        clock = FakeClock(self.T0)
+        cache = self._cache(clock)
+        cache.add_pod("ns/t1", make_pod("p1"), ttl=100.0)
+        cache.add_pod("ns/t1", make_pod("p2"), ttl=10.0)
+        clock.advance(timedelta(seconds=40))  # p2 already expired
+        state = cache.snapshot_state()
+        entries = state["ns/t1"]
+        assert set(entries) == {"default/p1"}
+        assert entries["default/p1"]["ttlRemainingSeconds"] == pytest.approx(60.0)
+
+    def test_restore_charges_dead_time_then_rebases_on_restored_clock(self):
+        clock = FakeClock(self.T0)
+        cache = self._cache(clock)
+        cache.add_pod("ns/t1", make_pod("keep"), ttl=100.0)
+        cache.add_pod("ns/t1", make_pod("die"), ttl=10.0)
+        state = cache.snapshot_state()
+
+        # restart on a clock 50s later (the process was dead that long):
+        # "die" (10s budget) must NOT resurrect; "keep" has 50s left
+        restore_clock = FakeClock(self.T0 + timedelta(seconds=50))
+        fresh = self._cache(restore_clock)
+        restored, dropped, touched = fresh.restore_state(
+            state, elapsed_s=50.0
+        )
+        assert (restored, dropped, touched) == (1, 1, ["ns/t1"])
+        assert fresh.reserved_pod_keys("ns/t1") == {"default/keep"}
+        restore_clock.advance(timedelta(seconds=49))
+        assert fresh.reserved_pod_keys("ns/t1") == {"default/keep"}
+        restore_clock.advance(timedelta(seconds=2))
+        assert fresh.reserved_pod_keys("ns/t1") == set()
+
+    def test_restore_is_skew_proof_frozen_clock(self):
+        """Even a restored clock BEHIND the snapshot clock cannot extend a
+        deadline: budgets are relative, never absolute timestamps."""
+        clock = FakeClock(self.T0)
+        cache = self._cache(clock)
+        cache.add_pod("ns/t1", make_pod("p1"), ttl=20.0)
+        state = cache.snapshot_state()
+        skewed = FakeClock(self.T0 - timedelta(hours=3))  # clock went backwards
+        fresh = self._cache(skewed)
+        fresh.restore_state(state, elapsed_s=0.0)
+        skewed.advance(timedelta(seconds=19))
+        assert fresh.reserved_pod_keys("ns/t1") == {"default/p1"}
+        skewed.advance(timedelta(seconds=2))
+        assert fresh.reserved_pod_keys("ns/t1") == set()

@@ -83,6 +83,73 @@ class TestJournal:
         attach(recovered, path).close()
         assert {p.key for p in recovered.list_pods()} == {"default/p1"}
 
+    def test_torn_final_line_is_normal_not_corruption(self, tmp_path):
+        """A torn FINAL line is the legal crash artifact: truncated
+        silently, counted in torn_tails, and the journal health stays OK —
+        an operator page for every unclean shutdown would be noise."""
+        path = str(tmp_path / "store.journal")
+        store = Store()
+        journal = attach(store, path)
+        _populate(store)
+        journal.close()
+        with open(path, "a") as f:
+            f.write('{"type": "ADDED", "kind": "Pod", "obj')  # crash mid-write
+        recovered = Store()
+        j = attach(recovered, path)
+        assert j.torn_tails == 1
+        assert j.replay_skipped == 0
+        state, detail = j.health_state()
+        assert state == "ok"
+        assert detail["tornTails"] == 1
+        j.close()
+
+    def test_interior_corruption_counts_and_degrades(self, tmp_path):
+        """A bad line WITH good lines after it cannot be a crash tail —
+        it is real corruption: skipped, counted, health degraded."""
+        path = str(tmp_path / "store.journal")
+        store = Store()
+        journal = attach(store, path)
+        store.create_namespace(Namespace("default"))
+        journal.close()
+        with open(path, "a") as f:
+            f.write("{corrupt interior line!!\n")
+        # more valid history lands AFTER the corruption
+        store2 = Store()
+        j2 = attach(store2, path)
+        assert j2.replay_skipped == 1 and j2.torn_tails == 0
+        store2.create_throttle(_throttle("t1", {"grp": "a"}, pod=10))
+        state, _ = j2.health_state()
+        assert state == "degraded"
+        j2.close()
+        # and the post-corruption throttle still replays on the NEXT restart
+        store3 = Store()
+        j3 = attach(store3, path)
+        assert len(store3.list_throttles()) == 1
+        assert j3.replay_skipped == 1  # the interior line, re-counted per replay
+        j3.close()
+
+    def test_trailing_run_counts_all_but_final_line_as_corruption(self, tmp_path):
+        """Only the LAST line of a trailing corrupt run can be the
+        crash-mid-write artifact; bad lines ahead of it had writes land
+        after them, so they are genuine corruption: counted (degraded)
+        while the final line truncates silently."""
+        path = str(tmp_path / "store.journal")
+        store = Store()
+        journal = attach(store, path)
+        _populate(store)
+        journal.close()
+        with open(path, "a") as f:
+            f.write("!!corrupt-but-complete-line\n")
+            f.write('{"type": "ADDED", "kind": "Pod", "obj')  # torn final
+        recovered = Store()
+        j = attach(recovered, path)
+        assert j.replay_skipped == 1  # the complete-but-corrupt line
+        assert j.torn_tails == 1  # the torn final line
+        state, _ = j.health_state()
+        assert state == "degraded"
+        assert {p.key for p in recovered.list_pods()} == {"default/p1"}
+        j.close()
+
     def test_post_corruption_appends_survive_the_next_restart(self, tmp_path):
         """attach() must truncate the corrupt tail BEFORE appending: events
         written after a corrupt line would otherwise be stranded behind the

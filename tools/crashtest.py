@@ -1,0 +1,541 @@
+#!/usr/bin/env python
+"""SIGKILL crash-point harness for the snapshot/recovery subsystem.
+
+Drives the standalone ingest stack (store + journal + snapshots +
+TTL'd reservations) in a CHILD process whose fault plan SIGKILLs it at a
+seeded ``crash.*`` site (faults/plan.py) — the worst possible instants:
+between a store mutation and its journal line, mid-snapshot-tmp-write,
+between the snapshot rename and the prune, right after a compaction
+rotates the log. The parent then restarts over the same data directory
+and asserts the **invariant oracle**:
+
+1. *replay equivalence* — the recovered store (newest valid snapshot +
+   journal tail, engine/recovery.py) is byte-identical, object for
+   object, to a pure from-genesis replay of the same journal;
+2. *admission equivalence* — ``pre_filter`` verdicts (status code +
+   reason strings) for every stored pod match between the two;
+3. *plane integrity* — the recovery reconcile finds ZERO divergences
+   between the rebuilt published ``st_*`` planes and the restored
+   statuses (throttled flags included);
+4. *reservation safety* — every restored reservation existed unexpired in
+   the snapshot, nothing expired is resurrected, and non-TTL entries all
+   survive.
+
+Usage:
+    python tools/crashtest.py matrix [--seeds 0,1,2] [--events 150]
+    python tools/crashtest.py one --site crash.snapshot.pre_rename --seed 0
+    python tools/crashtest.py child ...   (internal: the workload driver)
+
+``make crash-test`` runs the full matrix; tests/test_crash_recovery.py
+runs one fast smoke cycle in tier-1 and the matrix behind ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+from dataclasses import replace
+from datetime import timedelta
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# every registered crash.* site (faults/plan.py KNOWN_SITES)
+CRASH_SITES = (
+    "crash.journal.append",
+    "crash.journal.torn",
+    "crash.journal.compact",
+    "crash.snapshot.begin",
+    "crash.snapshot.tmp_partial",
+    "crash.snapshot.pre_rename",
+    "crash.snapshot.post_rename",
+    "crash.snapshot.prune",
+)
+
+# workload knobs the child and the oracle agree on
+DEFAULT_EVENTS = 150
+SNAPSHOT_EVERY = 25
+COMPACT_AFTER = 70
+SNAPSHOT_KEEP = 2
+N_THROTTLES = 4
+
+
+def default_hit(site: str, seed: int) -> int:
+    """Which 1-based hit of ``site`` to die at: spread kills across the run
+    for per-append sites; low-frequency sites (per-snapshot, per-compact)
+    use small indices so each seed crashes a different occurrence."""
+    if site in ("crash.journal.append", "crash.journal.torn"):
+        return 10 + 37 * seed
+    return 1 + seed
+
+
+# --------------------------------------------------------------------------
+# child: the workload driver (dies by SIGKILL mid-flight)
+# --------------------------------------------------------------------------
+
+
+def _throttle(i: int):
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ResourceAmount,
+        Throttle,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+        ThrottleSpec,
+    )
+
+    return Throttle(
+        name=f"t{i}",
+        namespace="default",
+        spec=ThrottleSpec(
+            throttler_name="kube-throttler",
+            threshold=ResourceAmount.of(
+                pod=3 + i, requests={"cpu": str(1 + i)}
+            ),
+            selector=ThrottleSelector(
+                selector_terms=(
+                    ThrottleSelectorTerm(
+                        LabelSelector(match_labels={"grp": f"g{i}"})
+                    ),
+                )
+            ),
+        ),
+    )
+
+
+def _recompute_status(store, thr):
+    """A deterministic reconcile stand-in: count/sum the Running pods the
+    throttle's matchLabels selector matches and derive throttled flags —
+    enough to populate status.used/throttled/calculatedThreshold through
+    the real status-subresource write path (which the journal records)."""
+    from kube_throttler_tpu.api.types import (
+        CalculatedThreshold,
+        IsResourceAmountThrottled,
+        ResourceAmount,
+        ThrottleStatus,
+    )
+    from kube_throttler_tpu.resourcelist import pod_request_resource_list
+
+    grp = thr.spec.selector.selector_terms[0].pod_selector.match_labels.get("grp")
+    running = [
+        p
+        for p in store.list_pods("default")
+        if p.labels.get("grp") == grp and p.status.phase == "Running"
+    ]
+    cpu = sum(
+        (pod_request_resource_list(p).get("cpu", 0) for p in running), 0
+    )
+    # exact-Fraction quantities go straight into the dataclass (of() parses
+    # strings; these are already canonical)
+    used = ResourceAmount(
+        resource_counts=len(running), resource_requests={"cpu": cpu}
+    )
+    threshold = thr.spec.threshold
+    flags = IsResourceAmountThrottled(
+        resource_counts_pod=(
+            threshold.resource_counts is not None
+            and len(running) >= threshold.resource_counts
+        ),
+        resource_requests={
+            "cpu": cpu >= (threshold.resource_requests or {}).get("cpu", 0)
+        },
+    )
+    return thr.with_status(
+        ThrottleStatus(
+            calculated_threshold=CalculatedThreshold(threshold=threshold),
+            throttled=flags,
+            used=used,
+        )
+    )
+
+
+def run_child(args) -> int:
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.engine.recovery import RecoveryManager
+    from kube_throttler_tpu.engine.reservations import ReservedResourceAmounts
+    from kube_throttler_tpu.engine.snapshot import SnapshotManager
+    from kube_throttler_tpu.engine.store import Store
+    from kube_throttler_tpu.faults.plan import FaultPlan
+
+    plan = None
+    if args.site:
+        plan = FaultPlan(seed=args.seed).rule(
+            args.site, mode="kill", schedule=[args.hit]
+        )
+    store = Store()
+    recovery = RecoveryManager(
+        args.dir, faults=plan, compact_after=args.compact_after
+    )
+    journal = recovery.recover_store(store)
+    reservations = {
+        "throttle": ReservedResourceAmounts(8),
+        "clusterthrottle": ReservedResourceAmounts(8),
+    }
+    recovery.restore_reservations(reservations)
+    snapshotter = SnapshotManager(
+        args.dir,
+        store,
+        reservations=reservations,
+        keep=args.keep,
+        faults=plan,
+    )
+    snapshotter.bind_journal(journal, every_lines=args.snapshot_every)
+
+    rng = random.Random(args.seed)
+    if store.get_namespace("default") is None:
+        store.create_namespace(Namespace("default"))
+    throttles = []
+    for i in range(N_THROTTLES):
+        try:
+            store.create_throttle(_throttle(i))
+        except ValueError:
+            pass  # recovered from a previous run
+        throttles.append(f"t{i}")
+
+    for _step in range(args.events):
+        op = rng.random()
+        if op < 0.35:  # create a pod (some born Running)
+            i = rng.randrange(N_THROTTLES)
+            pod = make_pod(
+                f"p{rng.randrange(10**9)}",
+                labels={"grp": f"g{i}"},
+                requests={"cpu": f"{rng.randrange(100, 900)}m"},
+            )
+            if rng.random() < 0.5:
+                pod = replace(
+                    pod, spec=replace(pod.spec, node_name="node-1")
+                )
+                pod.status.phase = "Running"
+            try:
+                store.create_pod(pod)
+            except ValueError:
+                pass
+        elif op < 0.5:  # bind a pending pod
+            pods = [
+                p for p in store.list_pods("default") if p.status.phase == "Pending"
+            ]
+            if pods:
+                p = rng.choice(pods)
+                bound = replace(p, spec=replace(p.spec, node_name="node-1"))
+                bound = replace(bound, status=replace(bound.status, phase="Running"))
+                store.update_pod(bound)
+        elif op < 0.6:  # delete a pod
+            pods = store.list_pods("default")
+            if pods:
+                p = rng.choice(pods)
+                store.delete_pod(p.namespace, p.name)
+        elif op < 0.7:  # spec churn: bump a threshold
+            name = rng.choice(throttles)
+            thr = store.get_throttle("default", name)
+            spec = thr.spec
+            from kube_throttler_tpu.api.types import ResourceAmount
+
+            new_spec = replace(
+                spec,
+                threshold=ResourceAmount.of(
+                    pod=rng.randrange(2, 9),
+                    requests={"cpu": str(rng.randrange(1, 6))},
+                ),
+            )
+            store.update_throttle_spec(replace(thr, spec=new_spec))
+        elif op < 0.9:  # reconcile stand-in: status write (journaled)
+            name = rng.choice(throttles)
+            thr = store.get_throttle("default", name)
+            store.update_throttle_status(_recompute_status(store, thr))
+        else:  # reservation churn with mixed TTLs
+            name = rng.choice(throttles)
+            cache = reservations["throttle"]
+            pod = make_pod(
+                f"r{rng.randrange(10**6)}",
+                labels={"grp": name},
+                requests={"cpu": "250m"},
+            )
+            if rng.random() < 0.7:
+                ttl = rng.choice([None, 5.0, 30.0, timedelta(minutes=2)])
+                cache.add_pod(f"default/{name}", pod, ttl=ttl)
+            else:
+                keys = list(cache.reserved_pod_keys(f"default/{name}"))
+                if keys:
+                    cache.remove_pod_key(f"default/{name}", rng.choice(keys))
+
+    # survived every event (the seeded hit was never reached): exit through
+    # the graceful path — final snapshot + fsynced journal
+    snapshotter.write(reason="shutdown")
+    journal.close()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# parent: restart + invariant oracle
+# --------------------------------------------------------------------------
+
+
+def spawn_child(
+    data_dir: str,
+    seed: int,
+    site: str,
+    hit: int,
+    events: int,
+    timeout: float = 180.0,
+):
+    cmd = [
+        sys.executable,
+        os.path.abspath(__file__),
+        "child",
+        "--dir", data_dir,
+        "--seed", str(seed),
+        "--events", str(events),
+        "--snapshot-every", str(SNAPSHOT_EVERY),
+        "--compact-after", str(COMPACT_AFTER),
+        "--keep", str(SNAPSHOT_KEEP),
+    ]
+    if site:
+        cmd += ["--site", site, "--hit", str(hit)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO_ROOT
+    )
+
+
+def _dump_store(store) -> dict:
+    from kube_throttler_tpu.api.serialization import object_to_dict
+
+    return {
+        "Namespace": {n.name: object_to_dict(n) for n in store.list_namespaces()},
+        "Throttle": {t.key: object_to_dict(t) for t in store.list_throttles()},
+        "ClusterThrottle": {
+            t.name: object_to_dict(t) for t in store.list_cluster_throttles()
+        },
+        "Pod": {p.key: object_to_dict(p) for p in store.list_pods()},
+    }
+
+
+def _normalized_reasons(reasons) -> list:
+    out = []
+    for r in reasons:
+        head, _, names = r.partition("=")
+        out.append(f"{head}={','.join(sorted(names.split(',')))}")
+    return sorted(out)
+
+
+def _verdicts(plugin, store) -> dict:
+    out = {}
+    for pod in sorted(store.list_pods(), key=lambda p: p.key):
+        status = plugin.pre_filter(pod)
+        out[pod.key] = (status.code.value, _normalized_reasons(status.reasons))
+    return out
+
+
+def _build_plugin(store):
+    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
+
+    return KubeThrottler(
+        decode_plugin_args(
+            {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
+        ),
+        store,
+        use_device=True,
+        start_workers=False,
+    )
+
+
+def run_crash_cycle(
+    site: str,
+    seed: int,
+    workdir: str,
+    events: int = DEFAULT_EVENTS,
+    hit: int = None,
+) -> dict:
+    """One full crash/recover/verify cycle; raises AssertionError with a
+    diagnosis on any oracle violation, else returns a report dict."""
+    from kube_throttler_tpu.engine.journal import attach
+    from kube_throttler_tpu.engine.recovery import RecoveryManager
+    from kube_throttler_tpu.engine.reservations import ReservedResourceAmounts
+    from kube_throttler_tpu.engine.snapshot import find_snapshots, load_snapshot
+    from kube_throttler_tpu.engine.store import Store
+
+    hit = default_hit(site, seed) if hit is None else hit
+    data_dir = os.path.join(workdir, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    proc = spawn_child(data_dir, seed, site, hit, events)
+    killed = proc.returncode == -signal.SIGKILL
+    if not killed and proc.returncode != 0:
+        raise AssertionError(
+            f"child failed (rc={proc.returncode}) at {site} seed={seed}:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+
+    # two pristine copies of the crash artifact: recovery and the pure
+    # replay both truncate/compact, so they must not share files
+    recovered_dir = os.path.join(workdir, "recovered")
+    pure_dir = os.path.join(workdir, "pure")
+    for d in (recovered_dir, pure_dir):
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        shutil.copytree(data_dir, d)
+
+    # --- recovered state: snapshot + journal tail ------------------------
+    recovered = Store()
+    rec = RecoveryManager(recovered_dir, compact_after=10**9)
+    rec_journal = rec.recover_store(recovered)
+    caches = {
+        "throttle": ReservedResourceAmounts(8),
+        "clusterthrottle": ReservedResourceAmounts(8),
+    }
+    rec.restore_reservations(caches)
+    rec_journal.close()
+
+    # --- pure state: from-genesis journal replay, snapshots ignored ------
+    pure = Store()
+    attach(pure, os.path.join(pure_dir, "store.journal"), compact_after=10**9).close()
+
+    # oracle 1: replay equivalence (objects, statuses, throttled flags)
+    dump_rec, dump_pure = _dump_store(recovered), _dump_store(pure)
+    assert dump_rec == dump_pure, (
+        f"{site} seed={seed} hit={hit}: recovered state (mode="
+        f"{rec.report.journal_mode}) diverges from pure from-genesis replay"
+    )
+
+    # oracle 2+3: admission equivalence + zero plane divergence
+    plugin_rec = _build_plugin(recovered)
+    plugin_pure = _build_plugin(pure)
+    try:
+        v_rec, v_pure = _verdicts(plugin_rec, recovered), _verdicts(plugin_pure, pure)
+        assert v_rec == v_pure, (
+            f"{site} seed={seed} hit={hit}: admission verdicts diverge: "
+            f"{ {k: (v_rec.get(k), v_pure.get(k)) for k in set(v_rec) | set(v_pure) if v_rec.get(k) != v_pure.get(k)} }"
+        )
+        divergences = rec.reconcile(
+            plugin_rec.informers, device_manager=plugin_rec.device_manager
+        )
+        assert divergences == 0, (
+            f"{site} seed={seed} hit={hit}: {divergences} published-plane "
+            f"divergence(s) after recovery: {rec.report.repaired_keys}"
+        )
+    finally:
+        plugin_rec.stop()
+        plugin_pure.stop()
+
+    # oracle 4: reservation safety — everything restored was unexpired in
+    # the snapshot; nothing with a spent TTL came back; every non-TTL
+    # entry survived
+    snaps = find_snapshots(recovered_dir)
+    if rec.snapshot is not None and snaps:
+        snap_res = (rec.snapshot.get("reservations") or {}).get("throttle") or {}
+        restored_keys = {
+            (tk, pk)
+            for tk in caches["throttle"].throttle_keys()
+            for pk in caches["throttle"].reserved_pod_keys(tk)
+        }
+        snap_keys = {
+            (tk, pk) for tk, pods in snap_res.items() for pk in pods
+        }
+        extra = restored_keys - snap_keys
+        assert not extra, (
+            f"{site} seed={seed}: reservations restored that the snapshot "
+            f"never carried: {extra}"
+        )
+        eternal = {
+            (tk, pk)
+            for tk, pods in snap_res.items()
+            for pk, entry in pods.items()
+            if entry.get("ttlRemainingSeconds") is None
+        }
+        missing = eternal - restored_keys
+        assert not missing, (
+            f"{site} seed={seed}: non-TTL reservations lost in restore: {missing}"
+        )
+
+    return {
+        "site": site,
+        "seed": seed,
+        "hit": hit,
+        "killed": killed,
+        "mode": rec.report.journal_mode,
+        "snapshot_seq": rec.report.snapshot_seq,
+        "snapshots_rejected": rec.report.snapshots_rejected,
+        "journal_lines_replayed": rec.report.journal_lines_replayed,
+        "torn_tails": rec.report.journal_torn_tails,
+        "interior_skipped": rec.report.journal_interior_skipped,
+        "reservations_restored": rec.report.reservations_restored,
+        "reservations_expired_dropped": rec.report.reservations_expired_dropped,
+        "pods": len(pure.list_pods()),
+    }
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="crashtest")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    child = sub.add_parser("child", help="internal: the workload driver")
+    child.add_argument("--dir", required=True)
+    child.add_argument("--seed", type=int, default=0)
+    child.add_argument("--site", default="")
+    child.add_argument("--hit", type=int, default=1)
+    child.add_argument("--events", type=int, default=DEFAULT_EVENTS)
+    child.add_argument("--snapshot-every", type=int, default=SNAPSHOT_EVERY)
+    child.add_argument("--compact-after", type=int, default=COMPACT_AFTER)
+    child.add_argument("--keep", type=int, default=SNAPSHOT_KEEP)
+
+    one = sub.add_parser("one", help="one crash/recover/verify cycle")
+    one.add_argument("--site", required=True, choices=CRASH_SITES)
+    one.add_argument("--seed", type=int, default=0)
+    one.add_argument("--events", type=int, default=DEFAULT_EVENTS)
+    one.add_argument("--hit", type=int, default=None)
+
+    matrix = sub.add_parser("matrix", help="full site × seed matrix")
+    matrix.add_argument("--seeds", default="0,1,2")
+    matrix.add_argument("--events", type=int, default=DEFAULT_EVENTS)
+
+    args = parser.parse_args(argv)
+
+    if args.command == "child":
+        return run_child(args)
+
+    if args.command == "one":
+        with tempfile.TemporaryDirectory(prefix="crashtest-") as tmp:
+            report = run_crash_cycle(
+                args.site, args.seed, tmp, events=args.events, hit=args.hit
+            )
+        print(json.dumps(report, indent=2))
+        return 0
+
+    seeds = [int(s) for s in args.seeds.split(",") if s != ""]
+    failures = 0
+    for site in CRASH_SITES:
+        for seed in seeds:
+            with tempfile.TemporaryDirectory(prefix="crashtest-") as tmp:
+                try:
+                    report = run_crash_cycle(site, seed, tmp, events=args.events)
+                except AssertionError as e:
+                    failures += 1
+                    print(f"FAIL {site} seed={seed}: {e}")
+                    continue
+            print(
+                f"PASS {site:<28} seed={seed} hit={report['hit']:<4} "
+                f"killed={str(report['killed']):<5} mode={report['mode']:<13} "
+                f"replayed={report['journal_lines_replayed']:<4} "
+                f"torn={report['torn_tails']} pods={report['pods']}"
+            )
+    total = len(CRASH_SITES) * len(seeds)
+    print(f"\n{total - failures}/{total} crash points recovered cleanly")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
