@@ -161,6 +161,14 @@ def main(argv: Optional[list] = None) -> int:
         "TTLs. Empty = reservations live until observed/unreserved "
         "(reference semantics)",
     )
+    serve.add_argument(
+        "--ingest-batch",
+        default="adaptive",
+        help="micro-batched watch ingest (remote mode): 'adaptive' "
+        "(default — batch grows under backlog, collapses to single-event "
+        "application when idle), a fixed integer batch size, or 'off' for "
+        "per-event application",
+    )
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
     serve.add_argument(
         "--leader-elect",
@@ -359,18 +367,25 @@ def main(argv: Optional[list] = None) -> int:
     journal = None
     recovery = None
     snapshotter = None
+    ingest_pipeline = None
     from .metrics import Registry
 
     metrics_registry = Registry()  # shared: reflector metrics + the 16 families
     if rest_config is not None:
         from .client.transport import RemoteSession
 
+        ingest_batch = getattr(args, "ingest_batch", "adaptive")
+        if ingest_batch in ("off", "none", ""):
+            ingest_batch = None
+        elif ingest_batch != "adaptive":
+            ingest_batch = int(ingest_batch)
         session = RemoteSession(
             rest_config,
             store,
             metrics_registry=metrics_registry,
             qps=args.api_qps if args.api_qps > 0 else None,
             burst=args.api_burst,
+            ingest_batch=ingest_batch,
         )
         print(
             f"syncing from apiserver {session.config.server} "
@@ -405,6 +420,21 @@ def main(argv: Optional[list] = None) -> int:
             )
         if store.get_namespace("default") is None:
             store.create_namespace(Namespace("default"))
+        # standalone mode: the micro-batch ingest front-end over the local
+        # store (embedders/REST writers submit through it; idle it costs
+        # one parked thread) — built with the registry so the ingest
+        # batch-size/counter families export on the LOCAL path too
+        ingest_batch = getattr(args, "ingest_batch", "adaptive")
+        if ingest_batch not in ("off", "none", ""):
+            from .engine.ingest import MicroBatchIngest
+
+            ingest_pipeline = MicroBatchIngest(
+                store,
+                batch_policy=(
+                    "adaptive" if ingest_batch == "adaptive" else int(ingest_batch)
+                ),
+                metrics_registry=metrics_registry,
+            )
     plugin = KubeThrottler(
         plugin_args,
         store,
@@ -558,6 +588,8 @@ def main(argv: Optional[list] = None) -> int:
         if committer is not None:
             committer.flush()
         session.stop()
+    if ingest_pipeline is not None:
+        ingest_pipeline.stop()  # drain queued ops before the final snapshot
     plugin.stop()
     if snapshotter is not None:
         snapshotter.write(reason="shutdown")

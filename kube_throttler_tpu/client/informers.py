@@ -64,9 +64,17 @@ class Indexer:
     def upsert(self, key: str, obj: object) -> None:
         with self._lock:
             old = self._objects.get(key)
-            if old is not None:
-                self._unindex_locked(key, old)
             self._objects[key] = obj
+            if old is not None:
+                # skip the unindex/index set churn when every index value
+                # is unchanged — true for ~every status-write echo (the
+                # namespace index reads only obj.namespace), which at drain
+                # saturation is thousands of upserts/s
+                if all(
+                    fn(old) == fn(obj) for fn in self._index_funcs.values()
+                ):
+                    return
+                self._unindex_locked(key, old)
             self._index_locked(key, obj)
 
     def delete(self, key: str) -> None:
@@ -142,9 +150,14 @@ class SharedIndexInformer:
         # the store-facing subscription mirrors every event into the indexer
         # BEFORE fanning out, so handlers observe a cache ≥ the event
         self._store.add_event_handler(kind, self._on_store_event, replay=True)
+        # batched mutations deliver through on_batch (one mirror pass + one
+        # fan-out per batch); the per-event handler skips those dispatches
+        self._store.add_batch_listener(self)
         self._synced.set()
 
     def _on_store_event(self, event: Event) -> None:
+        if self._store.in_batch_dispatch:
+            return  # mirrored + fanned out by on_batch
         with self._dispatch_lock:
             key = key_of(self.kind, event.obj)
             if event.type == EventType.DELETED:
@@ -155,6 +168,34 @@ class SharedIndexInformer:
                 handlers = list(self._handlers)
             for h in handlers:
                 h(event)
+
+    def on_batch(self, events: List[Event]) -> None:
+        """Store batch-listener hook: mirror the batch's events of this
+        kind into the indexer under ONE dispatch-lock hold, then fan out —
+        handlers that expose ``on_events`` (the controllers' batch
+        handlers, controllers/base._BatchEventHandler) get the whole
+        ordered list in one call; plain handlers still see every event.
+        The per-listener serial-delivery contract is unchanged: everything
+        runs under the dispatch lock in event order."""
+        events = [e for e in events if e.kind == self.kind]
+        if not events:
+            return
+        with self._dispatch_lock:
+            for event in events:
+                key = key_of(self.kind, event.obj)
+                if event.type == EventType.DELETED:
+                    self.indexer.delete(key)
+                else:
+                    self.indexer.upsert(key, event.obj)
+            with self._lock:
+                handlers = list(self._handlers)
+            for h in handlers:
+                on_events = getattr(h, "on_events", None)
+                if on_events is not None:
+                    on_events(events)
+                else:
+                    for event in events:
+                        h(event)
 
     def add_event_handler(self, handler: Handler, replay: bool = True) -> None:
         # registration + replay under the dispatch lock: otherwise a
@@ -212,6 +253,7 @@ class SharedIndexInformer:
 
     def detach(self) -> None:
         self._store.remove_event_handler(self.kind, self._on_store_event)
+        self._store.remove_batch_listener(self)
 
 
 class InformerBundle:

@@ -158,9 +158,13 @@ class MockApiServer:
 
     def _make_recorder(self, kind: str):
         def record(event: Event) -> None:
-            # runs inside the store lock right after the rv bump, so
-            # latest_resource_version IS this event's rv
-            rv = self.store.latest_resource_version
+            # the event carries its own rv (batched dispatch runs after the
+            # whole batch mutated, so latest_resource_version would report
+            # the batch's LAST version for every event); events from older
+            # dispatch paths without one fall back to the live counter
+            rv = event.rv
+            if rv is None:  # pragma: no cover — all store paths stamp rv now
+                rv = self.store.latest_resource_version
             entry = (rv, _EVENT_TYPES[event.type], self._obj_dict(kind, event.obj, rv))
             with self._lock:
                 log = self._logs[kind]

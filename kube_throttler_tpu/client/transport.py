@@ -739,10 +739,17 @@ class Reflector:
         metrics: Optional[ReflectorMetrics] = None,
         backoff_cap: float = 30.0,
         backoff_rng: Optional[random.Random] = None,
+        ingest_batcher=None,
     ):
         self.client = client
         self.kind = kind
         self.store = store
+        # optional MicroBatchIngest (engine/ingest.py): watch events route
+        # through the adaptive micro-batcher instead of per-event store
+        # calls — the wire parser never waits on the store lock, and a
+        # backlog group-commits. Relists FLUSH it first (a relist diffs
+        # the live store; unapplied queued events would read as deletions).
+        self.ingest_batcher = ingest_batcher
         self.versions = versions
         # ``backoff`` stays the BASE delay (compat kwarg); the loop now
         # walks base→cap with jitter and resets on a healthy stream instead
@@ -860,6 +867,10 @@ class Reflector:
         """Paginated relist; on a mid-pagination 410 (continue token
         expired server-side) fall back to ONE unpaginated full LIST, the
         same way client-go's pager does. Returns the list RV."""
+        if self.ingest_batcher is not None:
+            # the replace diff reads the live store; queued-but-unapplied
+            # events would make current objects look deleted
+            self.ingest_batcher.flush(timeout=30.0)
         self._count(lambda m: m.lists)
         try:
             return self._sync_pages(self.client.list_pages(self.kind))
@@ -881,12 +892,19 @@ class Reflector:
                 self.last_resource_version = rv
             return
         obj = self._obj_from(item)
-        if etype == "ADDED":
+        if etype in ("ADDED", "MODIFIED") and self.ingest_batcher is not None:
+            self.ingest_batcher.upsert(self.kind, obj)
+        elif etype == "ADDED":
             self._create(obj)
         elif etype == "MODIFIED":
             self._upsert(obj)
         elif etype == "DELETED":
-            self._delete(obj)
+            if self.ingest_batcher is not None:
+                if self.versions is not None:
+                    self.versions.drop(self.kind, key_of(self.kind, obj))
+                self.ingest_batcher.delete(self.kind, key_of(self.kind, obj))
+            else:
+                self._delete(obj)
         else:
             logger.warning("reflector %s: unknown watch event %r", self.kind, etype)
             return
@@ -1467,6 +1485,7 @@ class RemoteSession:
         qps: Optional[float] = 50.0,
         burst: int = 100,
         faults=None,
+        ingest_batch=None,
     ):
         self.config = config
         self.store = store
@@ -1475,9 +1494,21 @@ class RemoteSession:
         metrics = (
             ReflectorMetrics(metrics_registry) if metrics_registry is not None else None
         )
+        # ``ingest_batch`` ("adaptive" or a fixed int) routes every
+        # reflector's watch events through ONE shared micro-batcher
+        # (engine/ingest.py) — per-event store application otherwise
+        self.ingest = None
+        if ingest_batch is not None:
+            from ..engine.ingest import MicroBatchIngest
+
+            self.ingest = MicroBatchIngest(
+                store, batch_policy=ingest_batch, faults=faults,
+                metrics_registry=metrics_registry,
+            )
         self.reflectors = {
             kind: Reflector(
-                self.client, kind, store, versions=self.versions, metrics=metrics
+                self.client, kind, store, versions=self.versions, metrics=metrics,
+                ingest_batcher=self.ingest,
             )
             for kind in self.KINDS
         }
@@ -1511,6 +1542,8 @@ class RemoteSession:
         self.event_recorder.close()
         for refl in self.reflectors.values():
             refl.stop()
+        if self.ingest is not None:
+            self.ingest.stop()
 
     def register_health(self, health) -> None:
         """Expose each reflector as a /readyz component (health.Health):

@@ -24,7 +24,8 @@ from __future__ import annotations
 import queue
 import threading
 import weakref
-from typing import Callable, Iterator, Optional
+from collections import deque
+from typing import Callable, Iterator, List, Optional, Union
 
 from ..utils.lockorder import make_lock
 from ..engine.store import Event, EventType, Store
@@ -75,39 +76,69 @@ class Watch:
         self._queue: "queue.Queue" = queue.Queue(maxsize=self._maxsize)
         self._stopped = threading.Event()
         self._terminal = False  # consumer-side: sentinel observed
-        self.dropped = 0  # events shed by drop-oldest on this watch
+        # consumer-side unpack buffer for batch items (micro-batched ingest
+        # delivers one LIST per store batch — see on_batch)
+        self._pending: "deque[Event]" = deque()
+        self.dropped = 0  # events shed by drop-oldest on this watch (PER EVENT)
         self.overflowed = False  # the stream has a gap — consumer should relist
 
         def handler(event: Event) -> None:
             if self._stopped.is_set():
                 return
+            if store.in_batch_dispatch:
+                return  # delivered as a batch item by on_batch
             if self._filter is not None and not self._filter(event):
                 return
-            if self._overflow == "block":
-                self._queue.put(event)
-                return
-            while True:
-                try:
-                    self._queue.put_nowait(event)
-                    return
-                except queue.Full:
-                    try:
-                        shed = self._queue.get_nowait()
-                    except queue.Empty:
-                        continue  # consumer raced us; retry the put
-                    if shed is self._SENTINEL:
-                        # never shed the terminator: the stream is stopping,
-                        # losing THIS event instead is fine
-                        self._queue.put_nowait(shed)
-                        return
-                    self.overflowed = True
-                    self.dropped += 1
-                    with Watch._stats_lock:
-                        Watch._dropped_total += 1
+            self._put(event, 1)
 
         self._handler = handler
         Watch._live.add(self)
         store.add_event_handler(kind, handler, replay=replay)
+        store.add_batch_listener(self)
+
+    def on_batch(self, events: List[Event]) -> None:
+        """Store batch-listener hook: the batch's matching events enqueue
+        as ONE item (a list — a slow consumer pays one queue round trip
+        per ingest batch, and the wire watch can encode them in one
+        write). Shedding accounts PER EVENT: a dropped list moves the
+        overflow counters by its length — counting batches would
+        under-report the stream's gap by the batch size."""
+        if self._stopped.is_set():
+            return
+        matched = [
+            e
+            for e in events
+            if e.kind == self._kind and (self._filter is None or self._filter(e))
+        ]
+        if not matched:
+            return
+        self._put(matched[0] if len(matched) == 1 else matched, len(matched))
+
+    def _put(self, item: Union[Event, List[Event]], n_events: int) -> None:
+        """Enqueue one item (an Event or a batch list) under the overflow
+        policy; drop-oldest counts shed EVENTS, not items."""
+        if self._overflow == "block":
+            self._queue.put(item)
+            return
+        while True:
+            try:
+                self._queue.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    shed = self._queue.get_nowait()
+                except queue.Empty:
+                    continue  # consumer raced us; retry the put
+                if shed is self._SENTINEL:
+                    # never shed the terminator: the stream is stopping,
+                    # losing THIS item instead is fine
+                    self._queue.put_nowait(shed)
+                    return
+                n = len(shed) if isinstance(shed, list) else 1
+                self.overflowed = True
+                self.dropped += n
+                with Watch._stats_lock:
+                    Watch._dropped_total += n
 
     def stop(self) -> None:
         """Terminate the stream; pending and future ``next()`` calls raise
@@ -115,6 +146,7 @@ class Watch:
         if not self._stopped.is_set():
             self._stopped.set()
             self._store.remove_event_handler(self._kind, self._handler)
+            self._store.remove_batch_listener(self)
             while True:
                 try:
                     self._queue.put_nowait(self._SENTINEL)
@@ -128,21 +160,55 @@ class Watch:
                         continue
 
     def qsize(self) -> int:
-        return self._queue.qsize()
+        # queue items plus the consumer-side unpack buffer; a batch item
+        # counts once here (the depth gauge reads this — cheap, slightly
+        # under events when batches are queued)
+        return self._queue.qsize() + len(self._pending)
 
     def next(self, timeout: Optional[float] = None) -> Event:
         """Block for the next event. Raises ``queue.Empty`` on timeout,
-        ``StopIteration`` after :meth:`stop`."""
+        ``StopIteration`` after :meth:`stop`. Batch items unpack
+        transparently — consumers keep their one-event-at-a-time view."""
         # once the sentinel has been observed the stream is terminal — a
         # straggler event that raced in behind the sentinel must never be
         # returned, so the flag (not the queue contents) is authoritative
         if self._terminal:
             raise StopIteration
+        if self._pending:
+            return self._pending.popleft()
         item = self._queue.get(timeout=timeout)
         if item is self._SENTINEL:
             self._terminal = True
             raise StopIteration
+        if isinstance(item, list):
+            self._pending.extend(item)
+            return self._pending.popleft()
         return item
+
+    def next_batch(self, timeout: Optional[float] = None, max_events: int = 256) -> List[Event]:
+        """Drain up to ``max_events`` immediately-available events in one
+        call (blocking like :meth:`next` for the first) — the consumer-side
+        micro-batch for wire encoders and reflectors: one socket write /
+        one store application per drained batch instead of per event."""
+        out = [self.next(timeout=timeout)]
+        while len(out) < max_events:
+            if self._pending:
+                out.append(self._pending.popleft())
+                continue
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is self._SENTINEL:
+                # re-stage the terminator for the NEXT call: this batch's
+                # events are real and must be delivered first
+                self._queue.put_nowait(item)
+                break
+            if isinstance(item, list):
+                self._pending.extend(item)
+            else:
+                out.append(item)
+        return out
 
     def __iter__(self) -> Iterator[Event]:
         while True:

@@ -402,11 +402,15 @@ class ClusterThrottleController(ControllerBase):
         # resync; here a namespace event whose selector match flips enqueues
         # the affected clusterthrottles directly (no replay: preexisting
         # namespaces carry no pending status change).
+        from .base import _BatchEventHandler
+
         if self.informers is not None:
             self.informers.cluster_throttles().add_event_handler(
-                self._on_throttle_event
+                _BatchEventHandler(self._on_throttle_event, self._on_throttle_events)
             )
-            self.informers.pods().add_event_handler(self._on_pod_event)
+            self.informers.pods().add_event_handler(
+                _BatchEventHandler(self._on_pod_event, self._on_pod_events)
+            )
             self.informers.namespaces().add_event_handler(
                 self._on_namespace_event, replay=False
             )
@@ -447,33 +451,45 @@ class ClusterThrottleController(ControllerBase):
                     self.enqueue(thr.key)
                     break
 
-    def _on_throttle_event(self, event: Event) -> None:
+    def _throttle_event_key(self, event: Event) -> Optional[str]:
         thr = event.obj
         if not self.is_responsible_for(thr):
-            return
+            return None
         if self._is_self_status_echo(event):
-            return  # our own in-flight status write; reconciling it is a no-op
-        self.enqueue(thr.key)
+            return None  # our own in-flight status write; reconciling it is a no-op
+        return thr.key
 
-    def _on_pod_event(self, event: Event) -> None:
+    def _on_throttle_event(self, event: Event) -> None:
+        key = self._throttle_event_key(event)
+        if key is not None:
+            self.enqueue(key)
+
+    def _on_throttle_events(self, events) -> None:
+        keys = [k for k in map(self._throttle_event_key, events) if k is not None]
+        if keys:
+            self.enqueue_all(keys)
+
+    def _pod_event_keys(self, event: Event):
+        """Per-event pod handling with the enqueue keys RETURNED (see
+        ThrottleController._pod_event_keys — the batch fan-out unions a
+        whole ingest burst into one workqueue lock hold)."""
         if event.type == EventType.ADDED:
             pod = event.obj
             if not self.should_count_in(pod):
-                return
-            self.enqueue_all(self._affected_keys_or_log(pod))
+                return None
+            return self._affected_keys_or_log(pod)
         elif event.type == EventType.MODIFIED:
             old_pod, new_pod = event.old_obj, event.obj
             if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
-                return
+                return None
             if self._selector_inputs_unchanged(old_pod, new_pod):
-                self.enqueue_all(self._affected_keys_or_log(new_pod))
-                return
+                return self._affected_keys_or_log(new_pod)
             try:
                 old_keys = set(self.affected_cluster_throttle_keys(old_pod))
                 new_keys = set(self.affected_cluster_throttle_keys(new_pod))
             except NotFoundError:
                 logger.exception("failed to get affected clusterthrottles for %s", new_pod.key)
-                return
+                return None
             moved_from = old_keys - new_keys
             moved_to = new_keys - old_keys
             if moved_from or moved_to:
@@ -481,17 +497,31 @@ class ClusterThrottleController(ControllerBase):
                 if self.device_manager is not None:
                     for key in moved_from | moved_to:
                         self.device_manager.on_reservation_change(self.KIND, key, self.cache)
-            self.enqueue_all(old_keys | new_keys)
+            return old_keys | new_keys
         else:  # DELETED
             pod = event.obj
             if not self.should_count_in(pod):
-                return
+                return None
             if pod.is_scheduled():
                 try:
                     self.unreserve(pod)
                 except Exception:
                     logger.exception("failed to unreserve deleted pod %s", pod.key)
-            self.enqueue_all(self._affected_keys_or_log(pod))
+            return self._affected_keys_or_log(pod)
+
+    def _on_pod_event(self, event: Event) -> None:
+        keys = self._pod_event_keys(event)
+        if keys:
+            self.enqueue_all(keys)
+
+    def _on_pod_events(self, events) -> None:
+        union: set = set()
+        for event in events:
+            keys = self._pod_event_keys(event)
+            if keys:
+                union.update(keys)
+        if union:
+            self.enqueue_all(union)
 
     def _affected_keys_or_log(self, pod: Pod) -> List[str]:
         try:

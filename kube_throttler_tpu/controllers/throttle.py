@@ -391,38 +391,59 @@ class ThrottleController(ControllerBase):
     # ---------------------------------------------------------- event wiring
 
     def _setup_event_handlers(self) -> None:
+        from .base import _BatchEventHandler
+
         if self.informers is not None:
             # shared-informer subscription (mustSetupEventHandler,
             # throttle_controller.go:400): the informer mirrors the store
             # into its indexer BEFORE fanning out, so lister reads from a
-            # handler always observe a cache >= the event
-            self.informers.throttles().add_event_handler(self._on_throttle_event)
-            self.informers.pods().add_event_handler(self._on_pod_event)
+            # handler always observe a cache >= the event. The batch
+            # wrappers let a micro-batched ingest burst fan out as ONE call
+            # with one workqueue lock hold (informers.on_batch probes for
+            # on_events).
+            self.informers.throttles().add_event_handler(
+                _BatchEventHandler(self._on_throttle_event, self._on_throttle_events)
+            )
+            self.informers.pods().add_event_handler(
+                _BatchEventHandler(self._on_pod_event, self._on_pod_events)
+            )
         else:
             self.store.add_event_handler("Throttle", self._on_throttle_event)
             self.store.add_event_handler("Pod", self._on_pod_event)
 
-    def _on_throttle_event(self, event: Event) -> None:
+    def _throttle_event_key(self, event: Event) -> Optional[str]:
         thr = event.obj
         if not self.is_responsible_for(thr):
-            return
+            return None
         if self._is_self_status_echo(event):
-            return  # our own in-flight status write; reconciling it is a no-op
-        self.enqueue(thr.key)
+            return None  # our own in-flight status write; reconciling it is a no-op
+        return thr.key
 
-    def _on_pod_event(self, event: Event) -> None:
+    def _on_throttle_event(self, event: Event) -> None:
+        key = self._throttle_event_key(event)
+        if key is not None:
+            self.enqueue(key)
+
+    def _on_throttle_events(self, events) -> None:
+        keys = [k for k in map(self._throttle_event_key, events) if k is not None]
+        if keys:
+            self.enqueue_all(keys)
+
+    def _pod_event_keys(self, event: Event):
+        """Per-event pod handling: reservation side effects run inline;
+        the keys to enqueue are RETURNED so the batch fan-out can union a
+        whole ingest burst into one workqueue lock hold."""
         if event.type == EventType.ADDED:
             pod = event.obj
             if not self.should_count_in(pod):
-                return
-            self.enqueue_all(self.affected_throttle_keys(pod))
+                return None
+            return self.affected_throttle_keys(pod)
         elif event.type == EventType.MODIFIED:
             old_pod, new_pod = event.old_obj, event.obj
             if not self.should_count_in(old_pod) and not self.should_count_in(new_pod):
-                return
+                return None
             if self._selector_inputs_unchanged(old_pod, new_pod):
-                self.enqueue_all(self.affected_throttle_keys(new_pod))
-                return
+                return self.affected_throttle_keys(new_pod)
             old_keys = set(self.affected_throttle_keys(old_pod))
             new_keys = set(self.affected_throttle_keys(new_pod))
             moved_from = old_keys - new_keys
@@ -434,11 +455,11 @@ class ThrottleController(ControllerBase):
                 if self.device_manager is not None:
                     for key in moved_from | moved_to:
                         self.device_manager.on_reservation_change(self.KIND, key, self.cache)
-            self.enqueue_all(old_keys | new_keys)
+            return old_keys | new_keys
         else:  # DELETED
             pod = event.obj
             if not self.should_count_in(pod):
-                return
+                return None
             if pod.is_scheduled():
                 # the deleted pod may still hold reservations
                 # (throttle_controller.go:508-519)
@@ -446,4 +467,18 @@ class ThrottleController(ControllerBase):
                     self.unreserve(pod)
                 except Exception:
                     logger.exception("failed to unreserve deleted pod %s", pod.key)
-            self.enqueue_all(self.affected_throttle_keys(pod))
+            return self.affected_throttle_keys(pod)
+
+    def _on_pod_event(self, event: Event) -> None:
+        keys = self._pod_event_keys(event)
+        if keys:
+            self.enqueue_all(keys)
+
+    def _on_pod_events(self, events) -> None:
+        union: set = set()
+        for event in events:
+            keys = self._pod_event_keys(event)
+            if keys:
+                union.update(keys)
+        if union:
+            self.enqueue_all(union)

@@ -27,7 +27,7 @@ import os
 import threading
 import time
 import weakref
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -158,6 +158,20 @@ def _host_classify_rows(rows, pod_req, pod_present, on_equal, step3_on_equal):
         ),
     )
     return np.where(valid, out, np.int8(CHECK_NOT_AFFECTED))
+
+
+_AGG_DEVICE_DELTAS: Optional[bool] = None
+
+
+def _agg_device_deltas() -> bool:
+    """True routes pending-delta bursts through the real
+    ``apply_pod_deltas_batched`` device kernel instead of its host mirror
+    (KT_AGG_DEVICE_DELTAS=1 — see _KindState.apply_pending_batched).
+    Resolved once; the parity test toggles the cache directly."""
+    global _AGG_DEVICE_DELTAS
+    if _AGG_DEVICE_DELTAS is None:
+        _AGG_DEVICE_DELTAS = os.environ.get("KT_AGG_DEVICE_DELTAS") == "1"
+    return _AGG_DEVICE_DELTAS
 
 
 _cls_lib = None
@@ -598,6 +612,30 @@ class _KindState:
             self._counted_dirty = True
         self._note_pod_row(row, before)
 
+    def set_pod_rows(self, plans) -> None:
+        """Batched :meth:`set_pod_row`: ``plans`` is
+        ``[(key, event, counted, count_in, entries)]`` for the upserted
+        pods of one ingest run. The index side goes through
+        ``upsert_pods_batch`` (one index-lock hold; label columns for the
+        whole run land before one re-match pass); the staging rows then
+        encode per pod exactly like the single path."""
+        if not plans:
+            return
+        rows = self.index.upsert_pods_batch([ev.obj for _, ev, _, _, _ in plans])
+        before = (self.pcap, self.R)
+        self.ensure_capacity()
+        for (key, ev, counted, count_in, entries), row in zip(plans, rows):
+            pod = ev.obj
+            self.pod_req, self.pod_present = self.encode_pod_requests_into(
+                self.pod_req, self.pod_present, row, pod, entries=entries
+            )
+            self.pod_valid[row] = True
+            self.count_in[row] = count_in
+            if self.counted[row] != counted:
+                self.counted[row] = counted
+                self._counted_dirty = True
+            self._note_pod_row(row, before)
+
     def remove_pod_row(self, key: str) -> None:
         row = self.index.pod_row(key)
         self.index.remove_pod(key)
@@ -834,6 +872,14 @@ class _KindState:
         the nonzero can be skipped. Only an optimization hint: counted /
         request changes are still re-read either way."""
         old, self._delta_old = self._delta_old, None
+        self.finish_pod_delta(pod_key, old, row_stable=row_stable)
+
+    def finish_pod_delta(self, pod_key: str, old, row_stable: bool = False) -> None:
+        """capture_pod_delta_end against an EXPLICITLY captured ``old``
+        contribution. The batched pod-event path holds one open capture per
+        distinct pod at once, which the single-slot ``_delta_old`` cannot;
+        it snapshots every old contribution first, applies the batch, then
+        finishes each delta through here."""
         if row_stable and old is not None:
             new = self._pod_contribution(pod_key, cols=old[0])
         else:
@@ -1060,32 +1106,81 @@ class _KindState:
             self.agg_req[arr] = req
             self.agg_contrib[arr] = ctb
         if pending:
-            # one vectorized exact-int64 pass over the whole burst:
-            # np.add.at handles repeated target cols across deltas, and a
-            # per-entry row matrix (padded to the current R — entries may
-            # predate an R growth) expands by each entry's col count. A
-            # per-entry Python loop of small adds measured ~16ms per
-            # 256-key drain at cfg5 max rate; this form is sub-ms.
-            R_cur = self.agg_req.shape[1]
-            n_ent = len(pending)
-            reqm = np.zeros((n_ent, R_cur), dtype=np.int64)
-            prem = np.zeros((n_ent, R_cur), dtype=np.int32)
-            counts = np.empty(n_ent, dtype=np.int64)
-            for i, (c, s, req, present) in enumerate(pending):
-                reqm[i, : req.shape[0]] = s * req
-                prem[i, : present.shape[0]] = s * present
-                counts[i] = c.size
-            all_cols = np.concatenate([c for c, _, _, _ in pending])
-            signs = np.repeat(
-                np.fromiter(
-                    (s for _, s, _, _ in pending), dtype=np.int64, count=n_ent
-                ),
-                counts,
+            self.apply_pending_batched(pending)
+
+    def _pending_batch_arrays(self, pending):
+        """Encode a pending-delta burst into the canonical batched-delta
+        form ``ops.aggregate.apply_pod_deltas_batched`` takes: per-event
+        target rows ``ids int32[N,K]`` (padded with tcap — dropped by the
+        scatter), ``sign int64[N,K]`` (0 on padding), and the event's
+        request row/presence ``[N,R]`` (padded to the CURRENT aggregate
+        width — entries may predate an R growth)."""
+        n_ent = len(pending)
+        R_cur = self.agg_req.shape[1]
+        K = max(c.size for c, _, _, _ in pending)
+        # pow2-bucket K so the device route's compiled shapes stay
+        # logarithmic (the host route is shape-indifferent)
+        kb = 4
+        while kb < max(K, 1):
+            kb *= 2
+        ids = np.full((n_ent, kb), self.tcap, dtype=np.int32)
+        sign = np.zeros((n_ent, kb), dtype=np.int64)
+        req = np.zeros((n_ent, R_cur), dtype=np.int64)
+        pres = np.zeros((n_ent, R_cur), dtype=bool)
+        for i, (c, s, r, p) in enumerate(pending):
+            ids[i, : c.size] = c
+            sign[i, : c.size] = s
+            req[i, : r.shape[0]] = r
+            pres[i, : p.shape[0]] = p
+        return ids, sign, req, pres
+
+    def apply_pending_batched(self, pending) -> None:
+        """Land a pending-delta burst (N pod events × ≤K affected columns)
+        in ONE batched scatter-add — the ingest-path wiring of
+        ``apply_pod_deltas_batched``, which until PR 5 only the sharded
+        tick used (parallel/sharded.py sharded_apply_deltas).
+
+        The aggregates are HOST-resident (see apply_agg_work), so the
+        default route is the kernel's exact host mirror: the same flattened
+        [N·K] scatter-add over the same (ids, sign, req, present) encoding
+        — np.add.at commutes and associates exactly in int64 like the
+        device scatter, and the parity is pinned by
+        tests/test_batch_ingest.py against the real kernel.
+        ``KT_AGG_DEVICE_DELTAS=1`` opts into dispatching the actual jitted
+        kernel instead (accelerator-resident aggregate experiments); both
+        routes are bit-identical by construction.
+
+        Caller holds the per-kind agg lock. A per-entry Python loop of
+        small adds measured ~16ms per 256-key drain at cfg5 max rate; this
+        form is sub-ms either way."""
+        if not pending:
+            return
+        ids, sign, req, pres = self._pending_batch_arrays(pending)
+        if _agg_device_deltas():
+            from ..ops.aggregate import apply_pod_deltas_batched
+
+            cnt, reqa, ctb = apply_pod_deltas_batched(
+                jnp.asarray(self.agg_cnt),
+                jnp.asarray(self.agg_req),
+                jnp.asarray(self.agg_contrib),
+                jnp.asarray(ids), jnp.asarray(sign),
+                jnp.asarray(req), jnp.asarray(pres),
             )
-            rows = np.repeat(np.arange(n_ent), counts)
-            np.add.at(self.agg_cnt, all_cols, signs)
-            np.add.at(self.agg_req, all_cols, reqm[rows])
-            np.add.at(self.agg_contrib, all_cols, prem[rows])
+            self.agg_cnt = np.asarray(cnt)
+            self.agg_req = np.asarray(reqa)
+            self.agg_contrib = np.asarray(ctb, dtype=np.int32)
+            return
+        flat_ids = ids.ravel()
+        flat_sign = sign.ravel()
+        valid = flat_ids < self.agg_cnt.shape[0]  # strip the tcap padding
+        rows = np.repeat(np.arange(len(pending)), ids.shape[1])[valid]
+        tgt = flat_ids[valid]
+        s = flat_sign[valid]
+        np.add.at(self.agg_cnt, tgt, s)
+        np.add.at(self.agg_req, tgt, s[:, None] * req[rows])
+        np.add.at(
+            self.agg_contrib, tgt, (s[:, None] * pres[rows]).astype(np.int32)
+        )
 
     def flush_agg(self) -> None:
         """Single-threaded convenience (tests): steal + apply in one go.
@@ -1203,8 +1298,14 @@ class DeviceStateManager:
         # (mesh, on_equal, step3) — rebuilding the jit wrapper per call
         # would recompile every tick
         self._sharded_steps: dict = {}
-        # (pod object, {kind: keys|None}) — see _on_pod; read lock-free
-        self._event_affected: Optional[tuple] = None
+        # {id(pod): (pod object, {kind: keys|None})} — see _handle_pod /
+        # _on_pod_run; read lock-free (swapped wholesale under the GIL)
+        self._event_affected: Optional[dict] = None
+        # {kind: workqueue.add_all_priority} wired by the plugin: the
+        # micro-batched ingest's single per-batch flip promotion
+        # (_promote_ingest_flips) pushes keys whose throttled flags just
+        # went stale straight into the controllers' priority lanes
+        self.flip_promoters: Dict[str, Callable] = {}
         # device circuit breaker: a failed dispatch (backend/tunnel died)
         # opens it for a cooldown so callers fall back to their host-oracle
         # paths instead of paying a failing dispatch per decision. The host
@@ -1243,6 +1344,10 @@ class DeviceStateManager:
         store.add_event_handler("Pod", self._on_pod)
         store.add_event_handler("Throttle", self._on_throttle)
         store.add_event_handler("ClusterThrottle", self._on_cluster_throttle)
+        # micro-batched ingest: one on_batch call per apply_events /
+        # batched status drain replaces the per-event handler calls above
+        # (they skip while store.in_batch_dispatch is set)
+        store.add_batch_listener(self)
 
     def _now_monotonic(self) -> float:
         return (self._monotonic or time.monotonic)()
@@ -1407,7 +1512,45 @@ class DeviceStateManager:
 
     # -- event wiring -----------------------------------------------------
 
+    def on_batch(self, events: List[Event]) -> None:
+        """Store batch-listener hook (one call per ``apply_events`` /
+        batched status drain, under the store lock): process the batch's
+        events in order, coalescing CONSECUTIVE Pod-event runs through the
+        batched mirror path (_on_pod_run — one main-lock hold, batched
+        index upsert, telescoped same-pod deltas), then — when any pod
+        deltas accumulated — land them in the aggregates via the batched
+        delta kernel encoding and promote the resulting flip candidates to
+        the controllers' priority lanes ONCE per batch
+        (_promote_ingest_flips). Per-event handlers re-fire afterwards with
+        ``store.in_batch_dispatch`` set; _on_pod & co. skip on it."""
+        run: List[Event] = []
+        saw_pods = False
+        for event in events:
+            if event.kind == "Pod":
+                run.append(event)
+                continue
+            if run:
+                self._on_pod_run(run)
+                run = []
+                saw_pods = True
+            if event.kind == "Namespace":
+                self._handle_namespace(event)
+            elif event.kind == "Throttle":
+                self._handle_any_throttle(self.throttle, event)
+            else:
+                self._handle_any_throttle(self.clusterthrottle, event)
+        if run:
+            self._on_pod_run(run)
+            saw_pods = True
+        if saw_pods and self.flip_promoters:
+            self._promote_ingest_flips()
+
     def _on_namespace(self, event: Event) -> None:
+        if self.store.in_batch_dispatch:
+            return  # already processed by on_batch
+        self._handle_namespace(event)
+
+    def _handle_namespace(self, event: Event) -> None:
         self._event_affected = None  # ns changes can re-route matching
         with self._lock:
             for ks in (self.throttle, self.clusterthrottle):
@@ -1425,6 +1568,11 @@ class DeviceStateManager:
             self.clusterthrottle.mark_full_rebase()
 
     def _on_pod(self, event: Event) -> None:
+        if self.store.in_batch_dispatch:
+            return  # already processed by on_batch
+        self._handle_pod(event)
+
+    def _handle_pod(self, event: Event) -> None:
         pod = event.obj
         count_in = (
             pod.spec.scheduler_name == self.target_scheduler_name and pod.is_scheduled()
@@ -1473,26 +1621,168 @@ class DeviceStateManager:
                 ks.capture_pod_delta_end(pod.key, row_stable=row_stable)
                 # no refresh_mask: a pod event only changes its own mask row,
                 # which the incremental row scatter ships
-                cols = ks.last_event_cols
-                if cols is None:
-                    affected[ks.kind] = None
-                else:
-                    ck = ks.index._col_keys
-                    affected[ks.kind] = [
-                        ck[c] for c in cols.tolist() if c in ck
-                    ]
+                affected[ks.kind] = self._affected_from_cols_locked(
+                    ks, pod, event.type, ks.last_event_cols
+                )
             # per-event affected-keys cache: the controllers' pod handlers
             # (and reserve/unreserve walks on the same stored object) query
             # affected_throttle_keys for THIS pod right after this handler,
             # each paying a main-lock round trip under drain contention for
             # a nonzero the delta capture above already did. Keyed by object
-            # identity (strong ref — no id() reuse), swapped atomically
-            # (tuple assignment under the GIL), invalidated by any event
-            # that can change pod↔throttle matching (throttle selector
-            # change/add/delete, namespace change).
-            self._event_affected = (pod, affected)
+            # identity (the entry holds a strong ref — no id() reuse),
+            # swapped atomically (dict assignment under the GIL),
+            # invalidated by any event that can change pod↔throttle
+            # matching (throttle selector change/add/delete, namespace
+            # change). The batched pod path publishes one entry per
+            # distinct pod of the batch through the same shape.
+            self._event_affected = {id(pod): (pod, affected)}
+
+    def _affected_from_cols_locked(self, ks: _KindState, pod, etype, cols):
+        """The event's affected-throttle key list for the per-event cache.
+        When the delta capture produced no cols (pod not counted — e.g.
+        Pending — or zero matches), the mask row is still authoritative for
+        any indexed pod, so read it directly: publishing None here sent
+        EVERY such query (notably the no-clusterthrottle common case) down
+        the locked fallback, a main-lock round trip per event per kind
+        under drain contention."""
+        if cols is None and etype != EventType.DELETED:
+            row = ks.index.pod_row(pod.key)
+            if row is not None:
+                cols = np.nonzero(ks.index.mask[row, :])[0]
+        if cols is None:
+            return None
+        ck = ks.index._col_keys
+        return [ck[c] for c in cols.tolist() if c in ck]
+
+    def _on_pod_run(self, events: List[Event]) -> None:
+        """Batched mirror update for a consecutive run of Pod events.
+
+        Same-pod events TELESCOPE: the aggregate delta of (old→v1) + (v1→v2)
+        equals (old→v2), and only the final version's staging row survives —
+        so each distinct pod is processed once, against its FIRST old
+        contribution and its FINAL object. Distinct pods' rows, captures,
+        and deltas are independent (a pod event touches only its own mask
+        row), so snapshot-all-olds → batch-apply → finish-all-deltas is
+        observably identical to per-event processing (property-tested in
+        tests/test_batch_ingest.py). The index upsert is the batched form:
+        label columns for the whole run land before one re-match pass."""
+        if len(events) == 1:
+            self._handle_pod(events[0])
+            return
+        finals: Dict[str, Event] = {}
+        stable: Dict[str, bool] = {}
+        for ev in events:
+            k = ev.obj.key
+            finals[k] = ev  # dict keeps first-seen order
+            # per-event label/ns stability chains: old_obj is the previous
+            # stored object, so all-stable links ⇒ first-old → final-new
+            # stable ⇒ the mask row never moved across the whole run
+            stable[k] = stable.get(k, True) and (
+                ev.type == EventType.MODIFIED
+                and ev.old_obj is not None
+                and ev.old_obj.labels == ev.obj.labels
+                and ev.old_obj.namespace == ev.obj.namespace
+            )
+        affected_cache: dict = {}
+        with self._lock:
+            for ev in events:
+                # evict the request-encode memo for EVERY version the batch
+                # carried, exactly like the per-event path
+                self._encode_cache.pop(id(ev.obj), None)
+                if ev.old_obj is not None:
+                    self._encode_cache.pop(id(ev.old_obj), None)
+            plans = []  # (key, final event, counted, count_in, entries)
+            for key, ev in finals.items():
+                pod = ev.obj
+                if ev.type == EventType.DELETED:
+                    plans.append((key, ev, False, False, None))
+                    continue
+                count_in = (
+                    pod.spec.scheduler_name == self.target_scheduler_name
+                    and pod.is_scheduled()
+                )
+                counted = count_in and pod.is_not_finished()
+                entries = [
+                    (self.dims.index_of(name), to_milli(q))
+                    for name, q in pod_request_resource_list(pod).items()
+                ]
+                plans.append((key, ev, counted, count_in, entries))
+            for ks in (self.throttle, self.clusterthrottle):
+                # phase 1: old contributions for every distinct pod (no
+                # mutation has happened yet, so these are the begin-side
+                # snapshots of every per-event capture)
+                olds = {key: ks._pod_contribution(key) for key in finals}
+                # phase 2: one batched row apply — deletions drop rows,
+                # upserts go through the index's batch path (one lock
+                # hold, label columns first, one re-match pass)
+                ks.set_pod_rows(
+                    [p for p in plans if p[1].type != EventType.DELETED]
+                )
+                for key, ev, _, _, _ in plans:
+                    if ev.type == EventType.DELETED:
+                        ks.remove_pod_row(key)
+                # phase 3: finish every delta against its old snapshot
+                for key, ev, _, _, _ in plans:
+                    pod = ev.obj
+                    row_stable = stable[key] and olds[key] is not None
+                    ks.finish_pod_delta(key, olds[key], row_stable=row_stable)
+                    entry = affected_cache.setdefault(id(pod), (pod, {}))
+                    entry[1][ks.kind] = self._affected_from_cols_locked(
+                        ks, pod, ev.type, ks.last_event_cols
+                    )
+            self._event_affected = affected_cache
+
+    def _promote_ingest_flips(self) -> None:
+        """ONE flip-candidate detection + ONE priority-lane promotion per
+        ingest batch: land the batch's accumulated pod deltas in the host
+        aggregates (apply_pending_batched — the batched delta kernel
+        encoding), reclassify against the published ``st_*`` planes, and
+        push every key whose flags just went stale into its controller's
+        priority lane. The promoted keys were already enqueued normal-lane
+        by the controllers' handlers, so add_all_priority MOVES them — the
+        flip overtakes the refresh backlog without waiting for the next
+        reconcile drain's classification pass.
+
+        Skips (leaving everything to the next reconcile's steal) whenever
+        a rebase is staged: recomputing a column — let alone the full
+        [P,T] scan — inside the store's dispatch would stall every
+        ingest producer behind it. Lock order: store (held by caller) →
+        agg → main, consistent with aggregate_used_for's agg → main."""
+        for kind in ("throttle", "clusterthrottle"):
+            promoter = self.flip_promoters.get(kind)
+            if promoter is None:
+                continue
+            ks = self._kind(kind)
+            keys: List[str] = []
+            with self._agg_locks[kind]:
+                with self._lock:
+                    shapes_ok = (
+                        ks.agg_cnt is not None
+                        and ks.agg_cnt.shape == (ks.tcap,)
+                        and ks.agg_req.shape == (ks.tcap, ks.R)
+                    )
+                    if (
+                        not shapes_ok
+                        or ks._agg_full_rebase
+                        or ks._agg_rebase_cols
+                        or not ks._agg_pending
+                    ):
+                        continue
+                    pending, ks._agg_pending = ks._agg_pending, []
+                ks.apply_pending_batched(pending)
+                cols = ks.flip_candidate_cols()
+                if cols.size:
+                    ck = ks.index._col_keys  # noqa: SLF001 — hint read
+                    keys = [ck[c] for c in cols.tolist() if c in ck]
+            if keys:
+                promoter(keys)
 
     def _on_any_throttle(self, ks: _KindState, event: Event) -> None:
+        if self.store.in_batch_dispatch:
+            return  # already processed by on_batch
+        self._handle_any_throttle(ks, event)
+
+    def _handle_any_throttle(self, ks: _KindState, event: Event) -> None:
         thr = event.obj
         responsible = thr.spec.throttler_name == self.throttler_name
         with self._lock:
@@ -1551,6 +1841,11 @@ class DeviceStateManager:
 
     def _on_cluster_throttle(self, event: Event) -> None:
         self._on_any_throttle(self.clusterthrottle, event)
+
+    def install_flip_promoters(self, promoters: Dict[str, Callable]) -> None:
+        """Wire {kind: add_all_priority} for the per-ingest-batch flip
+        promotion (the plugin calls this once the controllers exist)."""
+        self.flip_promoters = dict(promoters)
 
     def on_reservation_change(
         self, kind: str, throttle_key: str, cache: ReservedResourceAmounts
@@ -1613,10 +1908,12 @@ class DeviceStateManager:
         handler behind in-flight reconcile flushes (measured ~25% of
         remote-wire ingest cost at 10k×1k)."""
         cached = self._event_affected
-        if cached is not None and cached[0] is pod:
-            keys = cached[1].get(kind)
-            if keys is not None:
-                return list(keys)
+        if cached is not None:
+            entry = cached.get(id(pod))
+            if entry is not None and entry[0] is pod:
+                keys = entry[1].get(kind)
+                if keys is not None:
+                    return list(keys)
         with self._lock:
             return self._kind(kind).index.affected_throttle_keys_for(pod)
 

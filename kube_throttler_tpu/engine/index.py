@@ -209,60 +209,89 @@ class SelectorIndex:
         self.mask = grown_mask
         self._pcap = new_cap
 
+    def _upsert_pod_locked(self, pod: Pod) -> Tuple[int, bool]:
+        """Row assignment + label-column writes for one pod (no re-match).
+        Returns ``(row, needs_recompute)`` — False when the update could
+        not have moved the mask row (labels+namespace unchanged)."""
+        assert_held(self._lock, "SelectorIndex._upsert_pod_locked")
+        row = self._pod_rows.get(pod.key)
+        if row is None:
+            if self._free_rows:
+                row = self._free_rows.pop()
+            else:
+                row = len(self._pod_rows)
+                while row >= self._pcap:
+                    self._grow_pods_locked()
+            self._pod_rows[pod.key] = row
+        prev = self._row_pods.get(row)
+        if prev is not None and prev is not pod:
+            self._row_prev = (row, prev, self.mask[row, : self._tcap].copy())
+        self._row_pods[row] = pod
+        self._pod_valid[row] = True
+
+        # Selector matching reads only (pod.labels, pod.namespace) — the
+        # namespace-side inputs (existence, ns labels) are maintained by
+        # upsert_namespace, which recomputes affected rows itself. So a
+        # pod update that changes neither (the dominant churn shape:
+        # requests/status-only updates) cannot flip this mask row, and
+        # the O(T) column sweep is skipped entirely.
+        if (
+            prev is not None
+            and prev.labels == pod.labels
+            and prev.namespace == pod.namespace
+        ):
+            return row, False
+
+        self._pod_ns[row] = self._ns_ids.id_of(pod.namespace)
+        self._pod_ns_exists[row] = pod.namespace in self._namespaces
+
+        seen: Set[str] = set()
+        for key, value in pod.labels.items():
+            self._pod_col_array_locked(self._pod_label, key)[row] = self._values.id_of(value)
+            seen.add(key)
+        for key, arr in self._pod_label.items():
+            if key not in seen:
+                arr[row] = _MISSING
+
+        ns = self._namespaces.get(pod.namespace)
+        ns_labels = ns.labels if ns else {}
+        seen = set()
+        for key, value in ns_labels.items():
+            self._pod_col_array_locked(self._ns_label, key)[row] = self._values.id_of(value)
+            seen.add(key)
+        for key, arr in self._ns_label.items():
+            if key not in seen:
+                arr[row] = _MISSING
+        return row, True
+
     def upsert_pod(self, pod: Pod) -> int:
         """Insert or update a pod; recomputes its mask row. Returns the row."""
         with self._lock:
-            row = self._pod_rows.get(pod.key)
-            if row is None:
-                if self._free_rows:
-                    row = self._free_rows.pop()
-                else:
-                    row = len(self._pod_rows)
-                    while row >= self._pcap:
-                        self._grow_pods_locked()
-                self._pod_rows[pod.key] = row
-            prev = self._row_pods.get(row)
-            if prev is not None and prev is not pod:
-                self._row_prev = (row, prev, self.mask[row, : self._tcap].copy())
-            self._row_pods[row] = pod
-            self._pod_valid[row] = True
-
-            # Selector matching reads only (pod.labels, pod.namespace) — the
-            # namespace-side inputs (existence, ns labels) are maintained by
-            # upsert_namespace, which recomputes affected rows itself. So a
-            # pod update that changes neither (the dominant churn shape:
-            # requests/status-only updates) cannot flip this mask row, and
-            # the O(T) column sweep is skipped entirely.
-            if (
-                prev is not None
-                and prev.labels == pod.labels
-                and prev.namespace == pod.namespace
-            ):
-                return row
-
-            self._pod_ns[row] = self._ns_ids.id_of(pod.namespace)
-            self._pod_ns_exists[row] = pod.namespace in self._namespaces
-
-            seen: Set[str] = set()
-            for key, value in pod.labels.items():
-                self._pod_col_array_locked(self._pod_label, key)[row] = self._values.id_of(value)
-                seen.add(key)
-            for key, arr in self._pod_label.items():
-                if key not in seen:
-                    arr[row] = _MISSING
-
-            ns = self._namespaces.get(pod.namespace)
-            ns_labels = ns.labels if ns else {}
-            seen = set()
-            for key, value in ns_labels.items():
-                self._pod_col_array_locked(self._ns_label, key)[row] = self._values.id_of(value)
-                seen.add(key)
-            for key, arr in self._ns_label.items():
-                if key not in seen:
-                    arr[row] = _MISSING
-
-            self._recompute_row_locked(row)
+            row, recompute = self._upsert_pod_locked(pod)
+            if recompute:
+                self._recompute_row_locked(row)
             return row
+
+    def upsert_pods_batch(self, pods: Sequence[Pod]) -> List[int]:
+        """Batch upsert under ONE lock hold: every pod's label columns are
+        written FIRST, then one re-match pass recomputes exactly the rows
+        whose matching inputs moved. Correctness rests on row independence
+        — a row's re-match reads only its own label entries and the
+        compiled columns, so deferring it past the other pods' column
+        writes cannot change its result (the per-event path interleaves
+        them; both orders are property-tested equal). Returns the rows in
+        input order."""
+        with self._lock:
+            rows: List[int] = []
+            pending: List[int] = []
+            for pod in pods:
+                row, recompute = self._upsert_pod_locked(pod)
+                rows.append(row)
+                if recompute:
+                    pending.append(row)
+            for row in pending:
+                self._recompute_row_locked(row)
+            return rows
 
     def remove_pod(self, pod_key: str) -> None:
         with self._lock:

@@ -121,6 +121,11 @@ class StoreJournal:
         self._snapshotter = None
         self.snapshot_every = 0
         self._lines_since_snapshot = 0
+        # group-commit durability knob: True adds ONE fsync per batched
+        # write (still "at most one fsync per batch"); False (default)
+        # keeps the append path's flush-only durability, same as the
+        # single-event path (fsync happens at compaction/close)
+        self.fsync_batches = False
         # robustness counters (health probe + tests read these)
         self.replay_skipped = 0  # corrupted interior lines skipped on replay
         self.torn_tails = 0  # torn final lines truncated (normal crash artifact)
@@ -267,14 +272,135 @@ class StoreJournal:
 
     # -- live append ----------------------------------------------------------
 
-    def _on_event(self, event: Event) -> None:
-        line = json.dumps(
+    @staticmethod
+    def _encode(event: Event) -> str:
+        return json.dumps(
             {
                 "type": event.type.value,
                 "kind": event.kind,
                 "object": object_to_dict(event.obj),
             }
         )
+
+    def on_batch(self, events) -> None:
+        """GROUP COMMIT (store batch-listener hook): journal a whole ingest
+        batch as ONE buffered write + one flush (+ at most one fsync when
+        ``fsync_batches`` is set) instead of a write+flush syscall pair per
+        event.
+
+        Crash contract (tools/crashtest.py site ``crash.journal.group_commit``):
+        the batch's lines are concatenated in event order and handed to the
+        file in one write, so a crash anywhere inside the commit leaves a
+        strict PREFIX of the batch on disk — every complete line replays,
+        and only the FINAL surviving record can be torn (truncated by
+        recovery as the normal torn-tail artifact). No interior corruption
+        is possible because nothing is appended after the cut.
+
+        Per-line fault modes (``journal.append`` torn/error and the
+        per-append ``crash.journal.*`` kill sites) keep their single-event
+        meaning inside a batch: the buffer accumulated so far is flushed
+        before a kill fires, so the on-disk artifact matches the
+        event-by-event timeline."""
+        pieces: list = []
+        lines_added = 0
+        snapshotter = None
+        with self._lock:
+            if self._file is None:
+                return
+            for event in events:
+                line = self._encode(event)
+                fault = (
+                    self.faults.check("journal.append")
+                    if self.faults is not None
+                    else None
+                )
+                if self.faults is not None:
+                    crash = self.faults.check("crash.journal.append")
+                    if crash is not None and crash.mode == "kill":
+                        # die BEFORE this event's line exists — earlier batch
+                        # lines already reached the store, so they reach the
+                        # file first (the per-event timeline's artifact)
+                        self._write_pieces_locked(pieces)
+                        crash.kill()
+                    crash_torn = self.faults.check("crash.journal.torn")
+                    if crash_torn is not None and crash_torn.mode == "kill":
+                        pieces.append(line[: max(1, len(line) // 2)])
+                        self._write_pieces_locked(pieces)
+                        crash_torn.kill()
+                if fault is not None and fault.mode == "error":
+                    self.write_errors += 1
+                    continue
+                if fault is not None and fault.mode == "torn":
+                    # half the line, no newline: the NEXT buffered line
+                    # concatenates onto it — one corrupt interior line,
+                    # exactly the single-event torn artifact
+                    pieces.append(line[: max(1, len(line) // 2)])
+                    self.torn_writes += 1
+                    lines_added += 1
+                    continue
+                pieces.append(line + "\n")
+                lines_added += 1
+            if pieces:
+                crash_gc = (
+                    self.faults.check("crash.journal.group_commit")
+                    if self.faults is not None
+                    else None
+                )
+                if crash_gc is not None and crash_gc.mode == "kill":
+                    # die MID-COMMIT: half the batch buffer reaches the file
+                    # (cutting through a line), then SIGKILL — recovery must
+                    # see a clean prefix with one torn tail, zero divergence
+                    data = "".join(pieces)
+                    self._file.write(data[: max(1, len(data) // 2)])
+                    self._file.flush()
+                    crash_gc.kill()
+                self._write_pieces_locked(pieces)
+                if self.fsync_batches:
+                    try:
+                        os.fsync(self._file.fileno())
+                    except OSError:  # pragma: no cover — fsync race on close
+                        pass
+            self._lines += lines_added
+            if self._lines >= self.compact_after:
+                try:
+                    self._compact_locked()
+                except OSError:
+                    self.compact_failures += 1
+                    self._lines = 0
+                    logger.warning(
+                        "journal %s: compaction failed; keeping the "
+                        "uncompacted log and retrying later",
+                        self.path, exc_info=True,
+                    )
+            if self._snapshotter is not None and self.snapshot_every > 0:
+                self._lines_since_snapshot += lines_added
+                if self._lines_since_snapshot >= self.snapshot_every:
+                    self._lines_since_snapshot = 0
+                    snapshotter = self._snapshotter
+        if snapshotter is not None:
+            # outside the journal lock, inside the store's batch dispatch —
+            # same placement as the single-event trigger
+            snapshotter.snapshot_on_journal_trigger()
+
+    def _write_pieces_locked(self, pieces) -> None:
+        """One buffered write + flush of the accumulated batch lines, with
+        the running (bytes, sha256) position advanced to match. Caller
+        holds the journal lock."""
+        assert_held(self._lock, "StoreJournal._write_pieces_locked")
+        if not pieces:
+            return
+        data = "".join(pieces)
+        raw = data.encode("utf-8")
+        self._file.write(data)
+        self._file.flush()
+        self._sha.update(raw)
+        self._bytes += len(raw)
+        del pieces[:]
+
+    def _on_event(self, event: Event) -> None:
+        if self.store.in_batch_dispatch:
+            return  # already group-committed by on_batch
+        line = self._encode(event)
         fault = self.faults.check("journal.append") if self.faults is not None else None
         # crash points OUTSIDE the lock (SIGKILL never returns, but keeping
         # lock holds minimal keeps the site placement honest): before the
@@ -452,6 +578,7 @@ class StoreJournal:
     def close(self) -> None:
         for kind in Store.KINDS:
             self.store.remove_event_handler(kind, self._on_event)
+        self.store.remove_batch_listener(self)
         with self._lock:
             if self._file is not None:
                 self._file.flush()
@@ -516,4 +643,7 @@ def attach(
         journal._sha = end_sha
     for kind in Store.KINDS:
         store.add_event_handler(kind, journal._on_event, replay=False)
+    # batched mutations (micro-batched ingest, batched status drains) group-
+    # commit through on_batch; the per-event handler skips those dispatches
+    store.add_batch_listener(journal)
     return journal

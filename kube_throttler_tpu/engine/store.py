@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 from enum import Enum
-from typing import Callable, Dict, List, Optional, Union
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..api.pod import Namespace, Pod
 from ..api.types import ClusterThrottle, Throttle
@@ -51,6 +51,13 @@ class Event:
     kind: str  # "Pod" | "Namespace" | "Throttle" | "ClusterThrottle"
     obj: KObject
     old_obj: Optional[KObject] = None
+    # the resourceVersion assigned by the mutation that produced this event.
+    # Under BATCHED dispatch (apply_events / the batched status writes)
+    # handlers run after the whole batch has mutated, so reading
+    # ``store.latest_resource_version`` inside a handler would report the
+    # batch's LAST version for every event — consumers that stamp wire
+    # events (the mockserver's watch log) must read this field instead.
+    rv: Optional[int] = None
 
 
 Handler = Callable[[Event], None]
@@ -78,7 +85,16 @@ class Store:
         "_objects": "self._lock",
         "_versions": "self._lock",
         "_handlers": "self._lock",
+        "_batch_listeners": "self._lock",
+        "_in_batch_dispatch": "self._lock",
     }
+
+    # statuses written per lock hold by the batched status writers: one
+    # hold per drain (the pre-chunking behavior) kept event ingest parked
+    # behind a ~250-key write for tens of ms at full scale, which is
+    # exactly the flip-publication tail. Chunking bounds any single hold
+    # while keeping the per-drain amortization (~chunk× fewer acquires).
+    STATUS_WRITE_CHUNK = 64
 
     def __init__(self) -> None:
         self._lock = make_rlock("store")
@@ -86,6 +102,16 @@ class Store:
         self._objects: Dict[str, Dict[str, KObject]] = {k: {} for k in self.KINDS}
         self._versions: Dict[str, Dict[str, int]] = {k: {} for k in self.KINDS}
         self._handlers: Dict[str, List[Handler]] = {k: [] for k in self.KINDS}
+        # batch-aware subscribers (journal, device mirror, informers, batch
+        # watches): each gets ONE ``on_batch(events)`` call per batched
+        # mutation with the whole ordered event list, instead of N per-event
+        # handler calls — the micro-batch ingest amortization point
+        self._batch_listeners: List = []
+        # True while apply_events / the batched status write dispatches the
+        # batch's events to PER-EVENT handlers; batch-aware components'
+        # per-event handlers early-return on it (they already processed the
+        # batch in on_batch). Only ever read under the store lock.
+        self._in_batch_dispatch = False
 
     # -- watch ------------------------------------------------------------
 
@@ -117,6 +143,107 @@ class Store:
         for handler in list(self._handlers[event.kind]):
             handler(event)
 
+    # -- batch-aware subscription (micro-batched ingest) -------------------
+
+    def add_batch_listener(self, listener) -> None:
+        """Register a batch-aware subscriber: ``listener.on_batch(events)``
+        runs ONCE per batched mutation (``apply_events`` or the batched
+        status writes), under the store lock, after every mutation in the
+        batch has landed and BEFORE per-event handlers dispatch. A listener
+        whose per-event handlers are subsumed by its batch processing must
+        early-return from them while :attr:`in_batch_dispatch` is set."""
+        with self._lock:
+            self._batch_listeners.append(listener)
+
+    def remove_batch_listener(self, listener) -> None:
+        with self._lock:
+            try:
+                self._batch_listeners.remove(listener)
+            except ValueError:
+                pass
+
+    @property
+    def in_batch_dispatch(self) -> bool:
+        """True while per-event handlers are being replayed for a batch a
+        batch listener already processed. Handlers are only ever called
+        under the store lock (asserted under KT_LOCK_ASSERT=1), so the
+        read is coherent."""
+        return self._in_batch_dispatch_locked()
+
+    def _in_batch_dispatch_locked(self) -> bool:
+        assert_held(self._lock, "Store.in_batch_dispatch")
+        return self._in_batch_dispatch
+
+    def _dispatch_batch_locked(self, events: List[Event]) -> None:
+        """Batch dispatch: batch listeners first (one call each, whole
+        ordered list), then the regular per-event handlers with
+        ``in_batch_dispatch`` raised so batch-subsumed handlers skip."""
+        assert_held(self._lock, "Store._dispatch_batch_locked")
+        if not events:
+            return
+        for listener in list(self._batch_listeners):
+            listener.on_batch(events)
+        self._in_batch_dispatch = True
+        try:
+            for event in events:
+                self._dispatch_locked(event)
+        finally:
+            self._in_batch_dispatch = False
+
+    def apply_events(self, ops: Sequence[Tuple[str, str, object]]) -> List[object]:
+        """Apply N mutations under ONE lock acquisition — the micro-batched
+        ingest entry point (engine/ingest.py drains its queue into this).
+
+        ``ops`` is an ordered sequence of ``(verb, kind, payload)``:
+
+        - ``("create", kind, obj)`` / ``("update", kind, obj)`` — the exact
+          single-op semantics (create raises on exists, update on missing);
+        - ``("upsert", kind, obj)`` — create-else-update (the watch-replay
+          shape reflectors and journals apply);
+        - ``("delete", kind, key)`` — delete by store key (also accepts the
+          object for convenience).
+
+        Returns one entry per op: the dispatched :class:`Event` on success
+        or the raised exception (per-op failures never abort the batch —
+        the events before AND after a bad op still land, so a batch is
+        observably a sequence of independent mutations).
+
+        Equivalence contract: for any partition of an op stream into
+        batches, the final store contents, assigned resourceVersions, and
+        the per-event handler event sequence are identical to applying the
+        ops one at a time. What batching changes is only WHEN handlers run
+        (after the whole batch mutated, not interleaved per op) and that
+        batch listeners get one amortized call per batch."""
+        results: List[object] = []
+        events: List[Event] = []
+        with self._lock:
+            for op in ops:
+                try:
+                    event = self._apply_op_locked(*op)
+                except Exception as e:  # noqa: BLE001 — reported per op
+                    results.append(e)
+                    continue
+                events.append(event)
+                results.append(event)
+            self._dispatch_batch_locked(events)
+        return results
+
+    def _apply_op_locked(self, verb: str, kind: str, payload) -> Event:
+        assert_held(self._lock, "Store._apply_op_locked")
+        if verb == "delete":
+            key = payload if isinstance(payload, str) else _key_of(kind, payload)
+            return self._delete_locked(kind, key)
+        if verb == "create":
+            return self._create_locked(kind, payload)
+        if verb == "update":
+            return self._update_locked(kind, payload)
+        if verb == "upsert":
+            try:
+                return self._create_locked(kind, payload)
+            except ValueError:
+                return self._update_locked(kind, payload)
+        raise ValueError(f"unknown ingest verb {verb!r}")
+
     # -- generic mutations ------------------------------------------------
 
     # NOTE: dispatch happens INSIDE the store lock. Releasing before dispatch
@@ -127,38 +254,51 @@ class Store:
     # their own lock while mutating the store from another thread (lock order
     # is store → handler-internal, established here).
 
+    def _create_locked(self, kind: str, obj: KObject) -> Event:
+        assert_held(self._lock, "Store._create_locked")
+        key = _key_of(kind, obj)
+        if key in self._objects[kind]:
+            raise ValueError(f"{kind} {key!r} already exists")
+        self._rv += 1
+        self._objects[kind][key] = obj
+        self._versions[kind][key] = self._rv
+        return Event(EventType.ADDED, kind, obj, rv=self._rv)
+
+    def _update_locked(self, kind: str, obj: KObject) -> Event:
+        assert_held(self._lock, "Store._update_locked")
+        key = _key_of(kind, obj)
+        old = self._objects[kind].get(key)
+        if old is None:
+            raise NotFoundError(f"{kind} {key!r} not found")
+        self._rv += 1
+        self._objects[kind][key] = obj
+        self._versions[kind][key] = self._rv
+        return Event(EventType.MODIFIED, kind, obj, old_obj=old, rv=self._rv)
+
+    def _delete_locked(self, kind: str, key: str) -> Event:
+        assert_held(self._lock, "Store._delete_locked")
+        old = self._objects[kind].pop(key, None)
+        if old is None:
+            raise NotFoundError(f"{kind} {key!r} not found")
+        self._versions[kind].pop(key, None)
+        self._rv += 1
+        return Event(EventType.DELETED, kind, old, rv=self._rv)
+
     def _create(self, kind: str, obj: KObject) -> KObject:
         with self._lock:
-            key = _key_of(kind, obj)
-            if key in self._objects[kind]:
-                raise ValueError(f"{kind} {key!r} already exists")
-            self._rv += 1
-            self._objects[kind][key] = obj
-            self._versions[kind][key] = self._rv
-            self._dispatch_locked(Event(EventType.ADDED, kind, obj))
+            self._dispatch_locked(self._create_locked(kind, obj))
         return obj
 
     def _update(self, kind: str, obj: KObject) -> KObject:
         with self._lock:
-            key = _key_of(kind, obj)
-            old = self._objects[kind].get(key)
-            if old is None:
-                raise NotFoundError(f"{kind} {key!r} not found")
-            self._rv += 1
-            self._objects[kind][key] = obj
-            self._versions[kind][key] = self._rv
-            self._dispatch_locked(Event(EventType.MODIFIED, kind, obj, old_obj=old))
+            self._dispatch_locked(self._update_locked(kind, obj))
         return obj
 
     def _delete(self, kind: str, key: str) -> KObject:
         with self._lock:
-            old = self._objects[kind].pop(key, None)
-            if old is None:
-                raise NotFoundError(f"{kind} {key!r} not found")
-            self._versions[kind].pop(key, None)
-            self._rv += 1
-            self._dispatch_locked(Event(EventType.DELETED, kind, old))
-        return old
+            event = self._delete_locked(kind, key)
+            self._dispatch_locked(event)
+        return event.obj
 
     def _get(self, kind: str, key: str) -> KObject:
         with self._lock:
@@ -290,35 +430,47 @@ class Store:
             self._rv += 1
             self._objects["Throttle"][key] = updated
             self._versions["Throttle"][key] = self._rv
-            self._dispatch_locked(Event(EventType.MODIFIED, "Throttle", updated, old_obj=current))
+            self._dispatch_locked(
+                Event(EventType.MODIFIED, "Throttle", updated, old_obj=current, rv=self._rv)
+            )
         return updated
 
     def _update_statuses_locked(self, kind: str, thrs) -> Dict[str, object]:
         """Batched UpdateStatus under ONE lock hold: at reconcile-drain
         saturation, per-key writes made every status contend with the
         event-ingest threads for this lock ~hundreds of times per drain;
-        one hold writes the whole drain's worth. Handlers still dispatch
-        per event inside the hold, preserving resourceVersion order.
-        Returns {key: updated object | Exception} — per-key failures don't
-        fail the batch."""
+        one hold writes the whole drain's worth. Dispatch is BATCHED
+        (``_dispatch_batch_locked``): batch listeners — the journal's group
+        commit, the device mirror's one-hold echo pass — get the drain's
+        events in one call; per-event handlers still see every event in
+        resourceVersion order. Returns {key: updated object | Exception} —
+        per-key failures don't fail the batch."""
         out: Dict[str, object] = {}
-        with self._lock:
-            for thr in thrs:
-                key = _key_of(kind, thr)
-                try:
-                    current = self._objects[kind].get(key)
-                    if current is None:
-                        raise NotFoundError(f"{kind} {key!r} not found")
-                    updated = current.with_status(thr.status)
-                    self._rv += 1
-                    self._objects[kind][key] = updated
-                    self._versions[kind][key] = self._rv
-                    self._dispatch_locked(
-                        Event(EventType.MODIFIED, kind, updated, old_obj=current)
-                    )
-                    out[key] = updated
-                except Exception as e:  # noqa: BLE001 — reported per key
-                    out[key] = e
+        thrs = list(thrs)
+        chunk = max(1, int(self.STATUS_WRITE_CHUNK))
+        for s in range(0, len(thrs), chunk):
+            events: List[Event] = []
+            with self._lock:
+                for thr in thrs[s : s + chunk]:
+                    key = _key_of(kind, thr)
+                    try:
+                        current = self._objects[kind].get(key)
+                        if current is None:
+                            raise NotFoundError(f"{kind} {key!r} not found")
+                        updated = current.with_status(thr.status)
+                        self._rv += 1
+                        self._objects[kind][key] = updated
+                        self._versions[kind][key] = self._rv
+                        events.append(
+                            Event(
+                                EventType.MODIFIED, kind, updated,
+                                old_obj=current, rv=self._rv,
+                            )
+                        )
+                        out[key] = updated
+                    except Exception as e:  # noqa: BLE001 — reported per key
+                        out[key] = e
+                self._dispatch_batch_locked(events)
         return out
 
     def update_throttle_statuses(self, thrs) -> Dict[str, object]:
@@ -344,7 +496,10 @@ class Store:
             self._objects["ClusterThrottle"][key] = updated
             self._versions["ClusterThrottle"][key] = self._rv
             self._dispatch_locked(
-                Event(EventType.MODIFIED, "ClusterThrottle", updated, old_obj=current)
+                Event(
+                    EventType.MODIFIED, "ClusterThrottle", updated,
+                    old_obj=current, rv=self._rv,
+                )
             )
         return updated
 

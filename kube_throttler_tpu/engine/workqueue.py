@@ -180,15 +180,18 @@ class RateLimitingQueue:
             if added:
                 self._cond.notify()
 
-    def _pop_ready_locked(self) -> Optional[str]:
+    def _pop_ready_locked(self, hi_only: bool = False) -> Optional[Tuple[str, bool]]:
         """Caller holds the lock (the `_locked` contract — asserted under
-        KT_LOCK_ASSERT=1). Priority lane first."""
+        KT_LOCK_ASSERT=1). Priority lane first; ``hi_only`` refuses to touch
+        the normal lane (the flip express drain). Returns (item, was_hi)."""
         assert_held(self._lock, "RateLimitingQueue._pop_ready_locked")
         if self._queue_hi:
             item = self._queue_hi.pop(0)
             self._hi.discard(item)
-        elif self._queue:
+            was_hi = True
+        elif self._queue and not hi_only:
             item = self._queue.pop(0)
+            was_hi = False
         else:
             return None
         self._processing.add(item)
@@ -196,10 +199,16 @@ class RateLimitingQueue:
         ts = self._enqueue_ts.pop(item, None)
         if ts is not None:
             self._claim_ts[item] = ts
-        return item
+        return item, was_hi
 
     def get(self, timeout: Optional[float] = None) -> str:
         """Blocks until an item is available. Raises ShutDown."""
+        return self.get_lane(timeout)[0]
+
+    def get_lane(self, timeout: Optional[float] = None) -> Tuple[str, bool]:
+        """``get`` plus which lane the item came from (True = priority) —
+        the workers use the lane to shape the drain (a priority first-key
+        triggers the flip express drain, controllers/base._drain_more)."""
         with self._cond:
             while not (self._queue or self._queue_hi) and not self._shutdown:
                 # untimed callers still wake on every add/done/shutdown
@@ -211,10 +220,12 @@ class RateLimitingQueue:
                 raise ShutDown
             return self._pop_ready_locked()
 
-    def try_get(self) -> Optional[str]:
-        """Non-blocking get: an immediately-ready item or None (batch drain)."""
+    def try_get(self, hi_only: bool = False) -> Optional[str]:
+        """Non-blocking get: an immediately-ready item or None (batch
+        drain). ``hi_only`` drains the priority lane exclusively."""
         with self._cond:
-            return self._pop_ready_locked()
+            popped = self._pop_ready_locked(hi_only=hi_only)
+            return popped[0] if popped is not None else None
 
     def claim_ts(self, item: str) -> Optional[float]:
         """Monotonic time of the first add that made the in-flight ``item``

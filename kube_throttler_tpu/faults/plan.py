@@ -42,12 +42,19 @@ journal.append              mode "torn" writes half the line (interior
                             the write
 journal.fsync               OSError during compaction fsync
 device.dispatch             dispatch raises (opens the circuit breaker)
+ingest.batch.partial        one op of a micro-batch fails mid-apply
+                            (engine/ingest.py splits around it; the ops
+                            before and after still land)
 crash.journal.append        SIGKILL before the event's journal line is
                             written (event reached the store, not the log)
 crash.journal.torn          half the line is written+flushed, then SIGKILL
                             (the canonical torn-final-line crash artifact)
 crash.journal.compact       SIGKILL right after the compacted log replaces
                             the live one (snapshot journal offsets stale)
+crash.journal.group_commit  SIGKILL mid group-commit write: half the batch
+                            buffer reaches the file (cut mid-line), so
+                            recovery must see a clean batch prefix with
+                            one torn tail (engine/journal.py on_batch)
 crash.snapshot.begin        SIGKILL before a snapshot write starts
 crash.snapshot.tmp_partial  SIGKILL with half the snapshot tmp file flushed
 crash.snapshot.pre_rename   SIGKILL after tmp fsync, before the atomic
@@ -99,9 +106,11 @@ KNOWN_SITES = frozenset(
         "journal.append",
         "journal.fsync",
         "device.dispatch",
+        "ingest.batch.partial",
         "crash.journal.append",
         "crash.journal.torn",
         "crash.journal.compact",
+        "crash.journal.group_commit",
         "crash.snapshot.begin",
         "crash.snapshot.tmp_partial",
         "crash.snapshot.pre_rename",

@@ -67,6 +67,9 @@ METRIC_NAMES = frozenset(
         "kube_throttler_watch_streams_open",
         "kube_throttler_watch_queue_depth",
         "kube_throttler_watch_overflow_total",
+        # micro-batched ingest (register_ingest_metrics / engine/ingest.py)
+        "kube_throttler_ingest_batch_size",
+        "kube_throttler_ingest_events_total",
         # reflector counters (client/transport.py ReflectorMetrics)
         "kube_throttler_reflector_lists_total",
         "kube_throttler_reflector_watches_total",
@@ -545,6 +548,28 @@ def register_recovery_metrics(
             rec_divergence.set_key((), float(r.divergences))
 
     registry.register_pre_expose(flush)
+
+
+def register_ingest_metrics(registry: Registry, pipeline) -> None:
+    """Micro-batched ingest observability (engine/ingest.py), exported on
+    whatever registry the daemon serves — local standalone and remote mode
+    both build their pipeline with the process registry, so the families
+    appear on both paths. The batch-size histogram is observed inline by
+    the dispatcher (one observe per drain — scrape-time sampling would
+    miss the distribution); the events counter moves with it."""
+    pipeline._batch_hist = registry.histogram_vec(
+        "kube_throttler_ingest_batch_size",
+        "events applied per micro-batch drain (1 = the unloaded "
+        "single-event path; growth means the adaptive batcher is absorbing "
+        "backlog)",
+        [],
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+    )
+    pipeline._events_ctr = registry.counter_vec(
+        "kube_throttler_ingest_events_total",
+        "events ingested through the micro-batch pipeline",
+        [],
+    )
 
 
 def register_watch_metrics(registry: Registry) -> None:

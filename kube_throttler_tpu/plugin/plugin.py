@@ -134,6 +134,16 @@ class KubeThrottler:
                 "throttle": self.throttle_ctr.cache,
                 "clusterthrottle": self.cluster_throttle_ctr.cache,
             }
+            # micro-batched ingest: each batch's single flip-candidate pass
+            # promotes stale-flag keys straight into the priority lanes
+            # (one add_all_priority per kind per batch — devicestate
+            # _promote_ingest_flips)
+            self.device_manager.install_flip_promoters(
+                {
+                    "throttle": self.throttle_ctr.workqueue.add_all_priority,
+                    "clusterthrottle": self.cluster_throttle_ctr.workqueue.add_all_priority,
+                }
+            )
         self.throttle_ctr.tracer = self.tracer
         self.cluster_throttle_ctr.tracer = self.tracer
         # local-path flip/total status-lag histograms; a lane-aware remote

@@ -53,6 +53,7 @@ CRASH_SITES = (
     "crash.journal.append",
     "crash.journal.torn",
     "crash.journal.compact",
+    "crash.journal.group_commit",
     "crash.snapshot.begin",
     "crash.snapshot.tmp_partial",
     "crash.snapshot.pre_rename",
@@ -74,6 +75,10 @@ def default_hit(site: str, seed: int) -> int:
     use small indices so each seed crashes a different occurrence."""
     if site in ("crash.journal.append", "crash.journal.torn"):
         return 10 + 37 * seed
+    if site == "crash.journal.group_commit":
+        # hit once per micro-batch group commit (~a third of events flow
+        # through batches): die at different batches per seed
+        return 2 + 3 * seed
     return 1 + seed
 
 
@@ -200,24 +205,33 @@ def run_child(args) -> int:
             pass  # recovered from a previous run
         throttles.append(f"t{i}")
 
+    def _mk_pod():
+        i = rng.randrange(N_THROTTLES)
+        pod = make_pod(
+            f"p{rng.randrange(10**9)}",
+            labels={"grp": f"g{i}"},
+            requests={"cpu": f"{rng.randrange(100, 900)}m"},
+        )
+        if rng.random() < 0.5:
+            pod = replace(pod, spec=replace(pod.spec, node_name="node-1"))
+            pod.status.phase = "Running"
+        return pod
+
     for _step in range(args.events):
         op = rng.random()
-        if op < 0.35:  # create a pod (some born Running)
-            i = rng.randrange(N_THROTTLES)
-            pod = make_pod(
-                f"p{rng.randrange(10**9)}",
-                labels={"grp": f"g{i}"},
-                requests={"cpu": f"{rng.randrange(100, 900)}m"},
-            )
-            if rng.random() < 0.5:
-                pod = replace(
-                    pod, spec=replace(pod.spec, node_name="node-1")
+        if op < 0.35:  # create pod(s) — a third arrive as one MICRO-BATCH
+            if rng.random() < 0.35:
+                # the batched ingest path: one store.apply_events per burst
+                # → the journal GROUP COMMITS it (one buffered write), and
+                # site crash.journal.group_commit can die mid-commit
+                store.apply_events(
+                    [("upsert", "Pod", _mk_pod()) for _ in range(rng.randrange(2, 6))]
                 )
-                pod.status.phase = "Running"
-            try:
-                store.create_pod(pod)
-            except ValueError:
-                pass
+            else:
+                try:
+                    store.create_pod(_mk_pod())
+                except ValueError:
+                    pass
         elif op < 0.5:  # bind a pending pod
             pods = [
                 p for p in store.list_pods("default") if p.status.phase == "Pending"
