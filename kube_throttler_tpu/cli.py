@@ -184,6 +184,34 @@ def main(argv: Optional[list] = None) -> int:
         "apiserver instead — multi-host capable)",
     )
     serve.add_argument(
+        "--ha-role",
+        choices=("none", "leader", "standby"),
+        default="none",
+        help="active/standby HA for the standalone store (docs/robustness.md "
+        "'High availability & fencing'): 'leader' acquires the lease, bumps "
+        "the fencing epoch, and serves replication endpoints for warm "
+        "standbys; 'standby' bootstraps from --replicate-from, streams the "
+        "journal tail into its own --data-dir while /readyz reports "
+        "standby, and promotes itself when the lease frees. Both imply "
+        "--leader-elect and require --data-dir",
+    )
+    serve.add_argument(
+        "--replicate-from",
+        default="",
+        help="standby only: the leader's HTTP base URL (its --host:--port); "
+        "snapshot bootstrap + journal tail stream come from its "
+        "/v1/replication endpoints",
+    )
+    serve.add_argument(
+        "--lease-backend",
+        choices=("auto", "file", "http"),
+        default="auto",
+        help="leadership lease backend: 'file' (flock, single host — the "
+        "OS frees it when the leader dies), 'http' (a coordination.k8s.io "
+        "Lease on the --kubeconfig apiserver, multi-host), or 'auto' "
+        "(http when a kubeconfig is given and no --lock-file, else file)",
+    )
+    serve.add_argument(
         "--nodes",
         type=int,
         default=0,
@@ -290,6 +318,29 @@ def main(argv: Optional[list] = None) -> int:
             "run an external scheduler against /v1/prefilter instead"
         )
 
+    # HA flag surface (usage errors before any heavy startup)
+    if args.ha_role != "none":
+        if not args.data_dir:
+            parser.error("--ha-role requires --data-dir (the replicated "
+                         "journal + snapshots live there)")
+        if plugin_args.kubeconfig:
+            parser.error(
+                "--ha-role is for the STANDALONE store; in --kubeconfig "
+                "mode the apiserver is the state of record and plain "
+                "--leader-elect active/standby already applies"
+            )
+        leader_elect = True
+    if args.ha_role == "standby":
+        if not args.replicate_from:
+            parser.error("--ha-role standby requires --replicate-from "
+                         "(the leader's HTTP base URL)")
+        if args.nodes > 0:
+            parser.error("--nodes cannot run on a standby: the embedded "
+                         "scheduler would bind pods before promotion")
+    if args.lease_backend == "http" and not plugin_args.kubeconfig:
+        parser.error("--lease-backend http requires --kubeconfig (the "
+                     "Lease object lives on that apiserver)")
+
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
@@ -319,8 +370,15 @@ def main(argv: Optional[list] = None) -> int:
             return 1
 
     elector = None
+    # demotion hooks run on leadership loss BEFORE the stop event fires —
+    # the fencing epoch (created later, once the data dir is open) appends
+    # one so a deposed leader's writes are refused even while draining
+    fence_hooks: list = []
     if leader_elect:
-        if rest_config is not None and not args.lock_file:
+        backend = args.lease_backend
+        if backend == "auto":
+            backend = "http" if (rest_config is not None and not args.lock_file) else "file"
+        if backend == "http":
             # multi-host: a coordination.k8s.io Lease on the shared
             # apiserver — replicas on different hosts compete for it, like
             # the reference's embedded kube-scheduler leader election
@@ -331,7 +389,10 @@ def main(argv: Optional[list] = None) -> int:
 
             def _leadership_lost():
                 # fail fast like the embedded kube-scheduler: a demoted
-                # leader must stop serving (a standby has taken over)
+                # leader must stop serving (a standby has taken over) —
+                # and must stop WRITING first (fencing)
+                for hook in fence_hooks:
+                    hook()
                 print("leadership lost; shutting down", file=sys.stderr, flush=True)
                 stop.set()
 
@@ -355,12 +416,15 @@ def main(argv: Optional[list] = None) -> int:
             lock_path = args.lock_file or default_lease_path(plugin_args.name)
             elector = FileLeaseElector(lock_path)
             print(f"leader election on {lock_path}: waiting for lease...", flush=True)
-        try:
-            if not elector.acquire(stop):
-                return 0  # interrupted while standing by
-        except RuntimeError as e:
-            print(str(e), file=sys.stderr, flush=True)
-            return 1
+        if args.ha_role != "standby":
+            # a standby replicates FIRST and blocks on the lease later;
+            # everyone else gates startup on acquisition, as before
+            try:
+                if not elector.acquire(stop):
+                    return 0  # interrupted while standing by
+            except RuntimeError as e:
+                print(str(e), file=sys.stderr, flush=True)
+                return 1
 
     store = Store()
     session = None
@@ -368,6 +432,11 @@ def main(argv: Optional[list] = None) -> int:
     recovery = None
     snapshotter = None
     ingest_pipeline = None
+    ha = None
+    epoch = None
+    replicator = None
+    standby_server = None
+    promoted = False
     from .metrics import Registry
 
     metrics_registry = Registry()  # shared: reflector metrics + the 16 families
@@ -418,6 +487,79 @@ def main(argv: Optional[list] = None) -> int:
                 f"{len(store.list_throttles())} throttles recovered)",
                 flush=True,
             )
+        if args.ha_role != "none":
+            # HA wiring (docs/robustness.md "High availability & fencing"):
+            # the fencing epoch gates the journal and snapshots; the
+            # coordinator carries role/epoch for /readyz and metrics
+            from .engine.replication import (
+                FencingEpoch,
+                HaCoordinator,
+                ReplicationSource,
+                StandbyReplicator,
+            )
+
+            epoch = FencingEpoch(args.data_dir)
+            epoch.observe(recovery.report.epoch)
+            fence_hooks.append(lambda: epoch.fence("leadership lost"))
+            journal.fencing = epoch
+            snapshotter.fencing = epoch
+            if args.ha_role == "standby":
+                replicator = StandbyReplicator(
+                    store, journal, args.replicate_from, epoch=epoch
+                )
+                ha = HaCoordinator(
+                    epoch, role="standby", replicator=replicator,
+                    journal=journal, snapshotter=snapshotter,
+                )
+                # the standby SERVES its role from the real port while
+                # replicating: /readyz 503 {"state": "standby", ...},
+                # admission endpoints refused until promotion
+                standby_server = ThrottlerHTTPServer(
+                    None, host=args.host, port=args.port, ha=ha
+                )
+                standby_server.start()
+                print(
+                    f"standby on {args.host}:{standby_server.port} "
+                    f"replicating from {args.replicate_from}",
+                    flush=True,
+                )
+                if not replicator.bootstrap(deadline_s=60.0):
+                    print(
+                        "standby bootstrap failed: leader unreachable at "
+                        f"{args.replicate_from}", file=sys.stderr, flush=True,
+                    )
+                    standby_server.stop()
+                    journal.close()
+                    return 1
+                replicator.start()
+                print(
+                    f"standby synced (offset={replicator.consumed_offset()}, "
+                    f"events={replicator.events_applied}); standing by",
+                    flush=True,
+                )
+                if not elector.acquire(stop):
+                    # interrupted while standing by: clean exit
+                    replicator.stop()
+                    standby_server.stop()
+                    journal.close()
+                    return 0
+                new_epoch = ha.promote()
+                promoted = True
+                print(
+                    f"promoted to leader (epoch {new_epoch}, tail "
+                    f"fast-forward {ha.failover_duration_s:.3f}s)",
+                    flush=True,
+                )
+            else:
+                ha = HaCoordinator(
+                    epoch, role="leader", journal=journal,
+                    snapshotter=snapshotter,
+                )
+                ha.become_leader()
+                print(f"leading with fencing epoch {epoch.current()}", flush=True)
+            # either way this replica now leads: serve the replication
+            # endpoints so (new) standbys can bootstrap and stream
+            ha.source = ReplicationSource(args.data_dir, journal, epoch)
         if store.get_namespace("default") is None:
             store.create_namespace(Namespace("default"))
         # standalone mode: the micro-batch ingest front-end over the local
@@ -480,6 +622,11 @@ def main(argv: Optional[list] = None) -> int:
         # the rest of the crash-safety wiring needs the plugin: reservation
         # ledgers live on the controllers, and the first-relist reconcile
         # compares the rebuilt device planes against the informer caches
+        if replicator is not None and recovery.snapshot is None:
+            # a fresh standby has no local snapshot — standing reservations
+            # come from the leader's bootstrap snapshot (TTLs rebased
+            # against OUR clock inside restore_reservations)
+            recovery.snapshot = replicator.bootstrap_snapshot
         reservation_caches = {
             "throttle": plugin.throttle_ctr.cache,
             "clusterthrottle": plugin.cluster_throttle_ctr.cache,
@@ -515,6 +662,21 @@ def main(argv: Optional[list] = None) -> int:
         from .metrics import register_recovery_metrics
 
         register_recovery_metrics(metrics_registry, snapshotter, recovery)
+    if ha is not None:
+        plugin.health.register("ha", ha.health_state)
+        from .metrics import register_ha_metrics
+
+        register_ha_metrics(metrics_registry, ha)
+        if promoted:
+            # flip re-publication: every key reconciles against replicated
+            # truth, so flips the dead leader computed but never durably
+            # published are re-derived and go out through the two-lane
+            # pipeline's priority path
+            n_keys = ha.promote_reconcile(plugin)
+            print(
+                f"promotion reconcile: {n_keys} keys re-enqueued "
+                "(flips publish first)", flush=True,
+            )
     scheduler = None
     if args.nodes > 0:
         from .scheduler import Node, Scheduler
@@ -555,10 +717,17 @@ def main(argv: Optional[list] = None) -> int:
         freeze_startup_heap()
         gc_hygiene = GcHygieneThread(tracer=plugin.tracer)
         gc_hygiene.start()
-    server = ThrottlerHTTPServer(
-        plugin, host=args.host, port=args.port, remote=session is not None
-    )
-    server.start()
+    if standby_server is not None:
+        # the standby's listener (same host:port) flips to full serving —
+        # no socket rebind, so in-flight probes see 503→200 atomically
+        server = standby_server
+        server.set_plugin(plugin)
+    else:
+        server = ThrottlerHTTPServer(
+            plugin, host=args.host, port=args.port,
+            remote=session is not None, ha=ha,
+        )
+        server.start()
     print(
         f"kube-throttler-tpu serving on {args.host}:{server.port} "
         f"(throttler={plugin_args.name}, scheduler={plugin_args.target_scheduler_name}, "

@@ -26,11 +26,18 @@ Server-side fault verbs: assigning a :class:`~..faults.FaultPlan` to
 ``server.faults`` lets integration tests script outages the CLIENT cannot
 distinguish from real ones — sites ``mock.list`` (500 / 410 / stall),
 ``mock.watch.cut`` (stream severed mid-flight), ``mock.watch.gone``
-(410 ERROR event mid-stream), ``mock.status.conflict`` (forced 409) and
-``mock.status.error`` (500 on a status PUT). This is the other half of the
-fault matrix: client-side injection (transport.py) exercises our error
-handling; server-side verbs exercise the full wire round trip through real
-sockets.
+(410 ERROR event mid-stream), ``mock.status.conflict`` (forced 409),
+``mock.status.error`` (500 on a status PUT) and ``mock.lease`` (lease
+endpoint 500s/409s/stalls — leader-election chaos). This is the other half
+of the fault matrix: client-side injection (transport.py) exercises our
+error handling; server-side verbs exercise the full wire round trip through
+real sockets.
+
+HA fencing: writes may carry an ``X-Kube-Throttler-Epoch`` header
+(engine/replication.py). The server tracks the highest epoch presented and
+409s (reason ``FencedEpoch``) any write from a lower one — the wire half of
+split-brain prevention: a paused-then-resumed deposed leader's status and
+lease writes bounce without touching state.
 """
 
 from __future__ import annotations
@@ -95,6 +102,8 @@ class MockApiServer:
         "_lease_rv": "self._lock",
         "_events": "self._lock",
         "_continues": "self._lock",
+        "_fencing_epoch": "self._lock",
+        "stale_epoch_rejected": "self._lock",
     }
 
     def __init__(
@@ -146,6 +155,15 @@ class MockApiServer:
         # server-side fault verbs: a FaultPlan scripted by tests (see module
         # docstring); None = no injection
         self.faults = None
+        # HA fencing (engine/replication.py): the highest epoch any writer
+        # has presented via the X-Kube-Throttler-Epoch header. A write
+        # carrying a LOWER epoch is a paused-then-resumed deposed leader —
+        # rejected 409 with reason FencedEpoch and counted, exactly what
+        # the real apiserver's resourceVersion + Lease machinery achieves
+        # for the reference's embedded scheduler. Writes with no header
+        # pass (non-HA clients are unaffected).
+        self._fencing_epoch = 0
+        self.stale_epoch_rejected = 0
         for kind in COLLECTION_PATHS:
             self.store.add_event_handler(kind, self._make_recorder(kind), replay=False)
 
@@ -304,6 +322,44 @@ class MockApiServer:
         if fault is not None:
             fault.sleep()
         return fault
+
+    # -- HA fencing ---------------------------------------------------------
+
+    def _check_fencing(self, handler) -> bool:
+        """Epoch gate for every write verb: a request whose
+        ``X-Kube-Throttler-Epoch`` is below the highest epoch ever
+        presented is a deposed leader's write — 409 (reason FencedEpoch),
+        counted, and the state it targeted stays untouched. Requests
+        without the header pass unexamined."""
+        raw = handler.headers.get("X-Kube-Throttler-Epoch")
+        if not raw:
+            return True
+        try:
+            epoch = int(raw)
+        except ValueError:
+            handler._send_json(400, {"message": f"bad fencing epoch {raw!r}"})
+            return False
+        with self._lock:
+            if epoch < self._fencing_epoch:
+                self.stale_epoch_rejected += 1
+                current = self._fencing_epoch
+            else:
+                self._fencing_epoch = epoch
+                return True
+        handler._send_json(
+            409,
+            {
+                "message": f"stale fencing epoch: writer epoch {epoch} < "
+                f"fenced epoch {current}",
+                "reason": "FencedEpoch",
+            },
+        )
+        return False
+
+    @property
+    def fencing_epoch(self) -> int:
+        with self._lock:
+            return self._fencing_epoch
 
     def _serve_list(self, handler, kind: str, query=None) -> None:
         fault = self._fault("mock.list")
@@ -534,7 +590,25 @@ class MockApiServer:
         """coordination.k8s.io Lease object: GET / POST(create) /
         PUT(update, optimistic via metadata.resourceVersion) — the three
         verbs client-go leader election needs. POST takes the collection
-        path (name from body.metadata); GET/PUT take the named path."""
+        path (name from body.metadata); GET/PUT take the named path.
+
+        Fault verbs (site ``mock.lease``): mode "error" 500s any lease
+        verb, "conflict" 409s a write, "delay" stalls — the leader-election
+        chaos the failover e2e tests script. Writes also pass the fencing
+        gate: a deposed leader's renew attempt must bounce."""
+        fault = self._fault("mock.lease")
+        if fault is not None:
+            if fault.mode == "error":
+                handler._send_json(500, {"message": "injected lease apiserver error"})
+                return
+            if fault.mode == "conflict" and verb in ("POST", "PUT"):
+                handler._send_json(
+                    409, {"message": "injected: the lease has been modified"}
+                )
+                return
+            # mode "delay": the sleep already happened — serve normally
+        if verb in ("POST", "PUT") and not self._check_fencing(handler):
+            return
         if verb == "POST":
             m = _LEASE_COLLECTION_RE.match(path)
             name = str(((body or {}).get("metadata") or {}).get("name", ""))
@@ -630,6 +704,8 @@ class MockApiServer:
         if m is None:
             handler._send_json(404, {"message": f"no route {path}"})
             return
+        if not self._check_fencing(handler):
+            return  # deposed leader: the status write never touches state
         fault = self._fault("mock.status.conflict")
         if fault is not None:
             handler._send_json(

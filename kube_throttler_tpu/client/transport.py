@@ -88,6 +88,17 @@ class GoneError(ApiError):
         super().__init__(410, message)
 
 
+class FencedError(ApiError):
+    """409 with reason ``FencedEpoch``: this writer's fencing epoch is
+    stale — a newer leader has taken over (engine/replication.py). Unlike
+    an ordinary optimistic-concurrency 409, this is TERMINAL for the
+    writer: retrying can never succeed, and continuing to serve would be
+    split brain. Callers fence themselves and stop."""
+
+    def __init__(self, message: str = "stale fencing epoch"):
+        super().__init__(409, message)
+
+
 @dataclass(frozen=True)
 class RestConfig:
     """The slice of a kubeconfig the transport needs (the analog of
@@ -339,6 +350,7 @@ class ApiClient:
         burst: int = 100,
         page_size: Optional[int] = None,
         faults=None,
+        epoch_provider: Optional[Callable[[], Optional[int]]] = None,
     ):
         self.config = config
         self.timeout = timeout
@@ -346,6 +358,10 @@ class ApiClient:
         # failure injection — connection resets, 409/410 storms, stalled
         # watch reads — for chaos tests. None in production.
         self.faults = faults
+        # HA fencing (engine/replication.py): when set, every request
+        # carries X-Kube-Throttler-Epoch so a fenced server can reject a
+        # deposed leader's writes (FencedError). None for non-HA clients.
+        self.epoch_provider = epoch_provider
         self.page_size = (
             self.DEFAULT_PAGE_SIZE if page_size is None else max(0, page_size)
         )
@@ -425,6 +441,10 @@ class ApiClient:
             token = self._file_token() or token
         if token:
             headers["Authorization"] = f"Bearer {token}"
+        if self.epoch_provider is not None:
+            epoch = self.epoch_provider()
+            if epoch:
+                headers["X-Kube-Throttler-Epoch"] = str(epoch)
         return headers
 
     def _file_token(self) -> str:
@@ -509,6 +529,9 @@ class ApiClient:
             self._conn_local.conn = None
             raise
         if resp.status == 409:
+            text = data.decode(errors="replace")[:200]
+            if "FencedEpoch" in text or "stale fencing epoch" in text:
+                raise FencedError(text)
             raise ConflictError(path)
         if resp.status == 404:
             raise NotFoundError(path)
@@ -1111,9 +1134,16 @@ class AsyncStatusCommitter:
     }
 
     def __init__(self, writer: "RemoteStatusWriter", workers: int = 4,
-                 metrics_registry=None, max_retries: int = 4):
+                 metrics_registry=None, max_retries: int = 4,
+                 on_fenced: Optional[Callable[[], None]] = None):
         self._writer = writer
         self._n = max(1, int(workers))
+        # HA fencing: a FencedError from a PUT is terminal — the callback
+        # fires ONCE (wired to FencingEpoch.fence + the daemon stop event)
+        # and the slot is dropped, never retried (retries cannot succeed
+        # and would hammer the fenced apiserver)
+        self.on_fenced = on_fenced
+        self._fenced_fired = False
         # per-shard lanes: key → (kind, obj, event_ts|None, flip, attempts)
         self._hi_shards: list = [{} for _ in range(self._n)]
         self._lo_shards: list = [{} for _ in range(self._n)]
@@ -1306,6 +1336,24 @@ class AsyncStatusCommitter:
                 # the object was deleted while its status sat queued —
                 # permanent; retrying would head-of-line block the shard
                 self._count(kind, "not_found")
+                return
+            except FencedError:
+                # a newer leader owns publication now: drop the slot, fire
+                # the demotion hook once, and stop writing (split-brain
+                # prevention — see engine/replication.py)
+                self._count(kind, "fenced")
+                if not self._fenced_fired:
+                    self._fenced_fired = True
+                    logger.warning(
+                        "status PUT rejected by fencing (%s %s): a newer "
+                        "leader has taken over — demoting",
+                        kind, key_of(kind, obj),
+                    )
+                    if self.on_fenced is not None:
+                        try:
+                            self.on_fenced()
+                        except Exception:
+                            logger.exception("on_fenced callback failed")
                 return
             except ConflictError:
                 self._count(kind, "conflict")

@@ -111,6 +111,14 @@ class StoreJournal:
         self.compact_after = compact_after
         self.faults = faults
         self._lock = make_lock("journal")
+        # HA fencing (engine/replication.py): when a FencingEpoch is bound
+        # and marked stale (leadership lost), every append is refused and
+        # counted — a paused-then-resumed old leader cannot extend a log a
+        # promoted standby no longer follows. ``last_epoch`` is the highest
+        # EPOCH control line seen (replay or set_epoch); single-writer,
+        # read by probes/recovery (same stance as the counters below).
+        self.fencing = None
+        self.last_epoch = 0
         self._lines = 0
         self._file = None
         # running position of the journal content: byte length + sha256 of
@@ -133,6 +141,7 @@ class StoreJournal:
         self.torn_writes = 0  # injected torn appends
         self.compact_failures = 0  # compactions aborted (old log kept)
         self.replayed_events = 0  # events applied by the last replay
+        self.stale_epoch_rejected = 0  # appends refused by the fencing gate
 
     # -- replay -------------------------------------------------------------
 
@@ -232,8 +241,15 @@ class StoreJournal:
         return applied, None, offset, h
 
     def _apply(self, event: dict) -> None:
-        kind = event["kind"]
         etype = event["type"]
+        if etype == "EPOCH":
+            # fencing control line (engine/replication.py): records the
+            # leadership term under which the following events were
+            # written — no store effect, but recovery/promotion read the
+            # high-water term from it
+            self.last_epoch = max(self.last_epoch, int(event.get("epoch", 0)))
+            return
+        kind = event["kind"]
         obj = object_from_dict({**event["object"], "kind": kind})
         store = self.store
         if etype == "DELETED":
@@ -301,11 +317,20 @@ class StoreJournal:
         meaning inside a batch: the buffer accumulated so far is flushed
         before a kill fires, so the on-disk artifact matches the
         event-by-event timeline."""
+        # HA kill site: the whole batch mutated the store, but none of its
+        # lines exist yet — the entire batch is unjournaled AND
+        # unreplicated (tools/hatest.py asserts the standby promotes from
+        # the surviving prefix with zero divergence)
+        maybe_crash(self.faults, "ha.journal.batch")
         pieces: list = []
         lines_added = 0
         snapshotter = None
         with self._lock:
             if self._file is None:
+                return
+            if self.fencing is not None and self.fencing.is_stale():
+                # fenced: a stale leader's batch must not extend the log
+                self.stale_epoch_rejected += len(events)
                 return
             for event in events:
                 line = self._encode(event)
@@ -406,6 +431,11 @@ class StoreJournal:
         # lock holds minimal keeps the site placement honest): before the
         # line hits the file at all, and the torn-then-die artifact
         maybe_crash(self.faults, "crash.journal.append")
+        if event.kind in ("Throttle", "ClusterThrottle") and event.type is EventType.MODIFIED:
+            # HA kill site: a status write (possibly a FLIP) reached the
+            # store but its journal line never lands — the standby must
+            # re-derive the flip from replicated pod/spec truth
+            maybe_crash(self.faults, "ha.status.commit")
         crash_torn = (
             self.faults.check("crash.journal.torn")
             if self.faults is not None
@@ -414,6 +444,9 @@ class StoreJournal:
         snapshotter = None
         with self._lock:
             if self._file is None:
+                return
+            if self.fencing is not None and self.fencing.is_stale():
+                self.stale_epoch_rejected += 1
                 return
             if crash_torn is not None and crash_torn.mode == "kill":
                 # the canonical crash-mid-write artifact: half the line,
@@ -477,6 +510,9 @@ class StoreJournal:
         (ADDED lines, namespaces first), atomically. Caller holds the
         journal lock (asserted under KT_LOCK_ASSERT=1)."""
         assert_held(self._lock, "StoreJournal._compact_locked")
+        epoch = self.last_epoch
+        if self.fencing is not None:
+            epoch = max(epoch, self.fencing.current())
         objs = []
         for ns in self.store.list_namespaces():
             objs.append(("Namespace", ns))
@@ -491,8 +527,20 @@ class StoreJournal:
         )
         new_sha = hashlib.sha256()
         new_bytes = 0
+        lines = len(objs)
         try:
             with os.fdopen(fd, "w", encoding="utf-8") as f:
+                if epoch > 0:
+                    # compaction must not erase the fencing high-water: a
+                    # genesis replay of the compacted log still learns the
+                    # leadership term the objects were written under
+                    data = (
+                        json.dumps({"type": "EPOCH", "epoch": epoch}) + "\n"
+                    ).encode("utf-8")
+                    f.write(data.decode("utf-8"))
+                    new_sha.update(data)
+                    new_bytes += len(data)
+                    lines += 1
                 for kind, obj in objs:
                     data = (
                         json.dumps(
@@ -522,7 +570,7 @@ class StoreJournal:
         maybe_crash(self.faults, "crash.journal.compact")
         self._file.close()
         self._file = open(self.path, "a", encoding="utf-8")
-        self._lines = len(objs)
+        self._lines = lines
         self._sha = new_sha
         self._bytes = new_bytes
         logger.info("journal %s compacted to %d objects", self.path, len(objs))
@@ -551,6 +599,62 @@ class StoreJournal:
         with self._lock:
             return self._bytes, self._sha.hexdigest()
 
+    def set_epoch(self, epoch: int) -> None:
+        """Append a fencing EPOCH control line (engine/replication.py):
+        stamps the leadership term into the event stream so replay,
+        recovery, and streaming standbys all learn the high-water term
+        from the journal alone. No store effect; replays as a no-op."""
+        epoch = int(epoch)
+        with self._lock:
+            if epoch <= self.last_epoch:
+                return  # terms only move forward; duplicates add no info
+            self.last_epoch = epoch
+            if self._file is None:
+                return
+            data = (json.dumps({"type": "EPOCH", "epoch": epoch}) + "\n").encode(
+                "utf-8"
+            )
+            self._file.write(data.decode("utf-8"))
+            self._file.flush()
+            self._sha.update(data)
+            self._bytes += len(data)
+            self._lines += 1
+
+    def replication_chunk(
+        self, start_offset: int, max_bytes: int = 4 << 20
+    ) -> Optional[Tuple[bytes, int, str, int]]:
+        """Tail bytes for a streaming standby: ``(data, end_offset,
+        end_sha_hex, position)`` covering ``[start_offset, min(position,
+        start_offset+max_bytes))``. Serving only up to the ACCOUNTED
+        position (never the raw file end) guarantees complete lines — a
+        torn crash artifact past the position is never shipped. Returns
+        None when ``start_offset`` lies beyond the position (the journal
+        was compacted/rewritten under the standby). Reads under the
+        journal lock so a concurrent compaction cannot swap the file
+        between the position read and the byte read."""
+        with self._lock:
+            position = self._bytes
+            if start_offset > position:
+                return None
+            end = min(position, start_offset + max_bytes)
+            if start_offset == end:
+                return b"", position, self._sha.hexdigest(), position
+            if not os.path.exists(self.path):
+                return None
+            with open(self.path, "rb") as f:
+                f.seek(start_offset)
+                data = f.read(end - start_offset)
+            if len(data) != end - start_offset:
+                return None  # file shorter than accounted (rewritten)
+            if end == position:
+                end_sha = self._sha.hexdigest()
+            else:
+                h = hash_prefix(self.path, end)
+                if h is None:
+                    return None
+                end_sha = h.hexdigest()
+            return data, end, end_sha, position
+
     def set_snapshotter(self, snapshotter, every_lines: int) -> None:
         """Arm the journal-size snapshot trigger: every ``every_lines``
         appended lines, ``snapshotter.snapshot_on_journal_trigger()`` runs
@@ -571,7 +675,13 @@ class StoreJournal:
             "tornTails": self.torn_tails,
             "writeErrors": self.write_errors,
             "compactFailures": self.compact_failures,
+            "staleEpochRejected": self.stale_epoch_rejected,
+            "epoch": self.last_epoch,
         }
+        if self.stale_epoch_rejected:
+            # a fenced journal is not merely lossy — this replica must not
+            # serve at all (a standby owns the keyspace now)
+            return "down", detail
         degraded = self.replay_skipped or self.write_errors or self.compact_failures
         return ("degraded" if degraded else "ok"), detail
 

@@ -73,6 +73,7 @@ class RecoveryReport:
     journal_torn_tails: int = 0
     reservations_restored: int = 0
     reservations_expired_dropped: int = 0
+    epoch: int = 0  # highest fencing epoch found (snapshot header + journal)
     divergences: int = 0
     repaired_keys: List[str] = field(default_factory=list)
     snapshot_drift_keys: int = 0  # keys whose flags legitimately progressed
@@ -198,6 +199,11 @@ class RecoveryManager:
         self.report.journal_lines_replayed = journal.replayed_events
         self.report.journal_interior_skipped = journal.replay_skipped
         self.report.journal_torn_tails = journal.torn_tails
+        # the fencing high-water this data directory knows about: a
+        # promoting standby (or restarting leader) must bump PAST it
+        self.report.epoch = max(
+            int((payload or {}).get("epoch") or 0), journal.last_epoch
+        )
         self.report.duration_s = time.monotonic() - t0
         logger.info(
             "recovery: mode=%s snapshot=%s objects=%d journal_lines=%d "

@@ -90,39 +90,46 @@ def find_snapshots(directory: str) -> List[Tuple[int, str]]:
     return out
 
 
+def parse_snapshot_bytes(blob: bytes, origin: str = "<bytes>") -> dict:
+    """Parse + verify a snapshot from raw bytes (header line + payload) —
+    the form a replication bootstrap receives over the wire. Raises
+    :class:`SnapshotError` on any integrity failure."""
+    header_line, _, body = blob.partition(b"\n")
+    try:
+        header = json.loads(header_line.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise SnapshotError(f"bad snapshot header in {origin}: {e}") from e
+    if header.get("format") != SNAPSHOT_FORMAT:
+        raise SnapshotError(f"{origin}: not a {SNAPSHOT_FORMAT} file")
+    if header.get("version") != SNAPSHOT_VERSION:
+        raise SnapshotError(
+            f"{origin}: unsupported snapshot version {header.get('version')!r}"
+        )
+    length = int(header.get("length", -1))
+    payload = body.rstrip(b"\n")
+    if length != len(payload):
+        raise SnapshotError(
+            f"{origin}: truncated payload ({len(payload)} bytes, header says {length})"
+        )
+    digest = hashlib.sha256(payload).hexdigest()
+    if digest != header.get("sha256"):
+        raise SnapshotError(f"{origin}: payload checksum mismatch")
+    try:
+        return json.loads(payload.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:  # pragma: no cover — sha256 gate
+        raise SnapshotError(f"{origin}: undecodable payload: {e}") from e
+
+
 def load_snapshot(path: str) -> dict:
     """Parse + verify one snapshot file; returns the payload dict. Raises
     :class:`SnapshotError` on any integrity failure (the caller falls back
     to an older snapshot or to pure journal replay)."""
     try:
         with open(path, "rb") as f:
-            header_line = f.readline()
-            body = f.read()
+            blob = f.read()
     except OSError as e:
         raise SnapshotError(f"unreadable snapshot {path}: {e}") from e
-    try:
-        header = json.loads(header_line.decode("utf-8"))
-    except (ValueError, UnicodeDecodeError) as e:
-        raise SnapshotError(f"bad snapshot header in {path}: {e}") from e
-    if header.get("format") != SNAPSHOT_FORMAT:
-        raise SnapshotError(f"{path}: not a {SNAPSHOT_FORMAT} file")
-    if header.get("version") != SNAPSHOT_VERSION:
-        raise SnapshotError(
-            f"{path}: unsupported snapshot version {header.get('version')!r}"
-        )
-    length = int(header.get("length", -1))
-    payload = body.rstrip(b"\n")
-    if length != len(payload):
-        raise SnapshotError(
-            f"{path}: truncated payload ({len(payload)} bytes, header says {length})"
-        )
-    digest = hashlib.sha256(payload).hexdigest()
-    if digest != header.get("sha256"):
-        raise SnapshotError(f"{path}: payload checksum mismatch")
-    try:
-        return json.loads(payload.decode("utf-8"))
-    except (ValueError, UnicodeDecodeError) as e:  # pragma: no cover — sha256 gate
-        raise SnapshotError(f"{path}: undecodable payload: {e}") from e
+    return parse_snapshot_bytes(blob, origin=path)
 
 
 @guard_attrs
@@ -159,12 +166,17 @@ class SnapshotManager:
         self.keep = max(1, int(keep))
         self.faults = faults
         self.journal = None
+        # HA fencing (engine/replication.py): when bound and stale, write()
+        # refuses — a deposed leader must not publish snapshots a standby
+        # could later bootstrap from
+        self.fencing = None
         self._lock = make_lock("snapshot")
         existing = find_snapshots(directory)
         self._seq = existing[0][0] if existing else 0
         # single-writer stats (health/metrics probes read these)
         self.snapshots_written = 0
         self.snapshot_failures = 0
+        self.stale_epoch_rejected = 0
         self.last_snapshot_time = None  # datetime (self.clock domain)
         self.last_snapshot_seq: Optional[int] = None
         self.last_snapshot_path: Optional[str] = None
@@ -204,9 +216,15 @@ class SnapshotManager:
                 objs.append(object_to_dict(thr))
             for pod in self.store.list_pods():
                 objs.append(object_to_dict(pod))
+            epoch = 0
+            if self.fencing is not None:
+                epoch = self.fencing.current()
+            elif self.journal is not None:
+                epoch = self.journal.last_epoch
             payload = {
                 "seq": seq,
                 "reason": reason,
+                "epoch": epoch,
                 "takenAt": now.isoformat(),
                 "rv": self.store.latest_resource_version,
                 "objects": objs,
@@ -230,7 +248,16 @@ class SnapshotManager:
     def write(self, reason: str = "manual") -> Optional[str]:
         """Cut one snapshot; returns its path, or None on an I/O failure
         (counted; the journal is still intact, so a failed snapshot only
-        costs recovery speed, never correctness)."""
+        costs recovery speed, never correctness) — or None, counted
+        separately, when this replica's fencing epoch has gone stale (a
+        deposed leader must stop publishing snapshots)."""
+        if self.fencing is not None and self.fencing.is_stale():
+            self.stale_epoch_rejected += 1
+            logger.warning(
+                "snapshot (%s) refused: fencing epoch %d is stale",
+                reason, self.fencing.current(),
+            )
+            return None
         maybe_crash(self.faults, "crash.snapshot.begin")
         with self._lock:
             self._seq += 1
@@ -265,6 +292,10 @@ class SnapshotManager:
                 "version": SNAPSHOT_VERSION,
                 "sha256": hashlib.sha256(data).hexdigest(),
                 "length": len(data),
+                # fencing epoch in the HEADER too: replication can answer
+                # "whose term is this snapshot from" without parsing the
+                # payload (loaders ignore unknown header keys)
+                "epoch": payload.get("epoch", 0),
             }
         ).encode("utf-8")
         blob = header + b"\n" + data + b"\n"
@@ -290,6 +321,10 @@ class SnapshotManager:
         # tmp is complete + fsynced but unnamed: recovery sees only the
         # previous snapshots
         maybe_crash(self.faults, "crash.snapshot.pre_rename")
+        # HA kill site: the leader dies mid-snapshot during a failover run
+        # (tmp complete, rename pending) — the standby must promote from
+        # the replicated journal, ignoring the orphan tmp
+        maybe_crash(self.faults, "ha.snapshot.write")
         os.replace(tmp, final)
         self._fsync_dir()
         # renamed but superseded snapshots not yet pruned: recovery must
@@ -340,5 +375,8 @@ class SnapshotManager:
             "failures": self.snapshot_failures,
             "lastSeq": self.last_snapshot_seq,
             "ageSeconds": round(age, 3) if age is not None else None,
+            "staleEpochRejected": self.stale_epoch_rejected,
         }
+        if self.stale_epoch_rejected:
+            return "down", detail  # fenced replica: must not serve
         return ("degraded" if self.snapshot_failures else "ok"), detail

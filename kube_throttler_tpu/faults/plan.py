@@ -67,6 +67,21 @@ mock.watch.cut              mockserver cuts the watch stream mid-flight
 mock.watch.gone             mockserver emits a 410 ERROR event mid-stream
 mock.status.conflict        mockserver 409s a status PUT
 mock.status.error           mockserver 500s a status PUT
+mock.lease                  mockserver lease endpoint: "conflict" 409s a
+                            lease write, "error" 500s any lease verb,
+                            "delay" stalls it (leader-election chaos)
+ha.journal.batch            SIGKILL the leader after a batch mutated the
+                            store but before ANY of its journal lines were
+                            written (the whole batch is unreplicated)
+ha.snapshot.write           SIGKILL the leader mid-snapshot (tmp complete,
+                            rename pending) during an HA failover run
+ha.status.commit            SIGKILL the leader after a throttle status
+                            write mutated the store but before its journal
+                            line landed (a flip computed but uncommitted —
+                            the standby must re-derive it)
+ha.replication.send         SIGKILL the leader mid-way through sending a
+                            journal chunk to a standby (torn replication
+                            stream; the standby must discard the partial)
 ==========================  ==================================================
 
 The ``crash.*`` family is the SIGKILL crash-point harness
@@ -121,6 +136,11 @@ KNOWN_SITES = frozenset(
         "mock.watch.gone",
         "mock.status.conflict",
         "mock.status.error",
+        "mock.lease",
+        "ha.journal.batch",
+        "ha.snapshot.write",
+        "ha.status.commit",
+        "ha.replication.send",
     }
 )
 

@@ -89,6 +89,12 @@ METRIC_NAMES = frozenset(
         "kube_throttler_recovery_duration_seconds",
         "kube_throttler_recovery_journal_lines_replayed",
         "kube_throttler_recovery_divergence_total",
+        # active/standby HA (register_ha_metrics / engine/replication.py)
+        "kube_throttler_leader_state",
+        "kube_throttler_failover_duration_seconds",
+        "kube_throttler_replication_lag_bytes",
+        "kube_throttler_replication_lag_events",
+        "kube_throttler_stale_epoch_rejections_total",
     }
 )
 
@@ -546,6 +552,61 @@ def register_recovery_metrics(
             rec_duration.set_key((), r.duration_s)
             rec_lines.set_key((), float(r.journal_lines_replayed))
             rec_divergence.set_key((), float(r.divergences))
+
+    registry.register_pre_expose(flush)
+
+
+def register_ha_metrics(registry: Registry, coordinator) -> None:
+    """Active/standby HA observability (engine/replication.py), fed at
+    scrape time from the coordinator: role (1=leader, 0=standby), the last
+    failover's duration, replication lag (bytes behind the leader's
+    journal position + events applied so far), and the stale-epoch write
+    rejections the fencing gates have refused — the counter that must stay
+    at ZERO on a healthy pair and moves exactly when a deposed leader
+    tries to write."""
+    leader_g = registry.gauge_vec(
+        "kube_throttler_leader_state",
+        "replica role (1=leader, 0=standby)",
+        [],
+    )
+    failover_g = registry.gauge_vec(
+        "kube_throttler_failover_duration_seconds",
+        "tail fast-forward + epoch bump time of the last promotion "
+        "(-1 before any failover)",
+        [],
+    )
+    lag_bytes_g = registry.gauge_vec(
+        "kube_throttler_replication_lag_bytes",
+        "journal bytes the standby still has to stream (0 on the leader)",
+        [],
+    )
+    lag_events_g = registry.gauge_vec(
+        "kube_throttler_replication_lag_events",
+        "events applied from the replication stream so far "
+        "(0 on a never-standby leader)",
+        [],
+    )
+    stale_c = registry.counter_vec(
+        "kube_throttler_stale_epoch_rejections_total",
+        "writes refused because this replica's fencing epoch went stale "
+        "(journal appends + snapshot cuts)",
+        [],
+    )
+
+    def flush() -> None:
+        leader_g.set_key((), 1.0 if coordinator.role == "leader" else 0.0)
+        failover_g.set_key(
+            (),
+            -1.0
+            if coordinator.failover_duration_s is None
+            else coordinator.failover_duration_s,
+        )
+        rep = coordinator.replicator
+        lag_bytes_g.set_key((), float(rep.lag_bytes()) if rep is not None else 0.0)
+        lag_events_g.set_key(
+            (), float(rep.events_applied) if rep is not None else 0.0
+        )
+        stale_c.set_key((), float(coordinator.stale_epoch_rejections()))
 
     registry.register_pre_expose(flush)
 

@@ -69,21 +69,34 @@ def _throttle_to_dict(thr) -> dict:
 class ThrottlerHTTPServer:
     def __init__(
         self,
-        plugin: KubeThrottler,
+        plugin: Optional[KubeThrottler],
         host: str = "127.0.0.1",
         port: int = 10259,
         remote: bool = False,
+        ha=None,
     ):
         """``remote=True`` (daemon synced from a real apiserver via
         reflectors) disables the local object-mutation endpoints: a local
         write to a reflector-owned kind would be silently reverted by the
         next watch event — mutate the real cluster instead. Admission
-        endpoints (/v1/prefilter, reserve, unreserve) stay available."""
+        endpoints (/v1/prefilter, reserve, unreserve) stay available.
+
+        ``plugin=None`` + ``ha`` (an engine.replication.HaCoordinator) is
+        STANDBY mode: the server answers /healthz (alive), reports role
+        ``standby`` on /readyz (503 — probes must not route admission
+        traffic here), and refuses every /v1 endpoint except the
+        replication routes. :meth:`set_plugin` flips it to serving at
+        promotion. A LEADER passes ``ha`` too: its replication source is
+        served from ``/v1/replication/*`` so warm standbys can bootstrap
+        and stream the journal tail."""
+        if plugin is None and ha is None:
+            raise ValueError("plugin-less server requires an HA coordinator")
         self.plugin = plugin
         self.remote = remote
-        self.store = plugin.store
-        self.clientset = plugin.clientset
-        self.listers = plugin.listers
+        self.ha = ha
+        self.store = plugin.store if plugin is not None else None
+        self.clientset = plugin.clientset if plugin is not None else None
+        self.listers = plugin.listers if plugin is not None else None
         # serializes get-then-update pod mutations (re-apply, bind): the
         # handler pool is threaded and a lost update here silently unbinds
         # a running pod
@@ -164,8 +177,29 @@ class ThrottlerHTTPServer:
             h._send(404, {"error": f"unknown path {h.path}"})
 
     def _get(self, h) -> None:
+        if self.ha is not None and self.ha.source is not None:
+            from .engine.replication import handle_replication_get
+
+            if handle_replication_get(h, self.ha.source, h.path):
+                return
         if h.path == "/healthz":
             h._send(200, "ok", content_type="text/plain")
+        elif self.plugin is None:
+            # standby: alive but not serving — /readyz reports the role
+            # (503 keeps admission traffic away until promotion) and every
+            # other surface refuses
+            if h.path == "/readyz":
+                state, detail = self.ha.health_state()
+                h._send(
+                    503,
+                    {
+                        "ok": False,
+                        "state": "standby",
+                        "components": {"ha": {"state": state, **detail}},
+                    },
+                )
+            else:
+                h._send(503, {"error": "standby replica; not serving yet"})
         elif h.path == "/readyz":
             # component readiness via the health state machine (health.py):
             # 200 while serving is possible — ok AND degraded both serve
@@ -200,6 +234,9 @@ class ThrottlerHTTPServer:
                     "clusterthrottle": len(self.plugin.cluster_throttle_ctr.workqueue),
                 },
             }
+            if self.ha is not None:
+                body["role"] = self.ha.role
+                body["epoch"] = self.ha.epoch.current()
             h._send(200 if snap["state"] != "down" else 503, body)
         elif h.path == "/metrics":
             h._send(
@@ -243,6 +280,9 @@ class ThrottlerHTTPServer:
     )
 
     def _post(self, h) -> None:
+        if self.plugin is None:
+            h._send(503, {"error": "standby replica; not serving yet"})
+            return
         body = h._body()
         if self.remote and h.path in ("/v1/objects", "/v1/bind"):
             h._send(409, {"error": self._REMOTE_REFUSAL})
@@ -332,6 +372,9 @@ class ThrottlerHTTPServer:
             h._send(404, {"error": f"unknown path {h.path}"})
 
     def _delete(self, h) -> None:
+        if self.plugin is None:
+            h._send(503, {"error": "standby replica; not serving yet"})
+            return
         if self.remote:
             h._send(409, {"error": self._REMOTE_REFUSAL})
             return
@@ -355,6 +398,16 @@ class ThrottlerHTTPServer:
         h._send(200, {"deleted": f"{kind}/{key}"})
 
     # ------------------------------------------------------------ lifecycle
+
+    def set_plugin(self, plugin: KubeThrottler) -> None:
+        """Promotion flip: a standby server starts answering the full
+        surface. Plain attribute rebinds — handler threads read them per
+        request, and each is atomic in CPython (a request races only into
+        seeing the old 503-standby behaviour, never a torn state)."""
+        self.plugin = plugin
+        self.store = plugin.store
+        self.clientset = plugin.clientset
+        self.listers = plugin.listers
 
     def mark_draining(self) -> None:
         """Flip /readyz to 503 (graceful shutdown step 1) while keeping the

@@ -4,6 +4,7 @@ plugin.go:97/109)."""
 
 from __future__ import annotations
 
+import time as _time
 from datetime import datetime, timedelta, timezone
 
 from .lockorder import guard_attrs, make_condition, make_lock
@@ -12,6 +13,13 @@ from .lockorder import guard_attrs, make_condition, make_lock
 class Clock:
     def now(self) -> datetime:  # pragma: no cover — interface
         raise NotImplementedError
+
+    def monotonic(self) -> float:
+        """Seconds on a monotonic axis — elapsed-time math (lease renew
+        deadlines, staleness windows) must use THIS, never deltas of
+        ``now()``: wall-clock NTP steps would stretch or shrink an
+        interval measured in ``datetime`` space."""
+        raise NotImplementedError  # pragma: no cover — interface
 
     def subscribe(self, callback) -> None:
         """Register a zero-arg callback fired when the clock jumps (FakeClock
@@ -27,21 +35,33 @@ class RealClock(Clock):
     def now(self) -> datetime:
         return datetime.now(timezone.utc)
 
+    def monotonic(self) -> float:
+        return _time.monotonic()
+
 
 @guard_attrs
 class FakeClock(Clock):
     """Settable clock for tests; ``advance`` wakes subscribed waiters."""
 
-    GUARDED_BY = {"_now": "self._cond", "_listeners": "self._cond"}
+    GUARDED_BY = {
+        "_now": "self._cond",
+        "_mono": "self._cond",
+        "_listeners": "self._cond",
+    }
 
     def __init__(self, start: datetime):
         self._now = start
+        self._mono = 0.0
         self._cond = make_condition(make_lock("utils.fakeclock"))
         self._listeners = []
 
     def now(self) -> datetime:
         with self._cond:
             return self._now
+
+    def monotonic(self) -> float:
+        with self._cond:
+            return self._mono
 
     def subscribe(self, callback) -> None:
         with self._cond:
@@ -66,11 +86,22 @@ class FakeClock(Clock):
             cb()
 
     def advance(self, delta: timedelta) -> None:
+        """Time passes: wall AND monotonic move together."""
         with self._cond:
             self._now += delta
+            self._mono += delta.total_seconds()
         self._notify()
 
     def set(self, t: datetime) -> None:
+        """Wall-clock JUMP (an NTP step): ``now()`` moves, ``monotonic()``
+        does not — elapsed-time consumers must be unaffected."""
         with self._cond:
             self._now = t
+        self._notify()
+
+    def advance_monotonic(self, seconds: float) -> None:
+        """Monotonic-only advance (a frozen wall clock that still ticks
+        elapsed time — the inverse skew case)."""
+        with self._cond:
+            self._mono += float(seconds)
         self._notify()

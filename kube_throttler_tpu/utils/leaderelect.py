@@ -23,9 +23,11 @@ import logging
 import os
 import threading
 import time
-from datetime import datetime, timedelta, timezone
+from datetime import datetime, timezone
 from pathlib import Path
-from typing import Optional
+from typing import Optional, Tuple
+
+from .clock import Clock, RealClock
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +76,13 @@ class FileLeaseElector:
         except OSError:
             os.close(fd)
             return False
+        except BaseException:
+            # anything else (KeyboardInterrupt between open and flock, a
+            # monkeypatched flock raising in tests) must not leak the fd:
+            # a leaked descriptor HOLDS the flock for the process lifetime,
+            # wedging every future acquire on this host
+            os.close(fd)
+            raise
         self._fd = fd  # leadership is held from here even if the pid write fails
         try:
             os.ftruncate(fd, 0)
@@ -102,25 +111,25 @@ class FileLeaseElector:
                 time.sleep(self.retry_period)
 
     def release(self) -> None:
-        if self._fd is None:
+        """Idempotent: a double release (or a release after a failed
+        acquire) is a no-op — the fd is nulled FIRST so even an unlock
+        error cannot leave a half-released elector that a second call
+        would double-close (closing a reused fd number belonging to
+        someone else)."""
+        fd, self._fd = self._fd, None
+        if fd is None:
             return
         try:
-            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        except OSError:
+            pass  # close() drops the lock regardless
         finally:
-            os.close(self._fd)
-            self._fd = None
+            os.close(fd)
         logger.info("released leadership lease %s", self.lock_path)
 
 
 def _rfc3339(dt: datetime) -> str:
     return dt.astimezone(timezone.utc).isoformat().replace("+00:00", "Z")
-
-
-def _parse_rfc3339(s: str) -> Optional[datetime]:
-    try:
-        return datetime.fromisoformat(s.replace("Z", "+00:00"))
-    except (ValueError, AttributeError):
-        return None
 
 
 class HttpLeaseElector:
@@ -150,6 +159,7 @@ class HttpLeaseElector:
         retry_period: float = 2.0,
         renew_deadline: Optional[float] = None,
         on_lost=None,
+        clock: Optional[Clock] = None,
     ):
         """``on_lost``: zero-arg callback fired when held leadership is LOST
         (renew conflict won by another replica, or the renew deadline
@@ -160,8 +170,17 @@ class HttpLeaseElector:
         ``renew_deadline`` must be STRICTLY less than ``lease_duration``
         (client-go defaults 10s vs 15s): the demoting side gives up before
         a standby's takeover clock expires, so there is never a window with
-        two leaders. Defaults to 2/3 of ``lease_duration``."""
+        two leaders. Defaults to 2/3 of ``lease_duration``.
+
+        ``clock``: staleness and renew-deadline math run on
+        ``clock.monotonic()`` (client-go's observedTime semantics) — the
+        holder's ``renewTime`` string is treated as an opaque heartbeat
+        value, and takeover happens only after OUR monotonic clock sees it
+        unchanged for a full ``lease_duration``. Wall-clock skew between
+        replicas or an NTP step on either side can therefore neither
+        trigger a premature takeover nor wedge a stale lease."""
         self.client = client
+        self.clock = clock or RealClock()
         self.name = name
         self.identity = identity
         # create is POST to the COLLECTION, read/update to the named
@@ -183,6 +202,9 @@ class HttpLeaseElector:
         self._rv = ""
         self._stop = threading.Event()
         self._renewer: Optional[threading.Thread] = None
+        # last observed (holder, renewTime string) + the monotonic instant
+        # we FIRST saw that exact pair — the takeover clock (see __init__)
+        self._observed: Optional[Tuple[str, str, float]] = None
 
     @property
     def is_leader(self) -> bool:
@@ -239,10 +261,19 @@ class HttpLeaseElector:
         spec = current.get("spec") or {}
         rv = str((current.get("metadata") or {}).get("resourceVersion", ""))
         holder = spec.get("holderIdentity") or ""
-        renew = _parse_rfc3339(spec.get("renewTime") or "")
+        renew_raw = str(spec.get("renewTime") or "")
         duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
-        now = datetime.now(timezone.utc)
-        expired = renew is None or (now - renew) > timedelta(seconds=duration)
+        # staleness on OUR monotonic clock, not wall-clock renewTime deltas:
+        # the heartbeat string is opaque — any CHANGE restarts the takeover
+        # window; only the same (holder, renewTime) pair observed for a full
+        # lease_duration of local monotonic time means the holder is dead.
+        # An NTP step (local or on the holder) changes neither condition.
+        mono = self.clock.monotonic()
+        if self._observed is None or self._observed[:2] != (holder, renew_raw):
+            self._observed = (holder, renew_raw, mono)
+            expired = not renew_raw  # a never-renewed lease is free game
+        else:
+            expired = (mono - self._observed[2]) > duration
         if holder == self.identity or expired or not holder:
             acquire = (
                 spec.get("acquireTime") if holder == self.identity else None
@@ -278,7 +309,7 @@ class HttpLeaseElector:
     def _renew_loop(self) -> None:
         from ..engine.store import ConflictError
 
-        last_renew = time.monotonic()
+        last_renew = self.clock.monotonic()
         wait = self.renew_period
         while not self._stop.wait(wait):
             wait = self.renew_period
@@ -289,13 +320,13 @@ class HttpLeaseElector:
                 self._rv = str(
                     (updated.get("metadata") or {}).get("resourceVersion", "")
                 )
-                last_renew = time.monotonic()
+                last_renew = self.clock.monotonic()
             except ConflictError:
                 # someone else wrote the Lease — re-read; demote unless it
                 # was our own write racing (then try_acquire re-renews)
                 self._leader = False
                 if self.try_acquire():
-                    last_renew = time.monotonic()
+                    last_renew = self.clock.monotonic()
                 else:
                     self._lost("conflict — another replica holds the lease")
                     return
@@ -304,9 +335,11 @@ class HttpLeaseElector:
                 # renew_period) and DEMOTE once renew_deadline passes with
                 # no successful write — strictly before a standby's
                 # lease_duration takeover clock can expire, so two replicas
-                # never both lead (client-go renewDeadline semantics)
+                # never both lead (client-go renewDeadline semantics). The
+                # deadline runs on the injectable monotonic clock: an NTP
+                # step must not fabricate (or eat) elapsed renew time.
                 logger.exception("lease renew failed; retrying")
-                if time.monotonic() - last_renew > self.renew_deadline:
+                if self.clock.monotonic() - last_renew > self.renew_deadline:
                     self._lost(
                         f"renew deadline passed ({self.renew_deadline:.0f}s "
                         "without a successful write)"
