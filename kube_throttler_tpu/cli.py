@@ -511,11 +511,18 @@ def main(argv: Optional[list] = None) -> int:
                     epoch, role="standby", replicator=replicator,
                     journal=journal, snapshotter=snapshotter,
                 )
+                # HA families registered BEFORE the standby wait: the
+                # replication-lag gauge must be scrapeable exactly while
+                # this replica is a standby, not only after promotion
+                from .metrics import register_ha_metrics
+
+                register_ha_metrics(metrics_registry, ha)
                 # the standby SERVES its role from the real port while
                 # replicating: /readyz 503 {"state": "standby", ...},
                 # admission endpoints refused until promotion
                 standby_server = ThrottlerHTTPServer(
-                    None, host=args.host, port=args.port, ha=ha
+                    None, host=args.host, port=args.port, ha=ha,
+                    metrics_registry=metrics_registry,
                 )
                 standby_server.start()
                 print(
@@ -555,6 +562,9 @@ def main(argv: Optional[list] = None) -> int:
                     epoch, role="leader", journal=journal,
                     snapshotter=snapshotter,
                 )
+                from .metrics import register_ha_metrics
+
+                register_ha_metrics(metrics_registry, ha)
                 ha.become_leader()
                 print(f"leading with fencing epoch {epoch.current()}", flush=True)
             # either way this replica now leads: serve the replication
@@ -663,10 +673,9 @@ def main(argv: Optional[list] = None) -> int:
 
         register_recovery_metrics(metrics_registry, snapshotter, recovery)
     if ha is not None:
+        # (HA metric families were registered at coordinator creation,
+        # before the standby wait — only the health hook needs the plugin)
         plugin.health.register("ha", ha.health_state)
-        from .metrics import register_ha_metrics
-
-        register_ha_metrics(metrics_registry, ha)
         if promoted:
             # flip re-publication: every key reconciles against replicated
             # truth, so flips the dead leader computed but never durably

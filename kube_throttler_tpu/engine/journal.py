@@ -26,7 +26,12 @@ replay distinguishes two cases:
 When the live log exceeds ``compact_after`` lines it is compacted to a
 snapshot of ADDED events (written to a temp file, atomically renamed); a
 failed compaction (fsync/rename error) is logged and retried a window
-later — it never breaks the store's dispatch.
+later — it never breaks the store's dispatch. Because compaction
+invalidates every existing snapshot's recorded journal anchor, a bound
+snapshotter is triggered immediately after each compaction so the newest
+snapshot always carries a valid anchor (local recovery falls back to
+genesis replay either way; streaming standbys re-bootstrap from the
+newest snapshot and need its anchor to resolve).
 
 The journal also maintains a running ``(byte offset, sha256)`` of its
 content, exposed via :meth:`position`. Snapshots (engine/snapshot.py)
@@ -386,9 +391,11 @@ class StoreJournal:
                     except OSError:  # pragma: no cover — fsync race on close
                         pass
             self._lines += lines_added
+            compacted = False
             if self._lines >= self.compact_after:
                 try:
                     self._compact_locked()
+                    compacted = True
                 except OSError:
                     self.compact_failures += 1
                     self._lines = 0
@@ -397,9 +404,16 @@ class StoreJournal:
                         "uncompacted log and retrying later",
                         self.path, exc_info=True,
                     )
-            if self._snapshotter is not None and self.snapshot_every > 0:
-                self._lines_since_snapshot += lines_added
-                if self._lines_since_snapshot >= self.snapshot_every:
+            if self._snapshotter is not None:
+                if self.snapshot_every > 0:
+                    self._lines_since_snapshot += lines_added
+                # a compaction invalidates every snapshot's journal anchor:
+                # cut a fresh one regardless of the line budget so standby
+                # bootstraps always find a resolvable anchor
+                if compacted or (
+                    self.snapshot_every > 0
+                    and self._lines_since_snapshot >= self.snapshot_every
+                ):
                     self._lines_since_snapshot = 0
                     snapshotter = self._snapshotter
         if snapshotter is not None:
@@ -479,9 +493,11 @@ class StoreJournal:
             self._sha.update(data)
             self._bytes += len(data)
             self._lines += 1
+            compacted = False
             if self._lines >= self.compact_after:
                 try:
                     self._compact_locked()
+                    compacted = True
                 except OSError:
                     # a failed compaction (disk full, fsync error) must not
                     # propagate into the store's dispatch — the old log is
@@ -493,9 +509,15 @@ class StoreJournal:
                         "uncompacted log and retrying later",
                         self.path, exc_info=True,
                     )
-            if self._snapshotter is not None and self.snapshot_every > 0:
-                self._lines_since_snapshot += 1
-                if self._lines_since_snapshot >= self.snapshot_every:
+            if self._snapshotter is not None:
+                if self.snapshot_every > 0:
+                    self._lines_since_snapshot += 1
+                # see on_batch: a compaction must be followed by a fresh
+                # snapshot or every bootstrap anchor dangles
+                if compacted or (
+                    self.snapshot_every > 0
+                    and self._lines_since_snapshot >= self.snapshot_every
+                ):
                     self._lines_since_snapshot = 0
                     snapshotter = self._snapshotter
         if snapshotter is not None:
@@ -587,9 +609,16 @@ class StoreJournal:
         # and it could also lose a concurrent event: one appended to the
         # old file after the snapshot was cut would vanish at rotation.
         with self.store._lock:  # noqa: SLF001 — same-package access
+            snapshotter = None
             with self._lock:
                 if self._file is not None:
                     self._compact_locked()
+                    snapshotter = self._snapshotter
+            if snapshotter is not None:
+                # the rewrite invalidated every snapshot's journal anchor;
+                # cut a fresh one (journal lock released, store lock held —
+                # the same stance as the dispatch-path trigger)
+                snapshotter.snapshot_on_journal_trigger()
 
     # -- position / snapshot trigger ---------------------------------------
 
@@ -621,24 +650,37 @@ class StoreJournal:
             self._lines += 1
 
     def replication_chunk(
-        self, start_offset: int, max_bytes: int = 4 << 20
-    ) -> Optional[Tuple[bytes, int, str, int]]:
+        self, start_offset: int, max_bytes: int = 4 << 20,
+        want_start_sha: bool = False,
+    ) -> Optional[Tuple[bytes, int, str, int, Optional[str]]]:
         """Tail bytes for a streaming standby: ``(data, end_offset,
-        end_sha_hex, position)`` covering ``[start_offset, min(position,
-        start_offset+max_bytes))``. Serving only up to the ACCOUNTED
-        position (never the raw file end) guarantees complete lines — a
-        torn crash artifact past the position is never shipped. Returns
-        None when ``start_offset`` lies beyond the position (the journal
-        was compacted/rewritten under the standby). Reads under the
-        journal lock so a concurrent compaction cannot swap the file
-        between the position read and the byte read."""
+        end_sha_hex, position, start_sha_hex)`` covering ``[start_offset,
+        min(position, start_offset+max_bytes))``. Serving only up to the
+        ACCOUNTED position (never the raw file end) guarantees complete
+        lines — a torn crash artifact past the position is never shipped.
+        Returns None when ``start_offset`` lies beyond the position (the
+        journal was compacted/rewritten under the standby).
+        ``start_sha_hex`` (the prefix hash at ``start_offset``, for the
+        source's continuity verification) is None unless
+        ``want_start_sha``. Everything — position, bytes, and both prefix
+        hashes — is read under the journal lock so a concurrent compaction
+        cannot swap the file between any two of the reads."""
         with self._lock:
             position = self._bytes
             if start_offset > position:
                 return None
+            start_sha: Optional[str] = None
+            if want_start_sha:
+                if start_offset == position:
+                    start_sha = self._sha.hexdigest()
+                else:
+                    h = hash_prefix(self.path, start_offset)
+                    if h is None:
+                        return None
+                    start_sha = h.hexdigest()
             end = min(position, start_offset + max_bytes)
             if start_offset == end:
-                return b"", position, self._sha.hexdigest(), position
+                return b"", position, self._sha.hexdigest(), position, start_sha
             if not os.path.exists(self.path):
                 return None
             with open(self.path, "rb") as f:
@@ -653,7 +695,7 @@ class StoreJournal:
                 if h is None:
                     return None
                 end_sha = h.hexdigest()
-            return data, end, end_sha, position
+            return data, end, end_sha, position, start_sha
 
     def set_snapshotter(self, snapshotter, every_lines: int) -> None:
         """Arm the journal-size snapshot trigger: every ``every_lines``

@@ -36,8 +36,9 @@ Chunk protocol (``GET /v1/replication/journal?offset=N&hash=H``):
   accounted position and are never shipped);
 - ``hash`` is the sha256 hexdigest of the journal prefix up to ``offset``
   as the standby last knew it; a mismatch (the leader compacted the
-  journal underneath the stream) answers 409 and the standby marks
-  itself diverged rather than applying bytes from a rewritten file;
+  journal underneath the stream) answers 409 and the standby discards
+  its resume point and re-bootstraps from the leader's newest snapshot
+  rather than applying bytes from a rewritten file;
 - the response carries ``X-KT-End-Sha`` (prefix hash at the chunk end) so
   the standby's resume pair stays verified without re-hashing, plus
   ``X-KT-Epoch`` and ``X-KT-Position`` for fencing and lag accounting.
@@ -55,13 +56,13 @@ import logging
 import os
 import threading
 import time
-from http.client import HTTPConnection
+from http.client import HTTPConnection, HTTPException
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..api.serialization import object_from_dict
 from ..utils.lockorder import guard_attrs, make_lock
-from .journal import StoreJournal, hash_prefix
+from .journal import StoreJournal
 from .snapshot import SnapshotError, find_snapshots, load_snapshot
 from .store import Store
 
@@ -89,13 +90,22 @@ class FencingEpoch:
     demotion step: once stale, every guarded writer (journal, snapshot,
     remote status committer) refuses and counts."""
 
-    GUARDED_BY = {"_epoch": "self._lock", "_stale": "self._lock"}
+    GUARDED_BY = {
+        "_epoch": "self._lock",
+        "_stale": "self._lock",
+        "_claimed": "self._lock",
+    }
 
     def __init__(self, data_dir: Optional[str] = None, epoch: int = 0):
         self._lock = make_lock("ha.epoch")
         self._path = os.path.join(data_dir, EPOCH_FILE) if data_dir else None
         self._epoch = int(epoch)
         self._stale = False
+        # True once bump() has run: this process claimed a term of its own.
+        # Only a claimant is deposed by a higher observed epoch — a standby
+        # legitimately observes every new leader term while streaming and
+        # must NOT fence itself out of its own journal.
+        self._claimed = False
         if self._path is not None and os.path.exists(self._path):
             try:
                 with open(self._path) as f:
@@ -115,12 +125,14 @@ class FencingEpoch:
     def observe(self, epoch: int) -> None:
         """Learn an epoch from the environment (snapshot header, journal
         EPOCH line, replication stream). Raises the known high-water; if a
-        STRICTLY higher epoch than ours appears while we are not stale,
-        someone else has taken over — fence ourselves."""
+        STRICTLY higher epoch appears while we hold a claimed term (bump()
+        ran) and are not yet stale, someone else has taken over — fence
+        ourselves. A process that never claimed (a streaming standby) just
+        tracks the high-water: new leader terms are its normal diet."""
         epoch = int(epoch)
         with self._lock:
             if epoch > self._epoch:
-                fence_now = not self._stale and self._epoch > 0
+                fence_now = self._claimed and not self._stale
                 self._epoch = epoch
             else:
                 return
@@ -133,6 +145,7 @@ class FencingEpoch:
         with self._lock:
             self._epoch += 1
             self._stale = False
+            self._claimed = True
             epoch, path = self._epoch, self._path
         if path is not None:
             tmp = f"{path}.tmp"
@@ -213,24 +226,24 @@ class ReplicationSource:
         """One tail chunk past ``offset``; verifies ``sha_hex`` (prefix
         hash at ``offset``) when given. Returns {data, endOffset, endSha,
         position, epoch, startSha?}; raises :class:`ReplicationDiverged`
-        on any continuity failure."""
-        chunk = self.journal.replication_chunk(offset, max_bytes=self.MAX_CHUNK)
+        on any continuity failure. The journal computes the start/end
+        prefix hashes under its own lock, so a compaction racing this read
+        cannot produce a hash over a rewritten file."""
+        chunk = self.journal.replication_chunk(
+            offset,
+            max_bytes=self.MAX_CHUNK,
+            want_start_sha=bool(sha_hex) or want_start_sha,
+        )
         if chunk is None:
             raise ReplicationDiverged(
                 f"offset {offset} beyond journal position (compacted?)"
             )
-        data, end_offset, end_sha, position = chunk
-        if sha_hex:
-            if offset == position:
-                ok = sha_hex == end_sha
-            else:
-                h = hash_prefix(self.journal.path, offset)
-                ok = h is not None and h.hexdigest() == sha_hex
-            if not ok:
-                raise ReplicationDiverged(
-                    f"prefix hash mismatch at offset {offset} — journal "
-                    "rewritten since the standby attached"
-                )
+        data, end_offset, end_sha, position, start_sha = chunk
+        if sha_hex and sha_hex != start_sha:
+            raise ReplicationDiverged(
+                f"prefix hash mismatch at offset {offset} — journal "
+                "rewritten since the standby attached"
+            )
         out = {
             "data": data,
             "endOffset": end_offset,
@@ -239,10 +252,7 @@ class ReplicationSource:
             "epoch": self.epoch.current(),
         }
         if want_start_sha:
-            h = hash_prefix(self.journal.path, offset)
-            if h is None:
-                raise ReplicationDiverged(f"offset {offset} unreadable")
-            out["startSha"] = h.hexdigest()
+            out["startSha"] = start_sha
         self.chunks_served += 1
         return out
 
@@ -426,6 +436,7 @@ class StandbyReplicator:
         self.lines_skipped = 0
         self.apply_errors = 0
         self.polls = 0
+        self.rebootstraps = 0
         self.last_contact_monotonic: Optional[float] = None
         self.diverged = False
         self.bootstrapped = False
@@ -439,6 +450,13 @@ class StandbyReplicator:
             resp = conn.getresponse()
             data = resp.read()
             return resp.status, data, {k: v for k, v in resp.getheaders()}
+        except HTTPException as e:
+            # a torn chunk (leader died mid-send, Content-Length declared
+            # but the connection closed short) surfaces from resp.read()
+            # as IncompleteRead — an HTTPException, NOT an OSError.
+            # Normalize so every caller's retry path (bootstrap, _run,
+            # catch_up) treats it like any other transport failure.
+            raise OSError(f"replication fetch failed: {e!r}") from e
         finally:
             conn.close()
 
@@ -447,8 +465,11 @@ class StandbyReplicator:
     def bootstrap(self, deadline_s: float = 30.0) -> bool:
         """Fetch the leader's newest snapshot (404 → genesis stream) and
         apply it into the local store; seeds the resume pair from the
-        snapshot's journal anchor. Retries until the leader answers or the
-        deadline passes. Returns True when bootstrapped."""
+        snapshot's journal anchor. Retries transport errors AND transient
+        non-200 answers (a restarting leader's 500 is as temporary as a
+        refused socket) until the deadline passes. Never raises — returns
+        True when bootstrapped, False on deadline/stop, so callers have
+        exactly one failure path."""
         deadline = time.monotonic() + deadline_s
         while not self._stop.is_set():
             try:
@@ -479,9 +500,14 @@ class StandbyReplicator:
                         self.epoch.observe(snap_epoch)
                     self.journal.set_epoch(snap_epoch)
             else:
-                raise ReplicationDiverged(
-                    f"snapshot fetch failed: HTTP {status} {data[:200]!r}"
+                logger.warning(
+                    "snapshot fetch: HTTP %d %r; retrying",
+                    status, data[:200],
                 )
+                if time.monotonic() >= deadline:
+                    return False
+                self._stop.wait(0.1)
+                continue
             ep = headers.get(EPOCH_HEADER)
             if ep:
                 self.leader_epoch = int(ep)
@@ -494,8 +520,18 @@ class StandbyReplicator:
                     self.poll_once()
                     if self._offset >= self.leader_position:
                         break
-            except (OSError, ReplicationDiverged):
+            except OSError:
                 pass  # leader vanished mid-drain: keep what landed
+            except ReplicationDiverged:
+                # the anchor went stale under us: the leader compacted
+                # after cutting the snapshot we just applied. Compaction
+                # triggers a fresh snapshot on the leader, so re-fetching
+                # yields one with a resolvable anchor — loop back
+                self.diverged = False
+                if time.monotonic() >= deadline:
+                    return False
+                self._stop.wait(0.1)
+                continue
             self.bootstrapped = True
             logger.info(
                 "standby bootstrapped from %s (offset=%d, epoch=%s)",
@@ -558,10 +594,8 @@ class StandbyReplicator:
             raise ReplicationDiverged(data.decode(errors="replace")[:200])
         if status != 200:
             raise OSError(f"journal fetch failed: HTTP {status}")
-        declared = headers.get("Content-Length")
-        if declared is not None and int(declared) != len(data):
-            # torn send (leader died mid-chunk): discard, re-fetch later
-            raise OSError("short journal chunk (torn replication send)")
+        # a torn send (leader died mid-chunk) never reaches here: read()
+        # raises IncompleteRead inside _get, normalized to OSError there
         if self._needs_rehash and "X-KT-Start-Sha" in headers:
             self._sha_hex = headers["X-KT-Start-Sha"]
             self._needs_rehash = False
@@ -642,9 +676,20 @@ class StandbyReplicator:
             try:
                 self.poll_once()
             except ReplicationDiverged as e:
-                logger.error("replication diverged: %s — standby state is "
-                             "frozen at its last verified offset", e)
-                return
+                # the leader rewrote the journal under the stream (any
+                # compaction does this): the resume pair is worthless, so
+                # discard it and re-bootstrap from the newest snapshot —
+                # exactly what ReplicationDiverged's contract demands. The
+                # standby reports down (diverged) until the re-bootstrap
+                # lands; on a dead/unreachable leader the bootstrap times
+                # out and the next poll's 409 brings us back here.
+                logger.warning("replication diverged: %s — re-bootstrapping "
+                               "from the leader's newest snapshot", e)
+                self._offset, self._sha_hex = 0, ""
+                self._needs_rehash = False
+                if self.bootstrap(deadline_s=30.0):
+                    self.diverged = False
+                    self.rebootstraps += 1
             except OSError:
                 # leader unreachable (crashed, restarting, network): keep
                 # polling — the lease decides when WE take over, not the
@@ -697,6 +742,7 @@ class StandbyReplicator:
             "linesSkipped": self.lines_skipped,
             "lastContactAgeSeconds": age,
             "leaderEpoch": self.leader_epoch,
+            "rebootstraps": self.rebootstraps,
         }
         if self.diverged:
             return "down", {**detail, "error": "replication diverged"}

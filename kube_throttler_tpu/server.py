@@ -74,6 +74,7 @@ class ThrottlerHTTPServer:
         port: int = 10259,
         remote: bool = False,
         ha=None,
+        metrics_registry=None,
     ):
         """``remote=True`` (daemon synced from a real apiserver via
         reflectors) disables the local object-mutation endpoints: a local
@@ -88,12 +89,22 @@ class ThrottlerHTTPServer:
         replication routes. :meth:`set_plugin` flips it to serving at
         promotion. A LEADER passes ``ha`` too: its replication source is
         served from ``/v1/replication/*`` so warm standbys can bootstrap
-        and stream the journal tail."""
+        and stream the journal tail.
+
+        ``metrics_registry`` makes ``/metrics`` scrapeable BEFORE the
+        plugin exists — a standby's replication lag is exactly the metric
+        that only matters pre-promotion; falls back to the plugin's
+        registry when absent (they are the same object in the daemon)."""
         if plugin is None and ha is None:
             raise ValueError("plugin-less server requires an HA coordinator")
         self.plugin = plugin
         self.remote = remote
         self.ha = ha
+        self.metrics_registry = (
+            metrics_registry
+            if metrics_registry is not None
+            else (plugin.metrics_registry if plugin is not None else None)
+        )
         self.store = plugin.store if plugin is not None else None
         self.clientset = plugin.clientset if plugin is not None else None
         self.listers = plugin.listers if plugin is not None else None
@@ -184,6 +195,14 @@ class ThrottlerHTTPServer:
                 return
         if h.path == "/healthz":
             h._send(200, "ok", content_type="text/plain")
+        elif h.path == "/metrics" and self.metrics_registry is not None:
+            # served on a standby too (plugin still None): replication lag
+            # is the one family operators need exactly while standing by
+            h._send(
+                200,
+                self.metrics_registry.exposition(),
+                content_type="text/plain; version=0.0.4",
+            )
         elif self.plugin is None:
             # standby: alive but not serving — /readyz reports the role
             # (503 keeps admission traffic away until promotion) and every
@@ -238,12 +257,6 @@ class ThrottlerHTTPServer:
                 body["role"] = self.ha.role
                 body["epoch"] = self.ha.epoch.current()
             h._send(200 if snap["state"] != "down" else 503, body)
-        elif h.path == "/metrics":
-            h._send(
-                200,
-                self.plugin.metrics_registry.exposition(),
-                content_type="text/plain; version=0.0.4",
-            )
         elif h.path == "/v1/throttles":
             h._send(200, [_throttle_to_dict(t) for t in self.listers.throttles.list()])
         elif h.path == "/v1/clusterthrottles":
@@ -408,6 +421,8 @@ class ThrottlerHTTPServer:
         self.store = plugin.store
         self.clientset = plugin.clientset
         self.listers = plugin.listers
+        if self.metrics_registry is None:
+            self.metrics_registry = plugin.metrics_registry
 
     def mark_draining(self) -> None:
         """Flip /readyz to 503 (graceful shutdown step 1) while keeping the

@@ -91,6 +91,21 @@ class TestFencingEpoch:
         e = FencingEpoch()
         assert e.bump() == 1  # no data dir: no persistence, no crash
 
+    def test_standby_never_claimed_does_not_self_fence(self):
+        """A streaming standby legitimately observes every new leader term
+        (a leader restart bumps N→N+1 mid-stream). Only a process that
+        CLAIMED a term via bump() is deposed by a higher observation —
+        a self-fenced standby would reject every replicated event into
+        its own journal and silently lose them at the next promotion."""
+        e = FencingEpoch()
+        e.observe(1)
+        e.observe(2)  # leader restarted: new term — normal standby diet
+        assert e.current() == 2 and not e.is_stale()
+        # once it claims (promotion), a higher term DOES depose it
+        assert e.bump() == 3
+        e.observe(4)
+        assert e.is_stale()
+
 
 # --------------------------------------------------------------------------
 # journal EPOCH lines + fencing gate
@@ -678,6 +693,140 @@ class TestReplicationStreaming:
         finally:
             pair.close()
 
+    def test_leader_term_bump_does_not_fence_streaming_standby(self, tmp_path):
+        """A restarting leader bumps its term while the standby streams at
+        the old one. The standby must track the higher epoch WITHOUT
+        fencing itself: its journal keeps accepting the re-journaled
+        replicated events, so nothing is lost at a later promotion."""
+        pair = _Pair(tmp_path)
+        try:
+            pair.ls.create_pod(make_pod("p0"))
+            assert pair.rep.bootstrap(5.0)
+            pair.converge()
+            pair.ha.become_leader()  # leader restart: term 1 → 2
+            pair.ls.create_pod(make_pod("p1"))
+            pair.converge()
+            assert pair.sepoch.current() == pair.lepoch.current() == 2
+            assert not pair.sepoch.is_stale(), (
+                "standby fenced itself on a normal leader term bump"
+            )
+            assert pair.sj.stale_epoch_rejected == 0
+            assert {p.key for p in pair.ss.list_pods()} == {
+                "default/p0", "default/p1",
+            }
+            # the replicated events actually landed in the standby's OWN
+            # journal (a fenced journal drops them while the store mutates)
+            pure = Store()
+            pj = attach(pure, os.path.join(pair.standby_dir, "store.journal"))
+            assert {p.key for p in pure.list_pods()} == {
+                "default/p0", "default/p1",
+            }
+            pj.close()
+        finally:
+            pair.close()
+
+    def test_compaction_rebootstraps_running_standby(self, tmp_path):
+        """A leader compaction rewrites the journal under the stream; the
+        BACKGROUND replicator must re-bootstrap from the freshly cut
+        post-compaction snapshot and converge again — not freeze at its
+        last verified offset until someone restarts the process."""
+        pair = _Pair(tmp_path)
+        try:
+            for i in range(5):
+                pair.ls.create_pod(make_pod(f"p{i}"))
+            assert pair.rep.bootstrap(5.0)
+            pair.rep.start()
+
+            def caught_up():
+                return pair.rep.consumed_offset() >= pair.lj.position()[0]
+
+            assert _wait(caught_up, 5.0)
+            # deletes make the compacted log differ from the append log
+            pair.ls.delete_pod("default", "p1")
+            pair.ls.delete_pod("default", "p3")
+            assert _wait(caught_up, 5.0)
+            pair.lj.compact()  # rewrite + fresh post-compaction snapshot
+            pair.ls.create_pod(make_pod("post-compact"))
+
+            def converged_again():
+                return (
+                    pair.rep.rebootstraps >= 1
+                    and not pair.rep.diverged
+                    and {p.key for p in pair.ss.list_pods()}
+                    == {p.key for p in pair.ls.list_pods()}
+                )
+
+            assert _wait(converged_again, 10.0), (
+                "standby never re-bootstrapped after leader compaction"
+            )
+            state, detail = pair.rep.health_state()
+            assert state == "ok" and detail["rebootstraps"] >= 1
+        finally:
+            pair.close()
+
+    def test_torn_chunk_read_surfaces_as_oserror(self):
+        """A leader dying mid-send leaves a short body under a declared
+        Content-Length; http.client raises IncompleteRead — an
+        HTTPException, NOT an OSError — from read(). The replicator must
+        normalize it so every retry path (bootstrap, _run, catch_up)
+        treats it like any other transport failure instead of the
+        replicator thread dying silently."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Torn(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "1000")
+                self.end_headers()
+                self.wfile.write(b'{"half": true}')  # then the socket closes
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Torn)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            rep = StandbyReplicator(
+                Store(), None,
+                f"http://127.0.0.1:{httpd.server_address[1]}",
+                request_timeout=2.0,
+            )
+            with pytest.raises(OSError):
+                rep.poll_once()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_bootstrap_retries_non_200_and_reports_false(self):
+        """A transient 500 on the snapshot fetch must not raise out of
+        bootstrap (the daemon's clean 'standby bootstrap failed' path
+        only handles the False return); it retries until the deadline."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Err(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = b'{"message": "boom"}'
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Err)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            rep = StandbyReplicator(
+                Store(), None,
+                f"http://127.0.0.1:{httpd.server_address[1]}",
+            )
+            assert rep.bootstrap(deadline_s=0.5) is False
+            assert not rep.bootstrapped
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
     def test_promotion_bumps_epoch_and_stamps_journal(self, tmp_path):
         pair = _Pair(tmp_path)
         try:
@@ -789,6 +938,34 @@ class TestStandbyServer:
                 assert len(listing) == 1
             finally:
                 plugin.stop()
+        finally:
+            srv.stop()
+            journal.close()
+
+    def test_standby_metrics_scrapeable_before_promotion(self, tmp_path):
+        """/metrics must answer on a plugin-less standby — replication lag
+        is exactly the family that only matters pre-promotion."""
+        import urllib.request
+
+        from kube_throttler_tpu.metrics import Registry, register_ha_metrics
+        from kube_throttler_tpu.server import ThrottlerHTTPServer
+
+        store = Store()
+        rec = RecoveryManager(str(tmp_path))
+        journal = rec.recover_store(store)
+        epoch = FencingEpoch(str(tmp_path))
+        rep = StandbyReplicator(store, journal, "http://127.0.0.1:1")
+        ha = HaCoordinator(epoch, role="standby", replicator=rep, journal=journal)
+        registry = Registry()
+        register_ha_metrics(registry, ha)
+        srv = ThrottlerHTTPServer(None, port=0, ha=ha, metrics_registry=registry)
+        srv.start()
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics"
+            ).read().decode()
+            assert "kube_throttler_replication_lag_bytes" in text
+            assert "kube_throttler_leader_state 0" in text
         finally:
             srv.stop()
             journal.close()
