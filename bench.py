@@ -1561,6 +1561,201 @@ def run_ingest_sweep() -> None:
     emit(out)
 
 
+def run_gang_bench() -> None:
+    """``python bench.py --gang``: the gang-admission rung — bursty
+    all-or-nothing group arrivals (mixed sizes 2/4/8/16) against ONE hot
+    throttle, with the cfg5 paced pod churn running through the
+    controllers underneath. Reports the all-or-nothing admit rate (and
+    asserts ZERO partial admissions observable in the ledger), group
+    admission latency percentiles (batched feasibility dispatch + atomic
+    group reserve), and the per-pod flip p99 of the concurrent churn
+    window — the PR 5 SLO (≤150 ms) must hold with gangs in the mix.
+    ``--full`` runs the 100k×10k shape; default is the 10k×1k rung."""
+    import random
+    import threading as _threading
+    from collections import deque
+
+    from kube_throttler_tpu.api.pod import make_pod
+    from kube_throttler_tpu.api.types import (
+        LabelSelector,
+        ResourceAmount,
+        Throttle,
+        ThrottleSelector,
+        ThrottleSelectorTerm,
+        ThrottleSpec,
+    )
+
+    platform = "cpu"
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        pass
+    full = "--full" in sys.argv
+    P, T = (100_000, 10_000) if full else (10_000, 1_000)
+    groups = 500
+    store, plugin = build_served_stack(P, T, groups, label="gang")
+
+    # the HOT throttle every gang lands on: a cpu budget of 16 admits 32
+    # 500m ranks — bursts of mixed sizes oversubscribe it, so admit/reject
+    # both happen and capacity cycles as held groups roll back
+    hot = Throttle(
+        name="gang-hot",
+        spec=ThrottleSpec(
+            throttler_name="kube-throttler",
+            threshold=ResourceAmount.of(requests={"cpu": "16"}),
+            selector=ThrottleSelector(
+                selector_terms=(
+                    ThrottleSelectorTerm(
+                        LabelSelector(match_labels={"grp": "gang-hot"})
+                    ),
+                )
+            ),
+        ),
+    )
+    store.create_throttle(hot)
+
+    # prewarm the gang kernel's shape rungs (member pads 8 and 16 cover
+    # sizes 2/4/8/16): the first dispatch's XLA compile (~2s on CPU) must
+    # not land inside the measured admission window
+    for warm_n in (2, 16):
+        warm = [
+            make_pod(
+                f"gangwarm{warm_n}-r{i}",
+                labels={"grp": "gang-hot"},
+                requests={"cpu": "500m"},
+                group=f"gangwarm{warm_n}",
+                group_size=warm_n,
+            )
+            for i in range(warm_n)
+        ]
+        plugin.pre_filter_gang(f"default/gangwarm{warm_n}", warm)
+
+    stop = _threading.Event()
+    gang_stats = {
+        "admit_lat": [],
+        "check_lat": [],
+        "admitted": 0,
+        "rejected": 0,
+        "violations": 0,
+        "sizes": {},
+    }
+
+    def gang_driver() -> None:
+        rng = random.Random(7)
+        held: deque = deque()  # (release_time, group_key)
+        gid = 0
+        sizes = (2, 4, 8, 16)
+        cache = plugin.throttle_ctr.cache
+        while not stop.is_set():
+            now = time.perf_counter()
+            while held and held[0][0] <= now:
+                _, gk = held.popleft()
+                plugin.unreserve_gang(gk)
+            for _ in range(rng.randint(1, 4)):  # one bursty arrival wave
+                gid += 1
+                size = rng.choice(sizes)
+                gk = f"default/gang{gid}"
+                members = [
+                    make_pod(
+                        f"gang{gid}-r{i}",
+                        labels={"grp": "gang-hot"},
+                        requests={"cpu": "500m"},
+                        group=f"gang{gid}",
+                        group_size=size,
+                    )
+                    for i in range(size)
+                ]
+                t0 = time.perf_counter()
+                st = plugin.pre_filter_gang(gk, members)
+                t1 = time.perf_counter()
+                ok = st.is_success() and plugin.reserve_gang(gk, members).is_success()
+                t2 = time.perf_counter()
+                gang_stats["check_lat"].append(t1 - t0)
+                gang_stats["admit_lat"].append(t2 - t0)
+                gang_stats["sizes"][size] = gang_stats["sizes"].get(size, 0) + 1
+                # all-or-nothing witness straight from the ledger: every
+                # member reserved on the hot key, or none of them
+                reserved = cache.reserved_pod_keys(hot.key)
+                member_keys = {m.key for m in members}
+                n_in = len(member_keys & reserved)
+                if ok:
+                    gang_stats["admitted"] += 1
+                    if n_in != size:
+                        gang_stats["violations"] += 1
+                    held.append((time.perf_counter() + 0.05, gk))
+                else:
+                    gang_stats["rejected"] += 1
+                    if n_in != 0:
+                        gang_stats["violations"] += 1
+            stop.wait(0.05)
+        while held:
+            plugin.unreserve_gang(held.popleft()[1])
+
+    driver = _threading.Thread(target=gang_driver, daemon=True)
+    driver.start()
+    try:
+        streaming = bench_served_streaming(
+            store, plugin, "gang-churn", groups=groups,
+            duration=4.0 if not full else 8.0, pace_hz=1000.0,
+            ingest_batch="adaptive",
+        )
+    finally:
+        stop.set()
+        driver.join(timeout=10)
+        plugin.stop()
+
+    lat = np.asarray(gang_stats["admit_lat"]) if gang_stats["admit_lat"] else np.asarray([0.0])
+    chk = np.asarray(gang_stats["check_lat"]) if gang_stats["check_lat"] else np.asarray([0.0])
+    total = gang_stats["admitted"] + gang_stats["rejected"]
+    out = {
+        "metric": (
+            "gang admission p99 (batched group feasibility + atomic "
+            "all-or-nothing reserve) under bursty mixed-size arrivals on "
+            "one hot throttle, cfg5 churn paced 1k ev/s underneath"
+        ),
+        "value": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "unit": "ms",
+        "platform": platform,
+        "scale": [P, T],
+        "gang_groups_total": total,
+        "gang_groups_admitted": gang_stats["admitted"],
+        "gang_groups_rejected": gang_stats["rejected"],
+        "gang_admit_rate": round(gang_stats["admitted"] / max(total, 1), 3),
+        "gang_all_or_nothing_violations": gang_stats["violations"],
+        "gang_sizes": gang_stats["sizes"],
+        "gang_admission_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "gang_admission_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "gang_check_p50_ms": round(float(np.percentile(chk, 50)) * 1e3, 3),
+        "gang_check_p99_ms": round(float(np.percentile(chk, 99)) * 1e3, 3),
+        "churn_flip_lag_p99_ms": streaming["flip_lag_p99_ms"],
+        "churn_flip_samples": streaming["flip_samples"],
+        "flip_slo_ms": 150.0,
+        "flip_slo_met": bool(
+            streaming["flip_samples"] == 0
+            or streaming["flip_lag_p99_ms"] <= 150.0
+        ),
+        "churn": streaming,
+    }
+    log(
+        f"[gang] {total} groups ({gang_stats['admitted']} admitted / "
+        f"{gang_stats['rejected']} rejected, admit rate "
+        f"{out['gang_admit_rate']:.0%}), admission p50 "
+        f"{out['gang_admission_p50_ms']:.2f}ms / p99 "
+        f"{out['gang_admission_p99_ms']:.2f}ms, all-or-nothing violations "
+        f"{gang_stats['violations']}; churn flip p99 "
+        f"{streaming['flip_lag_p99_ms']:.1f}ms over "
+        f"{streaming['flip_samples']} flips (SLO ≤150ms: "
+        f"{'MET' if out['flip_slo_met'] else 'MISSED'})"
+    )
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = f"BENCH_GANG_{platform.upper()}_{stamp}.json"
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    log(f"gang rung written to {path}")
+    emit(out)
+
+
 def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace_hz=1000.0):
     """cfg5 through the WIRE: pod churn lands on a (mock) apiserver, flows
     over real HTTP list+watch into the reflector-fed local cache, the
@@ -1717,7 +1912,7 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
 
 
 def bench_example_scenario(label):
-    """BASELINE config 1: the example/throttle.yaml t1 + walkthrough pods
+    """BASELINE config 1: the examples/throttle.yaml t1 + walkthrough pods
     through the FULL plugin stack on the host-oracle path (the 'CPU
     PreFilter reference scenario' — what the reference's Go hot path does
     per attempt, here per-decision host latency)."""
@@ -1735,10 +1930,10 @@ def bench_example_scenario(label):
         store,
         use_device=False,
     )
-    with open("example/throttle.yaml") as f:
+    with open("examples/throttle.yaml") as f:
         store.create_throttle(object_from_dict(yaml.safe_load(f)))
     pods = []
-    with open("example/pods.yaml") as f:
+    with open("examples/pods.yaml") as f:
         for doc in yaml.safe_load_all(f):
             pod = object_from_dict(doc)
             store.create_pod(pod)
@@ -1819,6 +2014,10 @@ def main():
     if "--ingest-sweep" in sys.argv:
         # PR 5 acceptance artifact: the full-scale batch-size sweep alone
         run_ingest_sweep()
+        return
+    if "--gang" in sys.argv:
+        # gang-admission rung: bursty group arrivals + churn SLO check
+        run_gang_bench()
         return
     quick = "--quick" in sys.argv
     rng = np.random.default_rng(0)

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Mapping, Optional
 from ..quantity import format_quantity, parse_quantity
 from .pod import Container, Namespace, Pod, PodSpec, PodStatus
 from .types import (
+    AccelClassThreshold,
     CalculatedThreshold,
     ClusterThrottle,
     ClusterThrottleSelector,
@@ -98,6 +99,23 @@ def _overrides_from_list(items: Optional[List[Mapping[str, Any]]]):
     )
 
 
+def _accel_thresholds_from_list(items: Optional[List[Mapping[str, Any]]]):
+    return tuple(
+        AccelClassThreshold(
+            accel_class=str(e.get("accelClass", "")),
+            threshold=resource_amount_from_dict(e.get("threshold")),
+        )
+        for e in (items or [])
+    )
+
+
+def _accel_thresholds_to_list(entries) -> List[Dict[str, Any]]:
+    return [
+        {"accelClass": e.accel_class, "threshold": e.threshold.to_dict()}
+        for e in entries
+    ]
+
+
 def _throttled_flags_from_dict(d: Optional[Mapping[str, Any]]) -> IsResourceAmountThrottled:
     if not d:
         return IsResourceAmountThrottled()
@@ -146,6 +164,9 @@ def throttle_from_dict(d: Mapping[str, Any]) -> Throttle:
             temporary_threshold_overrides=_overrides_from_list(
                 spec.get("temporaryThresholdOverrides")
             ),
+            accel_class_thresholds=_accel_thresholds_from_list(
+                spec.get("accelClassThresholds")
+            ),
             selector=ThrottleSelector(selector_terms=terms),
         ),
         status=status_from_dict(d.get("status")),
@@ -172,6 +193,9 @@ def cluster_throttle_from_dict(d: Mapping[str, Any]) -> ClusterThrottle:
             temporary_threshold_overrides=_overrides_from_list(
                 spec.get("temporaryThresholdOverrides")
             ),
+            accel_class_thresholds=_accel_thresholds_from_list(
+                spec.get("accelClassThresholds")
+            ),
             selector=ClusterThrottleSelector(selector_terms=terms),
         ),
         status=status_from_dict(d.get("status")),
@@ -196,6 +220,9 @@ def pod_from_dict(d: Mapping[str, Any]) -> Pod:
         name=str(meta.get("name", "")),
         namespace=str(meta.get("namespace", "default") or "default"),
         labels={str(k): str(v) for k, v in (meta.get("labels") or {}).items()},
+        annotations={
+            str(k): str(v) for k, v in (meta.get("annotations") or {}).items()
+        },
         **uid_kwargs,
         spec=PodSpec(
             scheduler_name=str(spec.get("schedulerName", "")),
@@ -326,6 +353,15 @@ def throttle_to_dict(thr: Throttle) -> Dict[str, Any]:
                 if thr.spec.temporary_threshold_overrides
                 else {}
             ),
+            **(
+                {
+                    "accelClassThresholds": _accel_thresholds_to_list(
+                        thr.spec.accel_class_thresholds
+                    )
+                }
+                if thr.spec.accel_class_thresholds
+                else {}
+            ),
             "selector": {
                 "selectorTerms": [
                     {"podSelector": label_selector_to_dict(t.pod_selector)}
@@ -352,6 +388,15 @@ def cluster_throttle_to_dict(thr: ClusterThrottle) -> Dict[str, Any]:
                     )
                 }
                 if thr.spec.temporary_threshold_overrides
+                else {}
+            ),
+            **(
+                {
+                    "accelClassThresholds": _accel_thresholds_to_list(
+                        thr.spec.accel_class_thresholds
+                    )
+                }
+                if thr.spec.accel_class_thresholds
                 else {}
             ),
             "selector": {
@@ -388,6 +433,11 @@ def pod_to_dict(pod: Pod) -> Dict[str, Any]:
             "namespace": pod.namespace,
             **({"uid": pod.uid} if pod.uid else {}),
             **({"labels": dict(sorted(pod.labels.items()))} if pod.labels else {}),
+            **(
+                {"annotations": dict(sorted(pod.annotations.items()))}
+                if pod.annotations
+                else {}
+            ),
         },
         "spec": {
             **({"schedulerName": pod.spec.scheduler_name} if pod.spec.scheduler_name else {}),

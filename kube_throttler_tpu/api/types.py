@@ -257,6 +257,29 @@ class CalculatedThreshold:
     messages: Tuple[str, ...] = ()
 
 
+@dataclass(frozen=True)
+class AccelClassThreshold:
+    """Per-accelerator-class effective threshold (heterogeneity-aware
+    admission, docs/gang_admission.md).
+
+    A mixed fleet's throttle capacity depends on which accelerator class a
+    pod lands on: the same ``cpu: 10`` budget may admit 40 v5e ranks but
+    only 8 v5p ranks. A spec may declare a list of these; for a pod whose
+    ``accel-class`` annotation equals ``accel_class``, the FIRST matching
+    entry's threshold REPLACES the throttle's effective (override-resolved)
+    threshold entirely — the same first-wins / whole-replacement semantics
+    as temporaryThresholdOverrides, so the two mechanisms compose without a
+    per-dimension merge ambiguity. Pods without a class (or with a class no
+    entry names) use the base effective threshold.
+
+    The persisted ``status.throttled`` flags stay class-agnostic (they are
+    derived from the base threshold at reconcile); class resolution applies
+    to the live admission inequality (steps 1/3/4), not to step 2."""
+
+    accel_class: str = ""
+    threshold: ResourceAmount = field(default_factory=ResourceAmount)
+
+
 # ---------------------------------------------------------------------------
 # Selectors
 # ---------------------------------------------------------------------------
@@ -394,11 +417,23 @@ class ClusterThrottleSelector:
 
 @dataclass(frozen=True)
 class ThrottleSpecBase:
-    """throttle_types.go:28-35."""
+    """throttle_types.go:28-35 (+ the heterogeneity extension
+    ``accelClassThresholds`` — see AccelClassThreshold)."""
 
     throttler_name: str = ""
     threshold: ResourceAmount = field(default_factory=ResourceAmount)
     temporary_threshold_overrides: Tuple[TemporaryThresholdOverride, ...] = ()
+    accel_class_thresholds: Tuple[AccelClassThreshold, ...] = ()
+
+    def accel_threshold_for(self, accel_class: Optional[str]) -> Optional[ResourceAmount]:
+        """First accelClassThresholds entry naming ``accel_class`` (first
+        wins, like the override merge), or None."""
+        if not accel_class:
+            return None
+        for entry in self.accel_class_thresholds:
+            if entry.accel_class == accel_class:
+                return entry.threshold
+        return None
 
     def next_override_happens_in(self, now: datetime) -> Optional[timedelta]:
         """throttle_types.go:37-63: soonest future begin/end boundary."""
@@ -504,14 +539,23 @@ def _check_throttled_for(
     reserved: ResourceAmount,
     is_throttled_on_equal: bool,
     step3_on_equal: bool,
+    threshold_override: Optional[ResourceAmount] = None,
 ) -> str:
     """The ordered 4-state check (throttle_types.go:128-153).
 
     step3_on_equal is True for Throttle (hardcoded at throttle_types.go:143)
     and ``is_throttled_on_equal`` for ClusterThrottle
     (clusterthrottle_types.go:45) — the one asymmetry between the kinds.
+
+    ``threshold_override`` (heterogeneity: a resolved per-accelerator-class
+    threshold) replaces the effective threshold for steps 1/3/4; step 2's
+    persisted flags stay class-agnostic by contract (AccelClassThreshold).
     """
-    threshold = effective_threshold(spec_threshold, status)
+    threshold = (
+        threshold_override
+        if threshold_override is not None
+        else effective_threshold(spec_threshold, status)
+    )
 
     pod_amount = resource_amount_of_pod(pod)
 
@@ -555,7 +599,11 @@ class Throttle:
         return f"{self.namespace}/{self.name}"
 
     def check_throttled_for(
-        self, pod: Pod, reserved: ResourceAmount, is_throttled_on_equal: bool
+        self,
+        pod: Pod,
+        reserved: ResourceAmount,
+        is_throttled_on_equal: bool,
+        accel_class: Optional[str] = None,
     ) -> str:
         return _check_throttled_for(
             self.spec.threshold,
@@ -564,6 +612,7 @@ class Throttle:
             reserved,
             is_throttled_on_equal,
             step3_on_equal=True,  # throttle_types.go:143
+            threshold_override=self.spec.accel_threshold_for(accel_class),
         )
 
     def with_status(self, status: ThrottleStatus) -> "Throttle":
@@ -587,7 +636,11 @@ class ClusterThrottle:
         return f"/{self.name}"
 
     def check_throttled_for(
-        self, pod: Pod, reserved: ResourceAmount, is_throttled_on_equal: bool
+        self,
+        pod: Pod,
+        reserved: ResourceAmount,
+        is_throttled_on_equal: bool,
+        accel_class: Optional[str] = None,
     ) -> str:
         return _check_throttled_for(
             self.spec.threshold,
@@ -596,6 +649,7 @@ class ClusterThrottle:
             reserved,
             is_throttled_on_equal,
             step3_on_equal=is_throttled_on_equal,  # clusterthrottle_types.go:45
+            threshold_override=self.spec.accel_threshold_for(accel_class),
         )
 
     def with_status(self, status: ThrottleStatus) -> "ClusterThrottle":

@@ -651,6 +651,11 @@ def main(argv: Optional[list] = None) -> int:
                 else None
             ),
         )
+        # gang ledger restore AFTER the per-pod reservations (it prunes
+        # expired/uncommitted groups' members back OUT of the caches), and
+        # GANG journal stamps flow to the recovered journal from here on
+        plugin.gang.journal = journal
+        recovery.restore_gangs(plugin.gang, journal)
         diverged = recovery.reconcile(
             plugin.informers,
             device_manager=plugin.device_manager,
@@ -665,6 +670,7 @@ def main(argv: Optional[list] = None) -> int:
                 "repair", flush=True,
             )
         snapshotter.reservations = reservation_caches
+        snapshotter.gang_ledger = plugin.gang
         snapshotter.device_manager = plugin.device_manager
         snapshotter.bind_journal(journal, every_lines=args.snapshot_every)
         plugin.health.register("recovery", recovery.health_state)

@@ -80,6 +80,9 @@ class ClusterThrottleController(ControllerBase):
         # crash recovery (engine/recovery.py)
         self.cache = ReservedResourceAmounts(num_key_mutex, clock=self.clock)
         self.reservation_ttl = reservation_ttl
+        # gang ledger (engine/gang.py), wired by the plugin — see
+        # ThrottleController.gang_ledger
+        self.gang_ledger = None
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
@@ -304,6 +307,12 @@ class ClusterThrottleController(ControllerBase):
             return affected
         return self._scan_cluster_throttles(pod, ns)
 
+    # kind-agnostic alias: the gang oracle (engine/gang.py
+    # sequential_gang_check) and other cross-kind walkers iterate both
+    # controllers through one method name
+    def affected_throttles(self, pod: Pod) -> List[ClusterThrottle]:
+        return self.affected_cluster_throttles(pod)
+
     def _scan_cluster_throttles(self, pod: Pod, ns) -> List[ClusterThrottle]:
         affected = []
         for thr in self._list_cluster_throttles():
@@ -333,6 +342,8 @@ class ClusterThrottleController(ControllerBase):
         removed = self.cache.remove_pod(thr.key, pod)
         if removed and self.device_manager is not None:
             self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
+        if removed and self.gang_ledger is not None:
+            self.gang_ledger.note_unreserved(self.KIND, thr.key, pod.key)
         return removed
 
     # ----------------------------------------------------------------- check
@@ -342,8 +353,11 @@ class ClusterThrottleController(ControllerBase):
     ) -> Tuple[
         List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle], List[ClusterThrottle]
     ]:
+        from ..api.pod import accel_class_of
+
+        accel = accel_class_of(pod)
         dm = self.device_manager
-        if dm is not None:
+        if dm is not None and not (accel and dm.has_accel_thresholds(self.KIND)):
             # the missing-namespace error contract holds on the device path
             # too (clusterthrottle_controller.go:273-276); with the breaker
             # open the host path below enforces it itself
@@ -358,7 +372,9 @@ class ClusterThrottleController(ControllerBase):
         exceeds: List[ClusterThrottle] = []
         for thr in throttles:
             reserved, _ = self.cache.reserved_resource_amount(thr.key)
-            status = thr.check_throttled_for(pod, reserved, is_throttled_on_equal)
+            status = thr.check_throttled_for(
+                pod, reserved, is_throttled_on_equal, accel_class=accel
+            )
             if status == "active":
                 active.append(thr)
             elif status == "insufficient":

@@ -80,6 +80,10 @@ class ThrottleController(ControllerBase):
         # crash recovery (engine/recovery.py)
         self.cache = ReservedResourceAmounts(num_key_mutex, clock=self.clock)
         self.reservation_ttl = reservation_ttl
+        # gang ledger (engine/gang.py), wired by the plugin: the
+        # unreserve-on-observe handshake notifies it as members' per-pod
+        # reservations release into status.used
+        self.gang_ledger = None
         self.device_manager = device_manager
         self.metrics_recorder = metrics_recorder
         self.reconcile_func = self.reconcile
@@ -317,6 +321,8 @@ class ThrottleController(ControllerBase):
         removed = self.cache.remove_pod(thr.key, pod)
         if removed and self.device_manager is not None:
             self.device_manager.on_reservation_change(self.KIND, thr.key, self.cache)
+        if removed and self.gang_ledger is not None:
+            self.gang_ledger.note_unreserved(self.KIND, thr.key, pod.key)
         return removed
 
     # ----------------------------------------------------------------- check
@@ -331,9 +337,15 @@ class ThrottleController(ControllerBase):
         over the mirrored tensors; otherwise — or while the device circuit
         breaker is open after a dispatch failure (backend/tunnel death) —
         the host oracle loops, so a device outage degrades latency, never
-        availability."""
+        availability. An accel-class pod takes the host oracle whenever
+        any mirrored throttle declares accelClassThresholds: the device
+        planes carry only the base thresholds, and the host oracle is
+        where the per-class replacement resolves (api/types.py)."""
+        from ..api.pod import accel_class_of
+
+        accel = accel_class_of(pod)
         dm = self.device_manager
-        if dm is not None:
+        if dm is not None and not (accel and dm.has_accel_thresholds(self.KIND)):
             results = dm.guarded("check", dm.check_pod, pod, self.KIND, is_throttled_on_equal)
             if results is not None:
                 return self.classify_from_map(results)
@@ -343,7 +355,9 @@ class ThrottleController(ControllerBase):
         exceeds: List[Throttle] = []
         for thr in throttles:
             reserved, _ = self.cache.reserved_resource_amount(thr.key)
-            status = thr.check_throttled_for(pod, reserved, is_throttled_on_equal)
+            status = thr.check_throttled_for(
+                pod, reserved, is_throttled_on_equal, accel_class=accel
+            )
             if status == "active":
                 active.append(thr)
             elif status == "insufficient":

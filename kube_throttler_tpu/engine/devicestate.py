@@ -329,6 +329,11 @@ class _KindState:
         # directly instead of round-tripping Fraction→milli again
         # (~24µs of the echo's ~43µs); weakref finalizers evict
         self._used_raw: dict = {}
+        # col → the throttle's accelClassThresholds tuple (heterogeneity):
+        # sparse — only columns whose spec declares entries appear. Feeds
+        # encode_class_thresholds for the gang kernel and gates the
+        # accel-aware host routing (manager.has_accel_thresholds).
+        self.accel_cols: Dict[int, tuple] = {}
 
     def _alloc_pods(self, pcap: int) -> None:
         self.pod_req = np.zeros((pcap, self.R), dtype=np.int64)
@@ -529,6 +534,11 @@ class _KindState:
                     used,
                     "used_cnt", "used_cnt_present", "used_req", "used_req_present", col,
                 )
+        accel = thr.spec.accel_class_thresholds
+        if accel:
+            self.accel_cols[col] = accel
+        else:
+            self.accel_cols.pop(col, None)
         st = thr.status.throttled
         if not (diff and old.status.throttled == st):
             self.st_cnt_throttled[col] = st.resource_counts_pod
@@ -548,6 +558,7 @@ class _KindState:
         col = self.index.throttle_col(key)
         self.index.remove_throttle(key)
         if col is not None:
+            self.accel_cols.pop(col, None)
             self.thr_valid[col] = False
             self.res_cnt[col] = 0
             self.res_cnt_present[col] = False
@@ -1925,6 +1936,149 @@ class DeviceStateManager:
     def indexed_pod(self, kind: str, pod_key: str) -> Optional[Pod]:
         with self._lock:
             return self._kind(kind).index.indexed_pod(pod_key)
+
+    # -- gang admission (batched group feasibility, ops/gang_check.py) -----
+
+    def has_accel_thresholds(self, kind: str) -> bool:
+        """True when any mirrored throttle of ``kind`` declares
+        accelClassThresholds — the gate that routes accel-class pods'
+        single-pod checks to the class-aware host oracle (the per-pod
+        device planes carry only the base thresholds). Lock-free len
+        probe: a torn read mis-routes at most one decision between two
+        CORRECT paths."""
+        return bool(self._kind(kind).accel_cols)
+
+    def gang_check_groups(self, groups) -> Dict[str, dict]:
+        """Batched all-or-nothing feasibility for a tick's worth of pod
+        groups: ``groups`` is ``[(group_key, [member Pod, ...],
+        accel_class|None)]``. ONE fused dispatch (``gang_check_both``)
+        evaluates every group against BOTH kinds' full throttle state —
+        per-(group, col) totals as segment-sum scatters over the same
+        (N, K) sparse matched-cols encoding the batch check uses — no
+        per-rank host loop and no per-kind second dispatch.
+
+        Returns ``{group_key: {"ok": bool, "kinds": {kind: {"ok",
+        "exceeds", "active", "blocked": [throttle_key, ...]}}}}`` — the
+        blocked keys feed reference-style reason strings host-side.
+
+        Locking mirrors check_pod: the main lock covers only the host
+        snapshot (member encodes, matched cols, plane copies, class-plane
+        encode); the dispatch and decode run outside it. Shapes ladder-pad
+        (members, groups, per-kind K) so a tick burst never recompiles."""
+        from ..ops.gang_check import gang_check_both
+        from ..ops.overrides import encode_class_thresholds
+
+        if not groups:
+            return {}
+        classes: List[str] = []
+        for _gk, _pods, cls in groups:
+            if cls and cls not in classes:
+                classes.append(cls)
+        members: List[Tuple[int, Pod]] = []
+        for g, (_gk, pods, _cls) in enumerate(groups):
+            for pod in pods:
+                members.append((g, pod))
+        N = _next_pow2(max(len(members), 1))
+        G = _next_pow2(max(len(groups), 1), lo=4)
+        gid = np.zeros(N, dtype=np.int32)
+        member_valid = np.zeros(N, dtype=bool)
+        gvalid = np.zeros(G, dtype=bool)
+        gvalid[: len(groups)] = True
+        gclass = np.zeros(G, dtype=np.int32)
+        for g, (_gk, _pods, cls) in enumerate(groups):
+            gclass[g] = (classes.index(cls) + 1) if cls else 0
+
+        per_kind: Dict[str, dict] = {}
+        col_key_maps: Dict[str, dict] = {}
+        with self._lock:
+            for kind in ("throttle", "clusterthrottle"):
+                self._kind(kind).ensure_capacity()
+            R = self.dims.capacity
+            pod_req = np.zeros((N, R), dtype=np.int64)
+            pod_present = np.zeros((N, R), dtype=bool)
+            member_cols: Dict[str, List[np.ndarray]] = {
+                "throttle": [], "clusterthrottle": []
+            }
+            for i, (g, pod) in enumerate(members):
+                gid[i] = g
+                member_valid[i] = True
+                row_req, row_pres = self._encoded_row(self.throttle, pod)
+                pod_req[i, : row_req.shape[1]] = row_req[0]
+                pod_present[i, : row_pres.shape[1]] = row_pres[0]
+                for kind in ("throttle", "clusterthrottle"):
+                    ks = self._kind(kind)
+                    prow = ks.index.pod_row(pod.key)
+                    if prow is not None:
+                        cols = np.nonzero(ks.index.mask[prow, : ks.tcap])[0]
+                    else:
+                        # pending pod not yet stored: compiled-row match,
+                        # same path as check_pod's PreFilter case
+                        with ks.index._lock:  # noqa: SLF001 — same-package access
+                            rowmask = (
+                                ks.index.match_row_cached_locked(pod)
+                                & ks.index._thr_valid
+                            )
+                        cols = np.nonzero(rowmask[: ks.tcap])[0]
+                    member_cols[kind].append(cols.astype(np.int32))
+            for kind in ("throttle", "clusterthrottle"):
+                ks = self._kind(kind)
+                kmax = max((c.size for c in member_cols[kind]), default=0)
+                K = _next_pow2(max(kmax, 1), lo=4)
+                cols_arr = np.full((N, K), -1, dtype=np.int32)
+                for i, cols in enumerate(member_cols[kind]):
+                    cols_arr[i, : cols.size] = cols
+                cls_cnt, cls_cnt_p, cls_req, cls_req_p = encode_class_thresholds(
+                    ks.thr_cnt, ks.thr_cnt_present, ks.thr_req,
+                    ks.thr_req_present, ks.accel_cols, classes, self.dims,
+                )
+                per_kind[kind] = {
+                    "pod_req": pod_req,
+                    "pod_present": pod_present,
+                    "member_valid": member_valid,
+                    "cols": cols_arr,
+                    "gid": gid,
+                    "thr_valid": ks.thr_valid.copy(),
+                    "cls_cnt": cls_cnt,
+                    "cls_cnt_present": cls_cnt_p,
+                    "cls_req": cls_req,
+                    "cls_req_present": cls_req_p,
+                    "st_cnt_throttled": ks.st_cnt_throttled.copy(),
+                    "st_req_flag_present": ks.st_req_flag_present.copy(),
+                    "st_req_throttled": ks.st_req_throttled.copy(),
+                    "au_cnt": (ks.used_cnt + ks.res_cnt),
+                    "au_req": (ks.used_req + ks.res_req),
+                }
+                col_key_maps[kind] = dict(ks.index._col_keys)  # noqa: SLF001
+
+        # ---- outside the lock: the single fused dispatch + decode --------
+        ok, (out_t, out_c) = gang_check_both(
+            per_kind["throttle"], per_kind["clusterthrottle"],
+            jnp.asarray(gclass), jnp.asarray(gvalid), num_groups=G,
+        )
+        ok = np.asarray(ok)
+        details = {"throttle": out_t, "clusterthrottle": out_c}
+        decoded = {
+            kind: tuple(np.asarray(a) for a in out)
+            for kind, out in details.items()
+        }
+        results: Dict[str, dict] = {}
+        for g, (gk, _pods, _cls) in enumerate(groups):
+            kinds_out = {}
+            for kind in ("throttle", "clusterthrottle"):
+                okk, exceeds, active, blocked = decoded[kind]
+                ckmap = col_key_maps[kind]
+                kinds_out[kind] = {
+                    "ok": bool(okk[g]),
+                    "exceeds": bool(exceeds[g]),
+                    "active": bool(active[g]),
+                    "blocked": [
+                        ckmap[c]
+                        for c in np.nonzero(blocked[g])[0].tolist()
+                        if c in ckmap
+                    ],
+                }
+            results[gk] = {"ok": bool(ok[g]), "kinds": kinds_out}
+        return results
 
     # -- used aggregation (replaces reconcile's per-throttle pod-sum loop,
     # throttle_controller.go:103-119) -------------------------------------

@@ -124,6 +124,12 @@ class StoreJournal:
         # read by probes/recovery (same stance as the counters below).
         self.fencing = None
         self.last_epoch = 0
+        # GANG control lines (engine/gang.py): group_key → {"op": last op
+        # seen ("begin"|"commit"|"rollback"), "members": [...]} — recovery
+        # reads begin-without-commit as a mid-reserve crash and rolls the
+        # group's member reservations back (GangLedger.rollback_uncommitted).
+        # Single-writer under the journal lock, read after replay.
+        self.gang_ops: dict = {}
         self._lines = 0
         self._file = None
         # running position of the journal content: byte length + sha256 of
@@ -253,6 +259,19 @@ class StoreJournal:
             # written — no store effect, but recovery/promotion read the
             # high-water term from it
             self.last_epoch = max(self.last_epoch, int(event.get("epoch", 0)))
+            return
+        if etype == "GANG":
+            # gang control line (engine/gang.py): group reserve/rollback
+            # audit stamp — no store effect; last op per group wins
+            group = str(event.get("group", ""))
+            if group:
+                entry = {"op": str(event.get("op", ""))}
+                members = event.get("members")
+                if members is not None:
+                    entry["members"] = [str(m) for m in members]
+                elif group in self.gang_ops and "members" in self.gang_ops[group]:
+                    entry["members"] = self.gang_ops[group]["members"]
+                self.gang_ops[group] = entry
             return
         kind = event["kind"]
         obj = object_from_dict({**event["object"], "kind": kind})
@@ -627,6 +646,36 @@ class StoreJournal:
         now — the tail-replay anchor a snapshot records at cut time."""
         with self._lock:
             return self._bytes, self._sha.hexdigest()
+
+    def append_gang(self, op: str, group_key: str, members=None) -> None:
+        """Append a GANG control line (engine/gang.py): ``op`` is
+        ``begin`` / ``commit`` / ``rollback``. No store effect; replays
+        into :attr:`gang_ops` so recovery can treat a begin-without-commit
+        tail as a mid-reserve crash. Stamps are advisory audit/defense
+        lines — the all-or-nothing invariant itself is held by the gang
+        lock around snapshot gathers (GangLedger) — so a fenced or closed
+        journal silently drops them like any other refused append."""
+        record = {"type": "GANG", "op": str(op), "group": str(group_key)}
+        if members is not None:
+            record["members"] = list(members)
+        with self._lock:
+            entry = {"op": str(op)}
+            if members is not None:
+                entry["members"] = list(members)
+            elif group_key in self.gang_ops and "members" in self.gang_ops[group_key]:
+                entry["members"] = self.gang_ops[group_key]["members"]
+            self.gang_ops[str(group_key)] = entry
+            if self._file is None:
+                return
+            if self.fencing is not None and self.fencing.is_stale():
+                self.stale_epoch_rejected += 1
+                return
+            data = (json.dumps(record) + "\n").encode("utf-8")
+            self._file.write(data.decode("utf-8"))
+            self._file.flush()
+            self._sha.update(data)
+            self._bytes += len(data)
+            self._lines += 1
 
     def set_epoch(self, epoch: int) -> None:
         """Append a fencing EPOCH control line (engine/replication.py):

@@ -156,11 +156,18 @@ class SnapshotManager:
         clock: Optional[Clock] = None,
         keep: int = 3,
         faults=None,
+        gang_ledger=None,
     ):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.store = store
         self.reservations = reservations or {}
+        # gang ledger (engine/gang.py): its lock is held around the
+        # reservation + gang capture below, which is what makes every
+        # snapshot gang-ATOMIC — a half-formed group reserve can never be
+        # observed by a snapshot, so recovery is always fully-reserved or
+        # fully-rolled-back
+        self.gang_ledger = gang_ledger
         self.device_manager = device_manager
         self.clock = clock or RealClock()
         self.keep = max(1, int(keep))
@@ -203,6 +210,8 @@ class SnapshotManager:
         """Materialize the payload under ONE store-lock hold (reentrant
         when triggered from dispatch), so objects, reservations, planes,
         and the journal anchor describe the same instant."""
+        import contextlib
+
         from ..api.serialization import object_to_dict
 
         with self.store._lock:  # noqa: SLF001 — same-package access
@@ -221,28 +230,43 @@ class SnapshotManager:
                 epoch = self.fencing.current()
             elif self.journal is not None:
                 epoch = self.journal.last_epoch
-            payload = {
-                "seq": seq,
-                "reason": reason,
-                "epoch": epoch,
-                "takenAt": now.isoformat(),
-                "rv": self.store.latest_resource_version,
-                "objects": objs,
-                "reservations": {
-                    kind: cache.snapshot_state(now)
-                    for kind, cache in self.reservations.items()
-                },
-                "published": (
-                    self.device_manager.published_flags()
-                    if self.device_manager is not None
-                    else None
-                ),
-                "journal": (
-                    dict(zip(("offset", "sha256"), self.journal.position()))
-                    if self.journal is not None
-                    else None
-                ),
-            }
+            # the gang lock spans the reservation AND gang captures: a
+            # reserve_group in flight holds it for its whole member loop,
+            # so this gather waits it out and never sees a partial group
+            # (lock order store → gang → reservation locks)
+            gang_guard = (
+                self.gang_ledger.lock
+                if self.gang_ledger is not None
+                else contextlib.nullcontext()
+            )
+            with gang_guard:
+                payload = {
+                    "seq": seq,
+                    "reason": reason,
+                    "epoch": epoch,
+                    "takenAt": now.isoformat(),
+                    "rv": self.store.latest_resource_version,
+                    "objects": objs,
+                    "reservations": {
+                        kind: cache.snapshot_state(now)
+                        for kind, cache in self.reservations.items()
+                    },
+                    "gangs": (
+                        self.gang_ledger.snapshot_state(now)
+                        if self.gang_ledger is not None
+                        else None
+                    ),
+                    "published": (
+                        self.device_manager.published_flags()
+                        if self.device_manager is not None
+                        else None
+                    ),
+                    "journal": (
+                        dict(zip(("offset", "sha256"), self.journal.position()))
+                        if self.journal is not None
+                        else None
+                    ),
+                }
         return payload
 
     def write(self, reason: str = "manual") -> Optional[str]:

@@ -17,14 +17,22 @@ DefaultControllerRateLimiter):
 Two extensions client-go does not have, both serving the two-lane status
 pipeline (flip-first publication):
 
-- **priority lane** (``add_priority`` / ``add_all_priority``): a second
-  FIFO drained before the normal one. Promoting an item already queued
-  normal MOVES it (an item is only ever queued once — dedup is
-  lane-global); promoting an item in processing re-queues it into the
-  priority lane at Done(). Used for throttles whose ``status.throttled``
-  flag is about to flip: they overtake the value-only refresh backlog,
-  which at full scale is the difference between ~100ms and multi-second
-  flip publication.
+- **ordered priority lane** (``add_priority`` / ``add_all_priority``): a
+  second lane drained before the normal one, ordered by **(priority desc,
+  age)** — a heap of ``(-priority, enqueue seq, item)``. Without explicit
+  priorities every item enters at priority 0 and the lane degenerates to
+  the original FIFO (age order), so the flip-first pipeline is unchanged;
+  WITH priorities (``add_all_priority(items, priorities={item: int})``)
+  candidates drain highest-priority-first, ties oldest-first — the
+  preemption-ordered admission lane (docs/gang_admission.md): when
+  capacity opens, flip candidates no longer drain in arbitrary key order.
+  Promoting an item already queued normal MOVES it (an item is only ever
+  queued once — dedup is lane-global); promoting an item in processing
+  re-queues it into the priority lane at Done() with its recorded
+  priority. Used for throttles whose ``status.throttled`` flag is about
+  to flip: they overtake the value-only refresh backlog, which at full
+  scale is the difference between ~100ms and multi-second flip
+  publication.
 - **enqueue timestamps** (``claim_ts``): the wall (monotonic) time of the
   FIRST add since the item was last handed out, claimed by the consumer at
   commit time — the "event" end of the event→publication lag histograms.
@@ -83,10 +91,13 @@ class RateLimitingQueue:
         self._cond = threading.Condition(self._lock)
         self._waker_cond = threading.Condition(self._lock)
         self._queue: List[str] = []  # FIFO of ready items (normal lane)
-        self._queue_hi: List[str] = []  # priority lane, drained first
+        # priority lane, drained first: heap of (-priority, seq, item) —
+        # highest priority first, ties in enqueue (age) order
+        self._queue_hi: List[Tuple[int, int, str]] = []
         self._hi: Set[str] = set()  # members of _queue_hi
-        # promoted while processing: done() re-queues into the hi lane
-        self._hi_pending: Set[str] = set()
+        # promoted while processing: done() re-queues into the hi lane at
+        # the recorded priority (item → priority)
+        self._hi_pending: Dict[str, int] = {}
         self._dirty: Set[str] = set()
         self._processing: Set[str] = set()
         self._failures: Dict[str, int] = {}
@@ -142,11 +153,19 @@ class RateLimitingQueue:
             if added:
                 self._cond.notify()
 
-    def add_priority(self, item: str) -> None:
-        self.add_all_priority((item,))
+    def add_priority(self, item: str, priority: int = 0) -> None:
+        self.add_all_priority((item,), priorities={item: priority} if priority else None)
 
-    def add_all_priority(self, items) -> None:
-        """Add/promote items into the priority lane (one lock hold). An
+    def _push_hi_locked(self, item: str, priority: int) -> None:
+        assert_held(self._lock, "RateLimitingQueue._push_hi_locked")
+        self._seq += 1
+        heapq.heappush(self._queue_hi, (-int(priority), self._seq, item))
+        self._hi.add(item)
+
+    def add_all_priority(self, items, priorities: Optional[Dict[str, int]] = None) -> None:
+        """Add/promote items into the ordered priority lane (one lock
+        hold). ``priorities`` (item → int, default 0) orders the drain
+        (priority desc, age); omitted, the lane is the original FIFO. An
         item already queued normal MOVES — the single-queued-once dedup
         invariant is lane-global, which is also what makes per-key
         ordering trivial (an item is never drained twice for one add). An
@@ -158,21 +177,21 @@ class RateLimitingQueue:
             added = False
             now = time.monotonic()
             for item in items:
+                prio = int(priorities.get(item, 0)) if priorities else 0
                 if item in self._hi:
                     continue  # already prioritized
                 if item in self._dirty:
                     if item in self._processing:
-                        self._hi_pending.add(item)
+                        self._hi_pending[item] = prio
                         continue
                     move.add(item)  # queued normal: relocate below
                 else:
                     self._dirty.add(item)
                     self._enqueue_ts.setdefault(item, now)
                     if item in self._processing:
-                        self._hi_pending.add(item)
+                        self._hi_pending[item] = prio
                         continue
-                self._hi.add(item)
-                self._queue_hi.append(item)
+                self._push_hi_locked(item, prio)
                 added = True
             if move:
                 # one filter pass relocates every promoted normal-lane item
@@ -186,7 +205,7 @@ class RateLimitingQueue:
         the normal lane (the flip express drain). Returns (item, was_hi)."""
         assert_held(self._lock, "RateLimitingQueue._pop_ready_locked")
         if self._queue_hi:
-            item = self._queue_hi.pop(0)
+            _, _, item = heapq.heappop(self._queue_hi)
             self._hi.discard(item)
             was_hi = True
         elif self._queue and not hi_only:
@@ -240,14 +259,12 @@ class RateLimitingQueue:
             self._claim_ts.pop(item, None)  # unclaimed: drop, don't leak
             if item in self._dirty:
                 if item in self._hi_pending:
-                    self._hi_pending.discard(item)
-                    self._hi.add(item)
-                    self._queue_hi.append(item)
+                    self._push_hi_locked(item, self._hi_pending.pop(item))
                 else:
                     self._queue.append(item)
                 self._cond.notify()
             else:
-                self._hi_pending.discard(item)
+                self._hi_pending.pop(item, None)
 
     # -- delay / rate limiting --------------------------------------------
 

@@ -55,6 +55,13 @@ crash.journal.group_commit  SIGKILL mid group-commit write: half the batch
                             buffer reaches the file (cut mid-line), so
                             recovery must see a clean batch prefix with
                             one torn tail (engine/journal.py on_batch)
+gang.reserve.partial        one member add of a gang reserve raises
+                            (engine/gang.py rolls the whole group back —
+                            the all-or-nothing failure path)
+crash.gang.partial_reserve  SIGKILL mid-gang-reserve: some members'
+                            reservations added, the rest not — recovery
+                            must land fully-reserved or fully-rolled-back,
+                            never a partial group (engine/gang.py)
 crash.snapshot.begin        SIGKILL before a snapshot write starts
 crash.snapshot.tmp_partial  SIGKILL with half the snapshot tmp file flushed
 crash.snapshot.pre_rename   SIGKILL after tmp fsync, before the atomic
@@ -122,6 +129,8 @@ KNOWN_SITES = frozenset(
         "journal.fsync",
         "device.dispatch",
         "ingest.batch.partial",
+        "gang.reserve.partial",
+        "crash.gang.partial_reserve",
         "crash.journal.append",
         "crash.journal.torn",
         "crash.journal.compact",

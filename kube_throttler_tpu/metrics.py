@@ -89,6 +89,13 @@ METRIC_NAMES = frozenset(
         "kube_throttler_recovery_duration_seconds",
         "kube_throttler_recovery_journal_lines_replayed",
         "kube_throttler_recovery_divergence_total",
+        # gang admission (register_gang_metrics / engine/gang.py): group
+        # ledger population + outcomes, and the batched group-feasibility
+        # kernel's dispatch latency (plugin.pre_filter_gang observes it)
+        "kube_throttler_gang_groups_pending",
+        "kube_throttler_gang_groups_admitted_total",
+        "kube_throttler_gang_groups_rolled_back_total",
+        "kube_throttler_gang_check_duration_seconds",
         # active/standby HA (register_ha_metrics / engine/replication.py)
         "kube_throttler_leader_state",
         "kube_throttler_failover_duration_seconds",
@@ -554,6 +561,46 @@ def register_recovery_metrics(
             rec_divergence.set_key((), float(r.divergences))
 
     registry.register_pre_expose(flush)
+
+
+def register_gang_metrics(registry: Registry, ledger) -> "HistogramVec":
+    """Gang-admission observability (engine/gang.py): ledger population
+    (groups reserved but not yet fully admitted) plus the all-or-nothing
+    outcome counters, sampled from the ledger at scrape time. Returns the
+    group-feasibility latency histogram the plugin observes per
+    ``pre_filter_gang`` dispatch (inline — scrape-time sampling would miss
+    the distribution)."""
+    pending_g = registry.gauge_vec(
+        "kube_throttler_gang_groups_pending",
+        "groups holding an all-or-nothing reserve, not yet fully admitted",
+        [],
+    )
+    admitted_c = registry.counter_vec(
+        "kube_throttler_gang_groups_admitted_total",
+        "groups whose every member was observed admitted",
+        [],
+    )
+    rolled_c = registry.counter_vec(
+        "kube_throttler_gang_groups_rolled_back_total",
+        "groups rolled back (member failure, deletion, TTL expiry, or an "
+        "explicit unreserve) — all member reservations released together",
+        [],
+    )
+    check_h = registry.histogram_vec(
+        "kube_throttler_gang_check_duration_seconds",
+        "batched group-feasibility evaluation latency (one dispatch per "
+        "scheduling tick, both kinds fused)",
+        [],
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+    )
+
+    def flush() -> None:
+        pending_g.set_key((), float(ledger.pending_groups()))
+        admitted_c.set_key((), float(ledger.groups_admitted_total))
+        rolled_c.set_key((), float(ledger.groups_rolled_back_total))
+
+    registry.register_pre_expose(flush)
+    return check_h
 
 
 def register_ha_metrics(registry: Registry, coordinator) -> None:

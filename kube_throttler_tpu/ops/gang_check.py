@@ -1,0 +1,170 @@
+"""Batched gang (pod-group) feasibility — "does the whole group fit under
+every matched throttle simultaneously", one dispatch per scheduling tick.
+
+Semantics are DERIVED from the per-pod 4-step check (ops/check.py), not
+invented: gang admission is defined as *sequential* per-pod admission —
+reserve member 1, check member 2 against used+reserved+member 1, and so on
+(engine/gang.py ``sequential_gang_check`` is that oracle, and the
+hypothesis property test pins this kernel to it). Under the PreFilter
+flags (onEqual=False; step-3 onEqual True for Throttle, False for
+ClusterThrottle) the sequential verdict is order-independent and collapses
+to a GROUP-LEVEL form — for every throttle column any member matches:
+
+- **member exceeds** (step 1): some matched member alone exceeds the
+  (class-resolved) threshold;
+- **active** (step 2): the persisted ``st_*`` flags block some matched
+  member (pod-count flag always; a request flag needs a member requesting
+  that dim non-zero);
+- **overflow** (steps 3+4 fused): ``used + reserved + group_total >
+  threshold`` on the count dim or any request dim some member requests
+  non-zero. The fusion is exact, not an approximation: with integer
+  counts, step 3's ``au + prefix ≥ thr`` at the last member equals
+  step 4's ``au + total > thr``; for requests, a positive final
+  contribution makes saturation of any strict prefix imply overflow of
+  the total. (Both step-3 onEqual variants collapse to the same strict
+  ``>``, which is why this kernel needs no static flag pair.)
+
+Heterogeneity: thresholds arrive as a per-class tensor ``[A, T]`` /
+``[A, T, R]`` (row 0 = the base effective thresholds; rows 1.. = the
+per-accel-class replacements, ops/overrides.encode_class_thresholds) and
+each group carries a class index — a gang is one job on one accelerator
+type, so the class is per-group, not per-member.
+
+Shapes: members [N] with matched cols [N,K] (-1 padded, the same sparse
+encoding as ``check_pods_gather``), group ids gid[N] in [0,G), groups
+padded to G. Group totals materialize as [G,T]/[G,T,R] scatter-adds —
+G is a small per-tick batch (ladder-padded), so the footprint is G× the
+throttle state, not P×T. Everything fuses into one jitted call per kind
+pair (``gang_check_both``): ONE dispatch per scheduling tick covers every
+group against both kinds, no per-rank host loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _gang_classify(
+    # member side
+    pod_req,  # int64[N,R]
+    pod_present,  # bool[N,R]
+    member_valid,  # bool[N]
+    cols,  # int32[N,K] (-1 padded)
+    gid,  # int32[N] group index per member
+    # throttle side (class-resolved thresholds + class-agnostic state)
+    thr_valid,  # bool[T]
+    cls_cnt,  # int64[A,T]
+    cls_cnt_present,  # bool[A,T]
+    cls_req,  # int64[A,T,R]
+    cls_req_present,  # bool[A,T,R]
+    st_cnt_throttled,  # bool[T]
+    st_req_flag_present,  # bool[T,R]
+    st_req_throttled,  # bool[T,R]
+    au_cnt,  # int64[T] used+reserved counts (0 where absent)
+    au_req,  # int64[T,R]
+    # group side
+    gclass,  # int32[G] per-group class row (0 = base)
+    gvalid,  # bool[G]
+    num_groups: int,
+):
+    """Core group classification → (ok bool[G], exceeds bool[G],
+    active bool[G], blocked bool[G,T])."""
+    G = num_groups
+    T = thr_valid.shape[0]
+    c = jnp.maximum(cols, 0)  # [N,K]
+    slot = (cols >= 0) & thr_valid[c] & member_valid[:, None]  # [N,K]
+    mclass = gclass[gid]  # [N] class row per member
+
+    pod_nonzero = pod_present & (pod_req != 0)  # [N,R]
+
+    # --- step 1 per slot: member alone vs its class threshold ------------
+    t_cnt = cls_cnt[mclass[:, None], c]  # [N,K]
+    t_cnt_p = cls_cnt_present[mclass[:, None], c]
+    t_req = cls_req[mclass[:, None], c]  # [N,K,R]
+    t_req_p = cls_req_present[mclass[:, None], c]
+    exceeds_slot = t_cnt_p & (1 > t_cnt)
+    exceeds_slot |= jnp.any(
+        t_req_p & pod_present[:, None, :] & (pod_req[:, None, :] > t_req)
+        & (pod_req[:, None, :] != 0),
+        axis=-1,
+    )
+    exceeds_slot &= slot
+
+    # --- step 2 per slot: persisted flags (class-agnostic) ---------------
+    active_slot = st_cnt_throttled[c] | jnp.any(
+        st_req_flag_present[c] & st_req_throttled[c] & pod_nonzero[:, None, :],
+        axis=-1,
+    )
+    active_slot &= slot
+
+    # per-group reductions of the member-level verdicts (scatter-max)
+    z_i32 = jnp.zeros((G,), dtype=jnp.int32)
+    g_exceeds = (
+        z_i32.at[gid].max(jnp.any(exceeds_slot, axis=1).astype(jnp.int32)) > 0
+    )
+    g_active = (
+        z_i32.at[gid].max(jnp.any(active_slot, axis=1).astype(jnp.int32)) > 0
+    )
+
+    # --- group totals per (group, col): segment-sum scatter ---------------
+    gid2 = jnp.broadcast_to(gid[:, None], c.shape)  # [N,K]
+    R = pod_req.shape[1]
+    g_cnt = jnp.zeros((G, T), dtype=jnp.int64).at[gid2, c].add(
+        slot.astype(jnp.int64)
+    )
+    g_req = jnp.zeros((G, T, R), dtype=jnp.int64).at[gid2, c].add(
+        jnp.where(slot[:, :, None], pod_req[:, None, :], 0)
+    )
+    g_nz = (
+        jnp.zeros((G, T, R), dtype=jnp.int32)
+        .at[gid2, c]
+        .max((slot[:, :, None] & pod_nonzero[:, None, :]).astype(jnp.int32))
+        > 0
+    )
+    affected = g_cnt > 0  # [G,T]
+
+    # --- steps 3+4 fused at group granularity -----------------------------
+    thr_cnt_g = cls_cnt[gclass]  # [G,T]
+    thr_cnt_p_g = cls_cnt_present[gclass]
+    thr_req_g = cls_req[gclass]  # [G,T,R]
+    thr_req_p_g = cls_req_present[gclass]
+    over_cnt = thr_cnt_p_g & (au_cnt[None, :] + g_cnt > thr_cnt_g)
+    over_req = jnp.any(
+        thr_req_p_g & g_nz & (au_req[None, :, :] + g_req > thr_req_g), axis=-1
+    )
+    blocked = affected & (over_cnt | over_req)
+
+    ok = gvalid & ~g_exceeds & ~g_active & ~jnp.any(blocked, axis=1)
+    return ok, g_exceeds & gvalid, g_active & gvalid, blocked & gvalid[:, None]
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def gang_check(
+    pod_req, pod_present, member_valid, cols, gid,
+    thr_valid, cls_cnt, cls_cnt_present, cls_req, cls_req_present,
+    st_cnt_throttled, st_req_flag_present, st_req_throttled,
+    au_cnt, au_req, gclass, gvalid, num_groups: int,
+):
+    """Single-kind batched gang feasibility (see module docstring)."""
+    return _gang_classify(
+        pod_req, pod_present, member_valid, cols, gid,
+        thr_valid, cls_cnt, cls_cnt_present, cls_req, cls_req_present,
+        st_cnt_throttled, st_req_flag_present, st_req_throttled,
+        au_cnt, au_req, gclass, gvalid, num_groups,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def gang_check_both(kind_a: dict, kind_b: dict, gclass, gvalid, num_groups: int):
+    """BOTH kinds' group feasibility in ONE jitted dispatch — the per-tick
+    form the device manager serves (``kind_a``/``kind_b`` are dicts of the
+    per-kind operands of :func:`gang_check` minus gclass/gvalid; dict
+    pytrees keep the signature readable). Returns ``(ok, per-kind detail)``
+    where ``ok = ok_a ∧ ok_b`` and detail carries each kind's
+    (ok, exceeds, active, blocked[G,T]) for reason construction."""
+    out_a = _gang_classify(**kind_a, gclass=gclass, gvalid=gvalid, num_groups=num_groups)
+    out_b = _gang_classify(**kind_b, gclass=gclass, gvalid=gvalid, num_groups=num_groups)
+    return out_a[0] & out_b[0], (out_a, out_b)

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from typing import Mapping
+
 from ..api.types import RFC3339ParseError, ThrottleSpecBase
 from ..quantity import to_milli
 from .schema import DimRegistry
@@ -179,6 +181,62 @@ def encode_override_schedule(
         spec_req=jnp.asarray(spec_req),
         spec_req_present=jnp.asarray(spec_req_present),
     )
+
+
+def encode_class_thresholds(
+    base_cnt: np.ndarray,  # int64[T] effective (override-resolved) thresholds
+    base_cnt_present: np.ndarray,  # bool[T]
+    base_req: np.ndarray,  # int64[T,R]
+    base_req_present: np.ndarray,  # bool[T,R]
+    accel_entries: Mapping[int, Sequence],  # col → (AccelClassThreshold, ...)
+    classes: Sequence[str],
+    dims: DimRegistry,
+):
+    """Per-(throttle, accel-class) effective-threshold tensor with
+    first-wins merge (heterogeneity-aware admission, ops/gang_check.py).
+
+    Produces the ``[A, T]`` / ``[A, T, R]`` planes the gang kernel gathers
+    per group: row 0 is the BASE effective threshold (exactly the staging
+    planes the per-pod check kernel reads — already override-resolved), and
+    row 1+a is the fleet seen through accelerator class ``classes[a]``:
+    wherever a throttle column declares an ``accelClassThresholds`` entry
+    for that class, the FIRST matching entry's threshold REPLACES the whole
+    base row (counts and requests both — the same whole-replacement
+    semantics as the temporary-override merge, api/types.py
+    ``AccelClassThreshold``); columns without a matching entry keep the
+    base row. ``accel_entries`` maps device column → the spec's entry
+    tuple; only those sparse columns are touched, so the encode is
+    O(A × accel-throttles), not O(A × T)."""
+    T = base_cnt.shape[0]
+    R = base_req.shape[1]
+    A = 1 + len(classes)
+    cnt = np.tile(base_cnt, (A, 1))
+    cnt_p = np.tile(base_cnt_present, (A, 1))
+    req = np.tile(base_req, (A, 1, 1))
+    req_p = np.tile(base_req_present, (A, 1, 1))
+    for a, cls in enumerate(classes, start=1):
+        for col, entries in accel_entries.items():
+            if col >= T:
+                continue  # racing capacity growth: column not encoded yet
+            entry = next((e for e in entries if e.accel_class == cls), None)
+            if entry is None:
+                continue
+            thr = entry.threshold
+            if thr.resource_counts is not None:
+                cnt[a, col] = thr.resource_counts
+                cnt_p[a, col] = True
+            else:
+                cnt[a, col] = 0
+                cnt_p[a, col] = False
+            req[a, col, :] = 0
+            req_p[a, col, :] = False
+            for name, q in (thr.resource_requests or {}).items():
+                j = dims.index_of(name)
+                if j >= R:
+                    continue  # dim registered after the planes were sized
+                req[a, col, j] = to_milli(q)
+                req_p[a, col, j] = True
+    return cnt, cnt_p, req, req_p
 
 
 @jax.jit

@@ -44,6 +44,11 @@ class KubeThrottlerPluginArgs:
     # recovery rebases the remaining budget on restore
     # (engine/reservations.py)
     reservation_ttl: Optional[timedelta] = None
+    # expiry for GANG group reserves (engine/gang.py): a half-bound gang
+    # whose scheduler died must free ALL ranks' capacity together. None
+    # falls back to reservation_ttl (and to reserve-until-observed when
+    # that is None too)
+    gang_reservation_ttl: Optional[timedelta] = None
 
 
 def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
@@ -88,6 +93,16 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
         # admission inequality's `reserved` term silently vanishes
         raise ValueError(f"reservationTTL must be positive: {raw_ttl!r}")
 
+    raw_gang_ttl = config.get("gangReservationTTL", 0)
+    if isinstance(raw_gang_ttl, str) and raw_gang_ttl:
+        gang_ttl = _parse_go_duration(raw_gang_ttl)
+    elif isinstance(raw_gang_ttl, (int, float)) and raw_gang_ttl:
+        gang_ttl = timedelta(seconds=float(raw_gang_ttl))
+    else:
+        gang_ttl = None
+    if gang_ttl is not None and gang_ttl <= timedelta(0):
+        raise ValueError(f"gangReservationTTL must be positive: {raw_gang_ttl!r}")
+
     return KubeThrottlerPluginArgs(
         name=name,
         target_scheduler_name=target,
@@ -96,6 +111,7 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
         controller_threadiness=threadiness,
         num_key_mutex=int(config.get("numKeyMutex", 0) or 0) or 128,
         reservation_ttl=reservation_ttl,
+        gang_reservation_ttl=gang_ttl,
     )
 
 

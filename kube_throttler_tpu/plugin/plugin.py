@@ -146,6 +146,43 @@ class KubeThrottler:
             )
         self.throttle_ctr.tracer = self.tracer
         self.cluster_throttle_ctr.tracer = self.tracer
+        # gang (pod-group) admission ledger (engine/gang.py): all-or-
+        # nothing reserve/rollback over BOTH kinds' reservation caches.
+        # The device mirror learns of member reservations through the same
+        # on_reservation_change hook the per-pod paths use; the journal is
+        # late-bound by the CLI (standalone mode) for GANG audit stamps.
+        from ..engine.gang import GangLedger
+
+        dm = self.device_manager
+        self.gang = GangLedger(
+            caches={
+                "throttle": self.throttle_ctr.cache,
+                "clusterthrottle": self.cluster_throttle_ctr.cache,
+            },
+            clock=clock,
+            on_change=(
+                (
+                    lambda kind, key: dm.on_reservation_change(
+                        kind,
+                        key,
+                        self.throttle_ctr.cache
+                        if kind == "throttle"
+                        else self.cluster_throttle_ctr.cache,
+                    )
+                )
+                if dm is not None
+                else None
+            ),
+            default_ttl=(args.gang_reservation_ttl or args.reservation_ttl),
+        )
+        self.throttle_ctr.gang_ledger = self.gang
+        self.cluster_throttle_ctr.gang_ledger = self.gang
+        # member lifecycle: bound members admit, deleted pre-admission
+        # members roll the whole group back (store → gang lock order)
+        store.add_event_handler("Pod", self.gang.on_pod_event, replay=False)
+        from ..metrics import register_gang_metrics
+
+        self._gang_check_hist = register_gang_metrics(self.metrics_registry, self.gang)
         # local-path flip/total status-lag histograms; a lane-aware remote
         # writer (AsyncStatusCommitter) observes the "remote" path itself
         lag_metrics = StatusLagMetrics(self.metrics_registry, "local")
@@ -456,6 +493,115 @@ class KubeThrottler:
             self.cluster_throttle_ctr.unreserve(pod)
         except Exception:
             logger.exception("Failed to unreserve pod %s in ClusterThrottleController", pod.key)
+
+    # -------------------------------------------------------- gang admission
+
+    def pre_filter_gang(self, group_key: str, pods: Sequence[Pod]) -> Status:
+        """All-or-nothing group feasibility: does the WHOLE group fit under
+        every matched throttle of both kinds simultaneously? The device
+        path is ONE batched dispatch (DeviceStateManager.gang_check_groups
+        → ops/gang_check.gang_check_both); the host fallback (no device /
+        breaker open) is the sequential per-pod oracle the kernel is
+        property-tested against. Per-member reasons come from the oracle;
+        the device path reports blocking throttle keys per kind."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            with self.tracer.trace("prefilter_gang"):
+                return self._pre_filter_gang(group_key, pods)
+        finally:
+            if self._gang_check_hist is not None:
+                self._gang_check_hist.observe_key((), _time.monotonic() - t0)
+
+    def _pre_filter_gang(self, group_key: str, pods: Sequence[Pod]) -> Status:
+        from ..api.pod import accel_class_of
+        from ..engine.gang import sequential_gang_check
+
+        if not pods:
+            return Status(StatusCode.SUCCESS)
+        accel = next((c for c in map(accel_class_of, pods) if c), None)
+        dm = self.device_manager
+        if dm is not None:
+            out = dm.guarded(
+                "gang", dm.gang_check_groups, [(group_key, list(pods), accel)]
+            )
+            if out is not None:
+                verdict = out[group_key]
+                if verdict["ok"]:
+                    return Status(StatusCode.SUCCESS)
+                reasons: List[str] = []
+                for kind in ("clusterthrottle", "throttle"):
+                    detail = verdict["kinds"][kind]
+                    if detail["exceeds"]:
+                        reasons.append(f"gang:{kind}[pod-requests-exceeds-threshold]")
+                    if detail["active"]:
+                        reasons.append(f"gang:{kind}[active]")
+                    if detail["blocked"]:
+                        reasons.append(
+                            f"gang:{kind}[group-insufficient]="
+                            + ",".join(sorted(detail["blocked"]))
+                        )
+                vlog(2, "gang %s is unschedulable: %s", group_key, "; ".join(reasons))
+                return Status(StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE, tuple(reasons))
+        try:
+            feasible, blocked = sequential_gang_check(
+                pods,
+                (
+                    ("throttle", self.throttle_ctr, False),
+                    ("clusterthrottle", self.cluster_throttle_ctr, False),
+                ),
+            )
+        except Exception as e:
+            return Status(StatusCode.ERROR, (str(e),))
+        if feasible:
+            return Status(StatusCode.SUCCESS)
+        reasons = tuple(
+            f"gang:{pod_key}: " + "; ".join(blocks)
+            for pod_key, blocks in sorted(blocked.items())
+        )
+        vlog(2, "gang %s is unschedulable: %s", group_key, "; ".join(reasons))
+        return Status(StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE, reasons)
+
+    def reserve_gang(self, group_key: str, pods: Sequence[Pod]) -> Status:
+        """Atomic multi-pod Reserve: every member on every matched throttle
+        of both kinds, or nothing (engine/gang.py). The scheduler calls
+        this once per admitted group instead of N per-pod reserves."""
+        with self.tracer.trace("reserve_gang"):
+            member_keys = {}
+            try:
+                for pod in pods:
+                    member_keys[pod.key] = {
+                        "throttle": self.throttle_ctr.affected_throttle_keys(pod),
+                        "clusterthrottle": (
+                            self.cluster_throttle_ctr.affected_cluster_throttle_keys(pod)
+                        ),
+                    }
+            except Exception as e:
+                return Status(
+                    StatusCode.ERROR,
+                    (f"Failed to resolve gang {group_key} member throttles: {e}",),
+                )
+            try:
+                ok = self.gang.reserve_group(group_key, list(pods), member_keys)
+            except Exception as e:
+                return Status(
+                    StatusCode.ERROR, (f"Failed to reserve gang {group_key}: {e}",)
+                )
+            if not ok:
+                return Status(
+                    StatusCode.ERROR,
+                    (f"gang {group_key}: member reserve failed (rolled back)",),
+                )
+            return Status(StatusCode.SUCCESS)
+
+    def unreserve_gang(self, group_key: str) -> None:
+        """Release the whole group reserve (scheduler Unreserve analog)."""
+        with self.tracer.trace("unreserve_gang"):
+            try:
+                self.gang.rollback_group(group_key, "unreserve")
+            except Exception:
+                logger.exception("Failed to unreserve gang %s", group_key)
 
     # ----------------------------------------------------------------- events
 

@@ -34,7 +34,7 @@ import time
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
-from .api.pod import Pod
+from .api.pod import Pod, PodGroup, pod_group_of, priority_of
 from .quantity import parse_quantity
 from .resourcelist import add as rl_add, pod_request_resource_list, sub as rl_sub
 from .utils.lockorder import assert_held, guard_attrs, make_condition, make_lock
@@ -66,6 +66,11 @@ class _QueuedPod:
     key: str
     attempts: int = 0
     not_before: float = 0.0  # monotonic gate for backoff
+    # preemption-ordered admission (docs/gang_admission.md): when capacity
+    # opens, eligible candidates are drained highest priority first, ties
+    # oldest first — not in arbitrary queue order
+    priority: int = 0
+    enqueued_at: float = 0.0  # monotonic first-enqueue time (the age tiebreak)
 
 
 @guard_attrs
@@ -193,8 +198,18 @@ class Scheduler:
                     self._track_usage_locked(held, pod, +1)
                 elif self._is_schedulable_target(pod) and pod.key not in self._queued_keys:
                     self._queued_keys.add(pod.key)
-                    self._active.append(_QueuedPod(pod.key))
+                    self._active.append(
+                        _QueuedPod(
+                            pod.key,
+                            priority=priority_of(pod),
+                            enqueued_at=time.monotonic(),
+                        )
+                    )
                     self._cv.notify_all()
+            # a new pod is a requeue hint too (EventsToRegister lists Pod
+            # events): a parked gang member may only need this arrival to
+            # complete its group
+            self._wake_unschedulable()
             return
         # MODIFIED: adjust occupancy for bind/unbind/termination transitions
         # AND in-place request edits (same node, different requests), then
@@ -264,13 +279,26 @@ class Scheduler:
         return None
 
     def schedule_one(self, now: Optional[float] = None) -> Optional[str]:
-        """Run one scheduling cycle. Returns the bound pod's key, or None if
-        nothing was schedulable (queue empty or all gated by backoff)."""
+        """Run one scheduling cycle. Returns the bound pod's key (a gang
+        cycle returns the triggering member's key after binding the whole
+        group), or None if nothing was schedulable (queue empty or all
+        gated by backoff).
+
+        Candidate selection is preemption-ordered: among backoff-eligible
+        queued pods, highest priority first, ties oldest-first — so when
+        capacity opens the drain order is (priority, age), not whatever
+        order the queue happened to accumulate."""
         now = time.monotonic() if now is None else now
         with self._cv:
-            idx = next(
-                (i for i, q in enumerate(self._active) if q.not_before <= now), None
-            )
+            idx = None
+            best = None
+            for i, q in enumerate(self._active):
+                if q.not_before > now:
+                    continue
+                rank = (-q.priority, q.enqueued_at)
+                if best is None or rank < best:
+                    best = rank
+                    idx = i
             if idx is None:
                 return None
             queued = self._active.pop(idx)
@@ -287,6 +315,9 @@ class Scheduler:
             return None
 
         queued.attempts += 1
+        group = pod_group_of(pod)
+        if group is not None:
+            return self._schedule_gang(queued, pod, group, now, gen)
         status = self.plugin.pre_filter(pod)
         if not status.is_success():
             self._record_failed_scheduling(pod, status.message())
@@ -324,6 +355,130 @@ class Scheduler:
         with self._cv:
             self._queued_keys.discard(queued.key)
         vlog(3, "scheduled %s -> %s", pod.key, node.name)
+        return pod.key
+
+    # -- gang scheduling ---------------------------------------------------
+
+    def _gang_members(self, group: PodGroup, namespace: str) -> List[Pod]:
+        """Pending schedulable members of ``group`` in ``namespace``,
+        name-sorted for a deterministic admission set."""
+        members = [
+            p
+            for p in self.store.list_pods(namespace)
+            if self._is_schedulable_target(p)
+            and (g := pod_group_of(p)) is not None
+            and g.key == group.key
+        ]
+        members.sort(key=lambda p: p.name)
+        return members
+
+    def _pick_nodes_for(self, pods: List[Pod]) -> Optional[List[Node]]:
+        """Greedy all-members placement with TENTATIVE occupancy: either
+        every member gets a node (respecting max-pods and declared
+        allocatable against the members placed before it) or the whole
+        placement fails — the node-capacity half of all-or-nothing."""
+        with self._cv:
+            counts = dict(self._bound_per_node)
+            used = {
+                name: dict(self._alloc_used[name])
+                for name, cap in self._alloc_cap.items()
+                if cap is not None
+            }
+            out: List[Node] = []
+            for pod in pods:
+                req = pod_request_resource_list(pod)
+                chosen = None
+                for node in self.nodes:
+                    if counts[node.name] >= node.max_pods:
+                        continue
+                    cap = self._alloc_cap[node.name]
+                    if cap is not None:
+                        u = used[node.name]
+                        if any(
+                            q != 0
+                            and (cap.get(r) is None or u.get(r, 0) + q > cap[r])
+                            for r, q in req.items()
+                        ):
+                            continue
+                    chosen = node
+                    break
+                if chosen is None:
+                    return None
+                counts[chosen.name] += 1
+                if self._alloc_cap[chosen.name] is not None:
+                    rl_add(used[chosen.name], req)
+                out.append(chosen)
+            return out
+
+    def _schedule_gang(
+        self, queued: _QueuedPod, pod: Pod, group: PodGroup, now: float, gen: int
+    ) -> Optional[str]:
+        """One gang admission cycle, triggered by ANY member's pop:
+        gather the pending members → group PreFilter (one batched
+        feasibility dispatch) → place every rank → atomic group Reserve →
+        bind all ranks. Any failure before the binds parks the triggering
+        member with the whole group unreserved (all-or-nothing)."""
+        members = self._gang_members(group, pod.namespace)
+        if len(members) < group.size:
+            self._record_failed_scheduling(
+                pod,
+                f"gang {group.key}: waiting for members "
+                f"({len(members)}/{group.size} present)",
+            )
+            self._park(queued, now, gen)
+            return None
+        members = members[: group.size]
+
+        status = self.plugin.pre_filter_gang(group.key, members)
+        if not status.is_success():
+            self._record_failed_scheduling(pod, status.message())
+            self._park(queued, now, gen)
+            return None
+
+        nodes = self._pick_nodes_for(members)
+        if nodes is None:
+            self._record_failed_scheduling(
+                pod,
+                "0/%d nodes can place all %d ranks of gang %s"
+                % (len(self.nodes), group.size, group.key),
+            )
+            self._park(queued, now, gen)
+            return None
+
+        reserve_status = self.plugin.reserve_gang(group.key, members)
+        if not reserve_status.is_success():
+            self.plugin.unreserve_gang(group.key)
+            self._park(queued, now, gen)
+            return None
+
+        for member, node in zip(members, nodes):
+            try:
+                self.store.mutate(
+                    "Pod",
+                    member.key,
+                    lambda cur, n=node.name: replace(
+                        cur, spec=replace(cur.spec, node_name=n)
+                    ),
+                )
+            except Exception:
+                logger.exception(
+                    "gang %s: bind failed for %s; releasing the group reserve",
+                    group.key, member.key,
+                )
+                # already-bound ranks are admitted (their reservations ride
+                # the normal unreserve-on-observe handshake); the rest of
+                # the group's reserve is released together
+                self.plugin.unreserve_gang(group.key)
+                self._park(queued, now, gen)
+                return None
+
+        with self._cv:
+            for member in members:
+                self._queued_keys.discard(member.key)
+                self._unschedulable.pop(member.key, None)
+            member_keys = {m.key for m in members}
+            self._active = [q for q in self._active if q.key not in member_keys]
+        vlog(3, "gang %s scheduled: %d rank(s)", group.key, len(members))
         return pod.key
 
     def _park(self, queued: _QueuedPod, now: float, gen: Optional[int] = None) -> None:

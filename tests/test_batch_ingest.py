@@ -537,7 +537,7 @@ class TestIngestFlipPromotion:
             ]
         )
         with wq._lock:  # noqa: SLF001 — lane introspection
-            hi = list(wq._queue_hi)
+            hi = [item for _, _, item in wq._queue_hi]  # heap of (-prio, seq, item)
         assert "default/t0" in hi
         plugin.run_pending_once()
         thr = store.get_throttle("default", "t0")

@@ -430,6 +430,17 @@ class TestCrashHarness:
         assert report["killed"] is True
         assert report["mode"] in ("tail", "genesis", "snapshot-only")
 
+    def test_gang_partial_reserve_smoke(self, tmp_path):
+        """Tier-1 smoke for the gang crash site: SIGKILL mid-group-reserve
+        recovers to fully-reserved or fully-rolled-back — run_crash_cycle's
+        oracle 5 asserts no partial group and no orphan member
+        reservations."""
+        report = crashtest.run_crash_cycle(
+            "crash.gang.partial_reserve", 0, str(tmp_path), events=120
+        )
+        assert report["killed"] is True
+        assert report["mode"] in ("tail", "genesis", "snapshot-only")
+
     @pytest.mark.slow
     @pytest.mark.parametrize("site", crashtest.CRASH_SITES)
     @pytest.mark.parametrize("seed", [0, 1, 2])

@@ -56,7 +56,7 @@ def test_crd_names_and_scope():
 
 def test_example_manifests_validate_and_roundtrip():
     for name in ["throttle.yaml", "clusterthrottle.yaml", "throttle-with-overrides.yaml"]:
-        for raw in _load_all(REPO / "example" / name):
+        for raw in _load_all(REPO / "examples" / name):
             # kubectl-style YAML→JSON normalization (RFC3339 strings, typo keys)
             doc = serialization.normalize_manifest(raw)
             assert crd.validate(doc) == [], (name, crd.validate(doc))
@@ -68,7 +68,7 @@ def test_example_manifests_validate_and_roundtrip():
 
 
 def test_example_pods_parse():
-    pods = [serialization.pod_from_dict(d) for d in _load_all(REPO / "example" / "pods.yaml")]
+    pods = [serialization.pod_from_dict(d) for d in _load_all(REPO / "examples" / "pods.yaml")]
     assert [p.name for p in pods] == ["pod1", "pod2", "pod1m", "pod3"]
     assert all(p.spec.scheduler_name == "my-scheduler" for p in pods)
 

@@ -54,6 +54,7 @@ CRASH_SITES = (
     "crash.journal.torn",
     "crash.journal.compact",
     "crash.journal.group_commit",
+    "crash.gang.partial_reserve",
     "crash.snapshot.begin",
     "crash.snapshot.tmp_partial",
     "crash.snapshot.pre_rename",
@@ -79,6 +80,10 @@ def default_hit(site: str, seed: int) -> int:
         # hit once per micro-batch group commit (~a third of events flow
         # through batches): die at different batches per seed
         return 2 + 3 * seed
+    if site == "crash.gang.partial_reserve":
+        # hit once per gang MEMBER-key add (~2-4 per gang reserve): odd
+        # indices land mid-group — the exact partial-reserve instant
+        return 3 + 8 * seed
     return 1 + seed
 
 
@@ -164,6 +169,7 @@ def _recompute_status(store, thr):
 
 def run_child(args) -> int:
     from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.engine.gang import GangLedger
     from kube_throttler_tpu.engine.recovery import RecoveryManager
     from kube_throttler_tpu.engine.reservations import ReservedResourceAmounts
     from kube_throttler_tpu.engine.snapshot import SnapshotManager
@@ -185,12 +191,15 @@ def run_child(args) -> int:
         "clusterthrottle": ReservedResourceAmounts(8),
     }
     recovery.restore_reservations(reservations)
+    gangs = GangLedger(caches=reservations, journal=journal, faults=plan)
+    recovery.restore_gangs(gangs, journal)
     snapshotter = SnapshotManager(
         args.dir,
         store,
         reservations=reservations,
         keep=args.keep,
         faults=plan,
+        gang_ledger=gangs,
     )
     snapshotter.bind_journal(journal, every_lines=args.snapshot_every)
 
@@ -260,10 +269,37 @@ def run_child(args) -> int:
                 ),
             )
             store.update_throttle_spec(replace(thr, spec=new_spec))
-        elif op < 0.9:  # reconcile stand-in: status write (journaled)
+        elif op < 0.88:  # reconcile stand-in: status write (journaled)
             name = rng.choice(throttles)
             thr = store.get_throttle("default", name)
             store.update_throttle_status(_recompute_status(store, thr))
+        elif op < 0.95:  # gang churn: all-or-nothing group reserve/rollback
+            if rng.random() < 0.75 or not gangs.pending_groups():
+                name = rng.choice(throttles)
+                gid = rng.randrange(10**6)
+                members = [
+                    make_pod(
+                        f"gang{gid}-r{i}",
+                        labels={"grp": name},
+                        requests={"cpu": "250m"},
+                        group=f"g{gid}",
+                        group_size=rng.randrange(2, 5),
+                    )
+                    for i in range(rng.randrange(2, 5))
+                ]
+                member_keys = {
+                    p.key: {"throttle": [f"default/{name}"]} for p in members
+                }
+                ttl = rng.choice([None, 10.0, 60.0])
+                # crash.gang.partial_reserve fires INSIDE this loop — the
+                # oracle must then find either every member reserved in
+                # the recovered state or none of them
+                gangs.reserve_group(f"default/g{gid}", members, member_keys, ttl=ttl)
+            else:
+                # roll an existing group back through the journaled path
+                rec = next(iter(gangs._groups.values()), None)  # noqa: SLF001
+                if rec is not None:
+                    gangs.rollback_group(rec.group_key, "workload churn")
         else:  # reservation churn with mixed TTLs
             name = rng.choice(throttles)
             cache = reservations["throttle"]
@@ -399,6 +435,8 @@ def run_crash_cycle(
         shutil.copytree(data_dir, d)
 
     # --- recovered state: snapshot + journal tail ------------------------
+    from kube_throttler_tpu.engine.gang import GangLedger
+
     recovered = Store()
     rec = RecoveryManager(recovered_dir, compact_after=10**9)
     rec_journal = rec.recover_store(recovered)
@@ -407,6 +445,8 @@ def run_crash_cycle(
         "clusterthrottle": ReservedResourceAmounts(8),
     }
     rec.restore_reservations(caches)
+    gangs = GangLedger(caches=caches)
+    rec.restore_gangs(gangs, rec_journal)
     rec_journal.close()
 
     # --- pure state: from-genesis journal replay, snapshots ignored ------
@@ -469,6 +509,55 @@ def run_crash_cycle(
         assert not missing, (
             f"{site} seed={seed}: non-TTL reservations lost in restore: {missing}"
         )
+
+    # oracle 5: gang all-or-nothing — every restored group is FULLY
+    # reserved (each pending member holds a reservation on every recorded
+    # throttle key); any group whose journal tail ends in begin (crash
+    # mid-reserve) or rollback has NO surviving member reservation; and no
+    # gang-member reservation exists outside a restored group record
+    reserved_pairs = {
+        (tk, pk)
+        for cache in caches.values()
+        for tk in cache.throttle_keys()
+        for pk in cache.reserved_pod_keys(tk)
+    }
+    with gangs.lock:
+        records = {
+            gk: (
+                {pk: dict(kinds) for pk, kinds in r.members.items()},
+                set(r.admitted),
+            )
+            for gk, r in gangs._groups.items()  # noqa: SLF001 — oracle read
+        }
+    recorded_members = set()
+    for gk, (members, admitted) in records.items():
+        for pk, kinds in members.items():
+            recorded_members.add(pk)
+            if pk in admitted:
+                continue
+            for _kind, keys in kinds.items():
+                for key in keys:
+                    assert (key, pk) in reserved_pairs, (
+                        f"{site} seed={seed} hit={hit}: gang {gk} member {pk} "
+                        f"lost its reservation on {key} — PARTIAL group survived"
+                    )
+    for gk, entry in rec_journal.gang_ops.items():
+        if entry.get("op") == "commit":
+            continue
+        for pk in entry.get("members") or []:
+            holders = {tk for tk, p in reserved_pairs if p == pk}
+            assert not holders, (
+                f"{site} seed={seed} hit={hit}: gang {gk} ended '{entry['op']}' "
+                f"but member {pk} still holds reservations on {holders} — "
+                "partial reserve leaked through recovery"
+            )
+    for tk, pk in reserved_pairs:
+        name = pk.partition("/")[2]
+        if name.startswith("gang"):
+            assert pk in recorded_members, (
+                f"{site} seed={seed} hit={hit}: orphan gang-member "
+                f"reservation {pk} on {tk} outside any restored group"
+            )
 
     return {
         "site": site,
