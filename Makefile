@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress crash-test ha-test lint gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress crash-test ha-test scenario-test scenario-regression lint gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -18,6 +18,12 @@ crash-test:      ## SIGKILL crash-point matrix: every crash.* site x 3 seeds
 
 ha-test:         ## kill-the-leader failover matrix: every ha.* site x 3 seeds + split-brain fencing
 	$(PY) tools/hatest.py matrix
+
+scenario-test:   ## trace-driven scenario corpus x 3 seeds, every SLO gate enforced
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios matrix
+
+scenario-regression: ## prove the gates gate: clean vs injected-regression diff report
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios regression --name smoke
 
 lint:            ## static analyzer (lock discipline, JAX purity, registries) + syntax sanity
 	$(PY) -m compileall -q kube_throttler_tpu tools bench.py __graft_entry__.py

@@ -688,55 +688,17 @@ def bench_streaming(rng, T, R, label, n_events=1000):
     return eps
 
 
-def _served_throttle(i, groups, flip_band_mc=0):
-    """Throttle i selecting pod group g{i%groups}; threshold class varies so
-    probe verdicts mix (open / tight cpu / pod-count).
-
-    ``flip_band_mc`` > 0 carves a FLIP BAND out of the tight-cpu class:
-    every 24th throttle's cpu threshold sits AT the expected group cpu sum
-    (P/groups × the 400m churn mean), so the paced churn's random walk
-    around that sum produces real throttled↔not-throttled crossings — the
-    events the flip-lag percentiles measure. Without the band, a scale
-    mismatch leaves every cpu threshold far from the live sum (at 100k×10k
-    the group sum ~80 cpu dwarfs the 2-14 cpu class) and a whole window
-    can pass with zero flips, making flip_lag_p99 unmeasurable."""
-    from kube_throttler_tpu.api.types import (
-        LabelSelector,
-        ResourceAmount,
-        Throttle,
-        ThrottleSelector,
-        ThrottleSelectorTerm,
-        ThrottleSpec,
-    )
-
-    if flip_band_mc and i % 24 == 1:
-        threshold = ResourceAmount.of(requests={"cpu": f"{flip_band_mc}m"})
-    elif i % 3 == 0:
-        threshold = ResourceAmount.of(pod=10**6, requests={"cpu": "100000"})
-    elif i % 3 == 1:
-        threshold = ResourceAmount.of(requests={"cpu": f"{(i % 7 + 1) * 2}"})
-    else:
-        threshold = ResourceAmount.of(pod=(i % 50) + 5)
-    return Throttle(
-        name=f"t{i}",
-        spec=ThrottleSpec(
-            throttler_name="kube-throttler",
-            threshold=threshold,
-            selector=ThrottleSelector(
-                selector_terms=(
-                    ThrottleSelectorTerm(
-                        LabelSelector(match_labels={"grp": f"g{i % groups}"})
-                    ),
-                )
-            ),
-        ),
-    )
-
-
-def _flip_band_mc(P, groups):
-    """Expected group cpu sum in milli: P/groups pods × the 400m mean of
-    the churn generator's rng.randrange(1, 8) * 100 distribution."""
-    return round(P / groups * 400)
+# the serving-rung measurement anchors moved to the package so the
+# scenario engine's SLO gates and the bench ladder measure with ONE
+# implementation (kube_throttler_tpu/scenarios/measure.py); the historical
+# underscore names stay bound here for every rung below
+from kube_throttler_tpu.scenarios.measure import (  # noqa: E402
+    flip_band_mc as _flip_band_mc,
+    flip_watch_of as _flip_watch_of,
+    group_keys_of as _group_keys_of,
+    lag_tracker as _lag_tracker,
+    served_throttle as _served_throttle,
+)
 
 
 def build_served_stack(P, T, groups=500, label="served"):
@@ -1055,103 +1017,6 @@ def bench_served_tick(plugin, label):
     return dt
 
 
-def _lag_tracker():
-    """(pending, flip_pending, lock, lags, flip_lags, handler): handler
-    pops a key's oldest pending timestamp on its MODIFIED event and
-    records the lag sample — into ``lags`` always (total lag), and ALSO
-    into ``flip_lags`` when the write changed the throttled flags or the
-    calculated threshold (a FLIP: the only status change that alters
-    admission verdicts). The flip/total split is the bench-side mirror of
-    the daemon's kube_throttler_status_flip_lag_seconds histograms.
-
-    The two samples anchor to DIFFERENT events, deliberately:
-
-    - total lag anchors to the key's OLDEST unpublished event (the
-      staleness window — coalescing must not shrink it);
-    - flip lag anchors to the LATEST crossing event (``flip_pending``,
-      stamped by the churn generator when a group's running cpu sum
-      actually crosses a throttle's threshold — see ``_flip_watch_of``).
-      A value-only refresh queued 2 s ago does not make the *flag* wrong;
-      the flag is only wrong from the crossing onward, so pairing a flip
-      write with the oldest refresh event would overstate flip lag by the
-      whole refresh backlog. Latest-crossing (overwrite, not setdefault)
-      handles cross-back sequences: after cross→cross-back→cross, the
-      published flag is newly wrong from the LAST crossing, and anchoring
-      the first would blame the daemon for the interval the flag was
-      accidentally right. The stamp is popped only by a flip write —
-      clearing it on value-only writes would race a write computed from
-      pre-crossing aggregates landing just after the stamp. When no
-      crossing is pending for a flipping key (e.g. a calculatedThreshold
-      change), the sample falls back to the oldest-pending anchor
-      (conservative: overstates, never understates)."""
-    import threading as _threading
-
-    from kube_throttler_tpu.engine.store import EventType
-
-    pending: dict = {}
-    flip_pending: dict = {}
-    lock = _threading.Lock()
-    lags: list = []
-    flip_lags: list = []
-
-    def on_write(event):
-        if event.type != EventType.MODIFIED:
-            return
-        now = time.perf_counter()
-        key = event.obj.key
-        old = event.old_obj
-        flipped = old is not None and (
-            old.status.throttled != event.obj.status.throttled
-            or old.status.calculated_threshold.threshold
-            != event.obj.status.calculated_threshold.threshold
-        )
-        with lock:
-            t0 = pending.pop(key, None)
-            tf = flip_pending.pop(key, None) if flipped else None
-        if flipped:
-            anchor = tf if tf is not None else t0
-            if anchor is not None:
-                flip_lags.append(now - anchor)
-        if t0 is not None:
-            lags.append(now - t0)
-
-    return pending, flip_pending, lock, lags, flip_lags, on_write
-
-
-def _flip_watch_of(store):
-    """(flip_watch, run_sums) for crossing-anchored flip-lag measurement:
-    ``flip_watch`` maps group → [(throttle key, cpu threshold milli)] for
-    every throttle with a cpu-requests threshold; ``run_sums`` seeds each
-    group's running cpu sum (milli) from the stored pods — the same values
-    the churn generator seeds its per-pod ``prev`` from, so the
-    incremental sums track the daemon's ``status.used`` exactly."""
-    from kube_throttler_tpu.resourcelist import pod_request_resource_list
-
-    flip_watch: dict = {}
-    for thr in store.list_throttles():
-        cpu = (thr.spec.threshold.resource_requests or {}).get("cpu")
-        if cpu is None:
-            continue
-        g = thr.spec.selector.selector_terms[0].pod_selector.match_labels["grp"]
-        flip_watch.setdefault(g, []).append((thr.key, int(cpu * 1000)))
-    run_sums: dict = {}
-    for pod in store.list_pods():
-        g = pod.labels.get("grp")
-        if g is None:
-            continue
-        cpu = pod_request_resource_list(pod).get("cpu")
-        run_sums[g] = run_sums.get(g, 0) + (int(cpu * 1000) if cpu else 0)
-    return flip_watch, run_sums
-
-
-def _group_keys_of(store):
-    group_keys: dict = {}
-    for thr in store.list_throttles():
-        g = thr.spec.selector.selector_terms[0].pod_selector.match_labels["grp"]
-        group_keys.setdefault(g, []).append(thr.key)
-    return group_keys
-
-
 def _drive_pod_churn(store, group_keys, pending, pend_lock, rng, duration, pace_hz,
                      flip_state=None, apply=None):
     """The cfg5 churn generator, SHARED by the in-process and remote-wire
@@ -1251,7 +1116,7 @@ def bench_served_streaming(
 
     rng = random.Random(1)
     # key → time of the first event not yet reflected in a status write
-    pending, flip_pending, pend_lock, lags, flip_lags, on_throttle_write = (
+    pending, flip_pending, pend_lock, lags, flip_lags, _flip_walls, on_throttle_write = (
         _lag_tracker()
     )
     group_keys = _group_keys_of(store)
@@ -1806,7 +1671,7 @@ def bench_remote_pipeline(label, P=10000, T=1000, groups=500, duration=6.0, pace
     commit_counts: dict = {}
     # lag is remote-commit→remote-commit: the tracker watches the REMOTE
     # store's Throttle MODIFIEDs (the arriving status PUTs)
-    pending, flip_pending, pend_lock, lags, flip_lags, on_remote_status = (
+    pending, flip_pending, pend_lock, lags, flip_lags, _flip_walls, on_remote_status = (
         _lag_tracker()
     )
     group_keys = _group_keys_of(remote)

@@ -27,7 +27,8 @@ Server-side fault verbs: assigning a :class:`~..faults.FaultPlan` to
 distinguish from real ones — sites ``mock.list`` (500 / 410 / stall),
 ``mock.watch.cut`` (stream severed mid-flight), ``mock.watch.gone``
 (410 ERROR event mid-stream), ``mock.status.conflict`` (forced 409),
-``mock.status.error`` (500 on a status PUT) and ``mock.lease`` (lease
+``mock.status.error`` (500 on a status PUT), ``mock.status.delay`` (a
+status PUT stalls for the rule's delay) and ``mock.lease`` (lease
 endpoint 500s/409s/stalls — leader-election chaos). This is the other half
 of the fault matrix: client-side injection (transport.py) exercises our
 error handling; server-side verbs exercise the full wire round trip through
@@ -134,6 +135,11 @@ class MockApiServer:
         self._shutdown = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # serving generation: bumped by every start(); zombie watch loops
+        # from a previous incarnation compare their captured generation and
+        # exit instead of streaming from a "restarted" server (a real
+        # apiserver restart severs every stream)
+        self._generation = 0
         # coordination.k8s.io Lease objects (leader election): (ns, name) →
         # (doc, rv); versioned off their own counter under self._lock
         self._leases: Dict[Tuple[str, str], Tuple[Dict[str, Any], int]] = {}
@@ -290,6 +296,11 @@ class MockApiServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
         self._httpd.daemon_threads = True
+        # remember the RESOLVED port so a restart rebinds the same address
+        # (clients keep their base URL across an apiserver restart)
+        self._port = self._httpd.server_address[1]
+        self._shutdown = threading.Event()
+        self._generation += 1
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="mock-apiserver", daemon=True
         )
@@ -301,6 +312,38 @@ class MockApiServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+    # -- restart semantics (scenario engine: apiserver restart) -----------
+
+    stop_serving = stop  # alias: state survives; only the listener dies
+
+    def reset_rv_window(self) -> int:
+        """Fresh resourceVersion retention horizon, as if the restarted
+        apiserver's watch cache started empty over a compacted etcd: every
+        retained event log is dropped, the per-kind 410 floor jumps to the
+        CURRENT store RV, and outstanding LIST continue tokens expire. A
+        client re-watching from any pre-restart resume point gets the 410
+        ERROR event and must relist; a mid-pagination continue read gets
+        410 and falls back to an unpaginated LIST — together the
+        post-restart relist storm. Returns the new 410 floor."""
+        floor = self.store.latest_resource_version
+        with self._lock:
+            for kind in self._logs:
+                self._logs[kind].clear()
+                self._dropped_rv[kind] = floor
+            self._continues.clear()
+        return floor
+
+    def restart(self, reset_rv_window: bool = True, downtime_s: float = 0.0) -> None:
+        """Stop serving, optionally reset the RV window (the
+        apiserver-restart shape: clients must relist), wait ``downtime_s``
+        (connection-refused window), then serve again on the SAME port."""
+        self.stop_serving()
+        if reset_rv_window:
+            self.reset_rv_window()
+        if downtime_s > 0:
+            time.sleep(downtime_s)
+        self.start()
 
     @property
     def port(self) -> int:
@@ -458,6 +501,11 @@ class MockApiServer:
             return False
 
     def _serve_watch(self, handler, kind: str, query) -> None:
+        # capture THIS incarnation's shutdown event + generation: start()
+        # replaces the event, so a zombie loop re-reading self._shutdown
+        # after a restart would never see the stop signal
+        shutdown = self._shutdown
+        generation = self._generation
         since = int(query.get("resourceVersion", ["0"])[0] or "0")
         try:
             timeout_s = float(query.get("timeoutSeconds", ["0"])[0] or "0")
@@ -501,7 +549,7 @@ class MockApiServer:
                 if not self._write_watch_line(handler, {"type": etype, "object": obj}):
                     return
                 last_rv = rv
-            while not self._shutdown.is_set():
+            while not shutdown.is_set() and generation == self._generation:
                 if deadline is not None and time.monotonic() >= deadline:
                     break  # graceful timeoutSeconds expiry; client re-watches
                 fault = self._fault("mock.watch.cut")
@@ -542,37 +590,64 @@ class MockApiServer:
                     except OSError:
                         pass
                     return
+                got = None
                 try:
-                    rv, etype, obj = q.get(timeout=self.bookmark_interval)
+                    got = q.get(timeout=self.bookmark_interval)
                 except Empty:
-                    # the bookmark RV must never cover an event this watcher
-                    # has not been sent, or a reconnecting client resumes
-                    # past it and loses it forever. Read the store RV FIRST
-                    # (lock order is store→mock; taking mock then store
-                    # would deadlock against the recorder), then confirm
-                    # the queue is still empty under the mock lock: any
-                    # event recorded after the RV read is either already in
-                    # the queue (→ skip the bookmark) or carries a strictly
-                    # greater RV (→ the bookmark doesn't cover it).
-                    bm_rv = self.store.latest_resource_version
-                    with self._lock:
-                        if not q.empty():
-                            continue  # deliver the raced-in event first
-                    bookmark = {
-                        "type": "BOOKMARK",
-                        "object": {
-                            "kind": kind,
-                            "metadata": {"resourceVersion": str(bm_rv)},
-                        },
-                    }
-                    if not self._write_watch_line(handler, bookmark):
-                        return
+                    pass
+                if got is not None:
+                    rv, etype, obj = got
+                    # batch-drain: one wfile.write for everything queued
+                    # (the real apiserver's http2 frames coalesce the same
+                    # way). Per-event write+flush cost one GIL round trip
+                    # each — at ~1k ev/s with busy reconcile threads that
+                    # queueing dominated wire-in delivery latency.
+                    batch = [(rv, etype, obj)]
+                    while len(batch) < 64:
+                        try:
+                            batch.append(q.get_nowait())
+                        except Empty:
+                            break
+                    chunks = []
+                    for rv, etype, obj in batch:
+                        if rv <= last_rv:
+                            continue  # already replayed
+                        data = (
+                            json.dumps({"type": etype, "object": obj}).encode()
+                            + b"\n"
+                        )
+                        chunks.append(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                        last_rv = rv
+                    if chunks:
+                        try:
+                            handler.wfile.write(b"".join(chunks))
+                            handler.wfile.flush()
+                        except (BrokenPipeError, ConnectionResetError, OSError):
+                            return
                     continue
-                if rv <= last_rv:
-                    continue  # already replayed
-                if not self._write_watch_line(handler, {"type": etype, "object": obj}):
+                # idle stream (queue empty for a bookmark interval): the
+                # bookmark RV must never cover an event this watcher
+                # has not been sent, or a reconnecting client resumes
+                # past it and loses it forever. Read the store RV FIRST
+                # (lock order is store→mock; taking mock then store
+                # would deadlock against the recorder), then confirm
+                # the queue is still empty under the mock lock: any
+                # event recorded after the RV read is either already in
+                # the queue (→ skip the bookmark) or carries a strictly
+                # greater RV (→ the bookmark doesn't cover it).
+                bm_rv = self.store.latest_resource_version
+                with self._lock:
+                    if not q.empty():
+                        continue  # deliver the raced-in event first
+                bookmark = {
+                    "type": "BOOKMARK",
+                    "object": {
+                        "kind": kind,
+                        "metadata": {"resourceVersion": str(bm_rv)},
+                    },
+                }
+                if not self._write_watch_line(handler, bookmark):
                     return
-                last_rv = rv
             try:  # graceful stream end: chunked terminator → client sees EOF
                 handler.wfile.write(b"0\r\n\r\n")
             except OSError:
@@ -716,6 +791,10 @@ class MockApiServer:
         if fault is not None:
             handler._send_json(500, {"message": "injected apiserver error"})
             return
+        # mock.status.delay: the _fault helper already slept the rule's
+        # delay — the PUT then serves normally (publication slowdown, the
+        # scenario engine's injected-regression knob)
+        self._fault("mock.status.delay")
         kind = "Throttle" if m.group("ns") else "ClusterThrottle"
         rv_raw = str((body.get("metadata") or {}).get("resourceVersion", "") or "")
         try:

@@ -27,6 +27,19 @@ Overflow policy mirrors the bounded Watch queues (client/watch.py):
 relist. (Counting per batch would under-report the gap by the batch size —
 the exact single-event assumption this subsystem must not reintroduce.)
 
+Overload posture (the scenario corpus' storm gates): shedding is
+VERDICT-SAFE. Only pod upserts — status-lag refreshes the resync loop
+regenerates — are eligible; Throttle/ClusterThrottle/Namespace ops and
+every DELETE are verdict-critical (a shed throttle spec or pod delete
+changes admission answers until a relist nobody scheduled), so they are
+never dropped: the queue prefers shedding the oldest sheddable op, drops a
+sheddable *incoming* op when nothing queued is sheddable, and briefly
+exceeds the bound rather than shed a critical op. Every shed is recorded
+per kind; ``take_overflow(kind)`` hands the gap to that kind's reflector,
+which forces a relist — the overflow flag is now a repair trigger, not a
+note. Under overload the pipeline therefore sheds non-flip status
+freshness, never verdict correctness.
+
 Fault site ``ingest.batch.partial`` (faults/plan.py): a firing makes one op
 of the current batch fail mid-apply; the dispatcher splits around it — the
 ops before AND after still land, the failure is counted in ``op_errors``
@@ -77,6 +90,7 @@ class MicroBatchIngest:
         "dropped": "self._lock",
         "overflowed": "self._lock",
         "events_in": "self._lock",
+        "_overflow_kinds": "self._lock",
     }
 
     def __init__(
@@ -109,6 +123,8 @@ class MicroBatchIngest:
         self.op_errors = 0  # per-op failures (incl. injected partials)
         self.dropped = 0  # ops shed by drop-oldest (PER EVENT)
         self.overflowed = False  # the stream has a gap — consumer should relist
+        # kinds with an unrepaired gap; reflectors consume via take_overflow
+        self._overflow_kinds: set = set()
         self.max_batch_seen = 0
         self._batch_hist = None
         self._events_ctr = None
@@ -130,19 +146,64 @@ class MicroBatchIngest:
 
     def submit_many(self, ops: Sequence[IngestOp]) -> None:
         """Queue a producer-side batch under one lock hold. Overflow sheds
-        oldest ops one by one — the counter moves PER EVENT even when a
-        whole producer batch is shed at once."""
+        one op per overflowing op — the counter moves PER EVENT even when a
+        whole producer batch is shed at once — under the verdict-safe
+        policy (see the module docstring's overload posture)."""
         with self._cond:
             if self._stopped:
                 return
             for op in ops:
-                while len(self._queue) >= self.maxsize:
-                    self._queue.popleft()
-                    self.dropped += 1
-                    self.overflowed = True
+                if len(self._queue) >= self.maxsize and not self._shed_for_locked(op):
+                    continue  # the incoming op itself was shed
                 self._queue.append(op)
                 self.events_in += 1
             self._cond.notify()
+
+    @staticmethod
+    def _sheddable(op: IngestOp) -> bool:
+        """Only pod upserts may be shed: a dropped pod refresh costs status
+        lag until the forced relist; a dropped throttle spec, namespace, or
+        ANY delete costs verdict correctness until a relist nobody runs."""
+        verb, kind, _ = op
+        return kind == "Pod" and verb != "delete"
+
+    def _shed_one_locked(self, op: IngestOp) -> None:
+        self.dropped += 1
+        self.overflowed = True
+        self._overflow_kinds.add(op[1])
+
+    def _shed_for_locked(self, incoming: IngestOp) -> bool:
+        """Make room for ``incoming`` on a full queue. True ⇒ append it;
+        False ⇒ ``incoming`` itself was dropped (queued ops were all
+        verdict-critical and the incoming op was not)."""
+        while len(self._queue) >= self.maxsize:
+            idx = next(
+                (i for i, op in enumerate(self._queue) if self._sheddable(op)),
+                None,
+            )
+            if idx is None:
+                if self._sheddable(incoming):
+                    self._shed_one_locked(incoming)
+                    return False
+                # verdict-critical op against a verdict-critical backlog:
+                # exceed the bound rather than shed correctness (critical
+                # ops are bounded by spec-churn rates, not pod storms)
+                return True
+            shed = self._queue[idx]
+            del self._queue[idx]
+            self._shed_one_locked(shed)
+        return True
+
+    def take_overflow(self, kind: str) -> bool:
+        """Consume ``kind``'s pending-gap marker (True exactly once per
+        overflow episode): the kind's reflector forces a relist to repair
+        the shed events' gap. ``overflowed``/``dropped`` stay as the
+        sticky stats."""
+        with self._cond:
+            if kind in self._overflow_kinds:
+                self._overflow_kinds.discard(kind)
+                return True
+            return False
 
     # typed convenience (the watch/reflector layer's vocabulary)
 

@@ -89,7 +89,35 @@ ha.status.commit            SIGKILL the leader after a throttle status
 ha.replication.send         SIGKILL the leader mid-way through sending a
                             journal chunk to a standby (torn replication
                             stream; the standby must discard the partial)
+mock.status.delay           mockserver stalls a status PUT for the rule's
+                            ``delay`` seconds before serving it (publication
+                            slowdown — the scenario engine's injected-
+                            regression knob)
+scenario.apiserver.restart  the scenario engine restarts the mock apiserver
+                            (stop, reset the RV retention window, start on
+                            the same port) — clients see connection
+                            failures, then 410 on re-watch, then the
+                            paginated-relist storm (scenarios/engine.py)
+scenario.leader.kill        the scenario engine runs one kill-the-leader
+                            failover episode through tools/harness.py (the
+                            PR 6 ha.* machinery) and gates its window
+scenario.churn.stall        the scenario engine's trace replayer pauses the
+                            arrival process for the rule's ``delay`` (a
+                            driver stall — tests the idle→burst transition)
+scenario.regression.flip_stall  the deliberately-injected SLO regression:
+                            the engine routes this into a per-status-PUT
+                            stall (``mock.status.delay``) so the flip-p99
+                            gate demonstrably fails (scenarios/slo.py)
 ==========================  ==================================================
+
+Virtual-time rules (the scenario engine's vocabulary): a rule may carry
+``window=(t0, t1)`` — it only considers firing while the plan's installed
+time source reads within [t0, t1) — and/or ``at_times=[...]`` — it fires
+exactly once per listed instant, at the first matching hit observed at or
+after that virtual time. Both extend the per-hit decision model: the plan
+stays deterministic given the same hit sequence and the same clock
+readings (scenarios replay committed traces, so both are pinned). A
+virtual-time rule on a plan with NO time source installed never fires.
 
 The ``crash.*`` family is the SIGKILL crash-point harness
 (tools/crashtest.py): a rule with mode ``"kill"`` makes the process die by
@@ -150,6 +178,11 @@ KNOWN_SITES = frozenset(
         "ha.snapshot.write",
         "ha.status.commit",
         "ha.replication.send",
+        "mock.status.delay",
+        "scenario.apiserver.restart",
+        "scenario.leader.kill",
+        "scenario.churn.stall",
+        "scenario.regression.flip_stall",
     }
 )
 
@@ -193,7 +226,14 @@ class FaultRule:
     ``schedule`` (1-based hit indices, applied after ``after`` is skipped)
     beats ``probability``; ``times`` caps total firings per site; ``after``
     lets the first N hits through untouched (e.g. let the initial sync
-    succeed, then storm)."""
+    succeed, then storm).
+
+    Virtual-time extensions (scenario engine): ``window=(t0, t1)`` gates
+    the rule to hits observed while the plan's time source reads within
+    [t0, t1); ``at_times=[...]`` fires exactly once per listed virtual
+    instant — at the first matching hit at/after it — and beats
+    probability/schedule the way ``schedule`` beats ``probability``.
+    Either requires a time source on the plan (``set_time_source``)."""
 
     site: str  # fnmatch pattern over dotted site names
     mode: str = "error"
@@ -203,6 +243,8 @@ class FaultRule:
     after: int = 0
     schedule: Optional[Sequence[int]] = None
     delay: float = 0.0
+    window: Optional[Tuple[float, float]] = None
+    at_times: Optional[Sequence[float]] = None
     _schedule_set: Optional[frozenset] = field(default=None, repr=False)
 
     def __post_init__(self) -> None:
@@ -232,6 +274,7 @@ class FaultPlan:
         "_hits": "self._lock",
         "_fired": "self._lock",
         "history": "self._lock",
+        "_times_pending": "self._lock",
     }
 
     def __init__(self, seed: int = 0):
@@ -242,6 +285,16 @@ class FaultPlan:
         self._fired: Dict[Tuple[int, str], int] = {}  # (rule idx, site) → count
         # site → [(hit, mode)] — the reproducibility witness
         self.history: Dict[str, List[Tuple[int, str]]] = {}
+        # virtual clock for window/at_times rules (scenarios install the
+        # trace replayer's virtual-time reader); None ⇒ those rules are inert
+        self._time_source: Optional[Callable[[], float]] = None
+        # (rule idx, site) → sorted not-yet-fired at_times instants
+        self._times_pending: Dict[Tuple[int, str], List[float]] = {}
+
+    def set_time_source(self, fn: Optional[Callable[[], float]]) -> None:
+        """Install the virtual clock that ``window``/``at_times`` rules read
+        (monotone float seconds; the scenario engine's trace time)."""
+        self._time_source = fn
 
     def rule(
         self,
@@ -254,6 +307,8 @@ class FaultPlan:
         after: int = 0,
         schedule: Optional[Sequence[int]] = None,
         delay: float = 0.0,
+        window: Optional[Tuple[float, float]] = None,
+        at_times: Optional[Sequence[float]] = None,
     ) -> "FaultPlan":
         """Add a rule; returns self for chaining."""
         self._rules.append(
@@ -266,6 +321,8 @@ class FaultPlan:
                 after=after,
                 schedule=schedule,
                 delay=delay,
+                window=window,
+                at_times=at_times,
             )
         )
         return self
@@ -279,6 +336,11 @@ class FaultPlan:
         with self._lock:
             hit = self._hits.get(site, 0) + 1
             self._hits[site] = hit
+            now_v: Optional[float] = None
+            if self._time_source is not None and any(
+                r.window is not None or r.at_times is not None for r in self._rules
+            ):
+                now_v = self._time_source()  # one read serves every rule
             for idx, rule in enumerate(self._rules):
                 if not fnmatch.fnmatchcase(site, rule.site):
                     continue
@@ -287,7 +349,24 @@ class FaultPlan:
                 key = (idx, site)
                 if rule.times is not None and self._fired.get(key, 0) >= rule.times:
                     continue
-                if rule._schedule_set is not None:
+                if rule.window is not None:
+                    if now_v is None or not (rule.window[0] <= now_v < rule.window[1]):
+                        continue
+                if rule.at_times is not None:
+                    # fires once per scheduled instant, at the first hit
+                    # observed at/after it (beats probability/schedule)
+                    if now_v is None:
+                        continue
+                    pend = self._times_pending.get(key)
+                    if pend is None:
+                        pend = self._times_pending[key] = sorted(
+                            float(t) for t in rule.at_times
+                        )
+                    if not pend or now_v < pend[0]:
+                        continue
+                    pend.pop(0)
+                    fire = True
+                elif rule._schedule_set is not None:
                     fire = (hit - rule.after) in rule._schedule_set
                 elif rule.probability >= 1.0:
                     fire = True
@@ -349,6 +428,7 @@ class FaultPlan:
             self._hits.clear()
             self._fired.clear()
             self.history.clear()
+            self._times_pending.clear()
 
 
 def maybe_crash(plan: Optional[FaultPlan], site: str) -> None:

@@ -102,6 +102,14 @@ METRIC_NAMES = frozenset(
         "kube_throttler_replication_lag_bytes",
         "kube_throttler_replication_lag_events",
         "kube_throttler_stale_epoch_rejections_total",
+        # scenario engine + SLO gates (register_scenario_metrics /
+        # scenarios/engine.py): per-scenario outcome families a scenario
+        # soak or CI gate dashboard alerts on
+        "kube_throttler_scenario_ops_total",
+        "kube_throttler_scenario_faults_total",
+        "kube_throttler_scenario_slo_gate",
+        "kube_throttler_scenario_flip_p99_seconds",
+        "kube_throttler_scenario_recovery_seconds",
     }
 )
 
@@ -656,6 +664,40 @@ def register_ha_metrics(registry: Registry, coordinator) -> None:
         stale_c.set_key((), float(coordinator.stale_epoch_rejections()))
 
     registry.register_pre_expose(flush)
+
+
+def register_scenario_metrics(registry: Registry) -> Dict[str, object]:
+    """Scenario-engine outcome families (scenarios/engine.py): ops
+    replayed and faults fired per scenario, each SLO gate's last verdict
+    (1 = pass), and the headline gate measurements (flip p99, post-restart
+    recovery). Written inline per run — a scenario run IS the scrape."""
+    return {
+        "ops": registry.counter_vec(
+            "kube_throttler_scenario_ops_total",
+            "trace ops replayed against the apiserver per scenario",
+            ["scenario"],
+        ),
+        "faults": registry.counter_vec(
+            "kube_throttler_scenario_faults_total",
+            "fault-plan firings per scenario and site (the schedule's witness)",
+            ["scenario", "site"],
+        ),
+        "gate": registry.gauge_vec(
+            "kube_throttler_scenario_slo_gate",
+            "last SLO gate verdict per scenario (1=pass, 0=fail)",
+            ["scenario", "gate"],
+        ),
+        "flip_p99": registry.gauge_vec(
+            "kube_throttler_scenario_flip_p99_seconds",
+            "crossing-anchored flip-publication p99 of the last run",
+            ["scenario"],
+        ),
+        "recovery": registry.gauge_vec(
+            "kube_throttler_scenario_recovery_seconds",
+            "worst post-restart time to the next landed status publication",
+            ["scenario"],
+        ),
+    }
 
 
 def register_ingest_metrics(registry: Registry, pipeline) -> None:

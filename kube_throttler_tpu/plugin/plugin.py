@@ -354,6 +354,7 @@ class KubeThrottler:
                             kind: (ok, rows) for kind, (_, ok, rows) in batches.items()
                         }
                         schedulable, errors = self._merge_verdicts(per_kind, known_ns)
+                        self._apply_accel_class_overrides(schedulable, errors)
                     return {"schedulable": schedulable, "errors": errors}
 
             # host oracle, side-effect-free (no Warning events — triage
@@ -419,6 +420,35 @@ class KubeThrottler:
             errors.append(key)
         return schedulable, errors
 
+    def _apply_accel_class_overrides(self, schedulable: dict, errors: list) -> None:
+        """Accel-class resolution on the batch-triage surfaces: the device
+        planes carry only BASE thresholds, so a device-classified verdict
+        for a pod whose accel class any mirrored throttle names is wrong
+        whenever the per-class replacement differs. Route exactly those
+        pods through the class-aware host oracle — the same route the
+        single-pod ``check_throttled`` takes (PR 7) — and overwrite their
+        rows in place. No accel thresholds mirrored ⇒ zero cost; otherwise
+        cost is O(accel-class pods), not O(P)."""
+        dm = self.device_manager
+        if dm is None or not (
+            dm.has_accel_thresholds("throttle")
+            or dm.has_accel_thresholds("clusterthrottle")
+        ):
+            return
+        from ..api.pod import accel_class_of
+
+        for pod in self.listers.pods.list():
+            if not accel_class_of(pod) or pod.key not in schedulable:
+                continue
+            try:
+                ta, ti, te, _ = self.throttle_ctr.check_throttled(pod, False)
+                ca, ci, ce, _ = self.cluster_throttle_ctr.check_throttled(pod, False)
+            except Exception:
+                del schedulable[pod.key]
+                errors.append(pod.key)
+                continue
+            schedulable[pod.key] = not (ta or ti or te or ca or ci or ce)
+
     def full_tick_sharded(self, n_devices: Optional[int] = None, shape=None) -> dict:
         """The fused reconcile+PreFilter sweep over a device mesh — the
         multi-chip serving surface. Builds a 2D ("pods","throttles") Mesh
@@ -453,6 +483,11 @@ class KubeThrottler:
             schedulable, errors = self._merge_verdicts(
                 {k: (v[1], v[2]) for k, v in out.items()}, known_ns
             )
+            # accel-class pods resolve per-class thresholds host-side, the
+            # documented accel route (their verdicts then read the written
+            # statuses, like every accel check since PR 7 — the tick's
+            # ahead-of-status freshness applies to base-threshold pods)
+            self._apply_accel_class_overrides(schedulable, errors)
             return {
                 "schedulable": schedulable,
                 "used": used,

@@ -1,0 +1,179 @@
+"""The starter scenario corpus (≥6 entries) plus the tier-1 smoke.
+
+Each entry composes arrival × topology × fault schedule into a shape
+production control planes actually see (ROADMAP item 5's list), with
+per-scenario SLO bounds the slow tier gates on. Scales are chosen so the
+full matrix (6 scenarios × 3 seeds, ``make scenario-test``) runs in
+minutes on one CPU core while still forcing the behaviors the gates
+exist to catch: a full relist mid-churn, one throttle matching half the
+pod population, a deployment-sized create burst, a composed bad day.
+
+``smoke`` is the tier-1 determinism scenario: small enough for two
+back-to-back runs in the fast tier, still crossing thresholds (flip
+samples) and restarting the apiserver (recovery gate).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .dsl import Arrival, FaultSpec, Scenario, SloGates, Topology
+
+__all__ = ["SCENARIOS", "corpus", "get_scenario"]
+
+
+def _scenarios() -> List[Scenario]:
+    return [
+        Scenario(
+            name="smoke",
+            description=(
+                "tier-1 determinism smoke: small diurnal churn with one "
+                "mid-run apiserver restart (RV reset) — two runs of the same "
+                "seed must produce byte-identical traces and identical gate "
+                "verdicts"
+            ),
+            duration_s=2.0,
+            arrival=Arrival(kind="diurnal", rate_hz=500.0, trough_frac=0.35, cycles=1.0),
+            topology=Topology(pods=600, throttles=48, groups=24, nodes=4),
+            faults=(
+                FaultSpec(site="scenario.apiserver.restart", mode="restart", t=0.9),
+            ),
+            # tier-1 bounds are deliberately loose: this scenario proves
+            # determinism + pipeline correctness inside a busy test
+            # process; the strict flip SLO is the corpus' job (slow tier)
+            slo=SloGates(flip_p99_ms=400.0, recovery_s=15.0, min_pace_frac=0.3),
+        ),
+        Scenario(
+            name="diurnal_ramp",
+            description=(
+                "compressed day/night traffic: sinusoidal arrival between "
+                "20% and 100% of peak, two cycles — the baseline 'nothing "
+                "broken, load just moves' scenario every other gate is "
+                "compared against"
+            ),
+            duration_s=6.0,
+            arrival=Arrival(kind="diurnal", rate_hz=600.0, trough_frac=0.2, cycles=2.0),
+            topology=Topology(pods=6000, throttles=300, groups=150, nodes=8),
+            slo=SloGates(flip_p99_ms=150.0),
+        ),
+        Scenario(
+            name="relist_storm",
+            description=(
+                "post-restart relist storm: the apiserver restarts mid-churn "
+                "with a fresh RV horizon (410 on every re-watch ⇒ full "
+                "paginated relists of the whole object population) and then "
+                "expires outstanding continue tokens mid-pagination (410 ⇒ "
+                "unpaginated fallback) — the reflector's full relist must "
+                "not starve the flip express lane"
+            ),
+            duration_s=7.0,
+            arrival=Arrival(kind="constant", rate_hz=500.0),
+            topology=Topology(pods=12000, throttles=360, groups=180, nodes=10),
+            faults=(
+                FaultSpec(site="scenario.apiserver.restart", mode="restart", t=2.5),
+                FaultSpec(
+                    site="scenario.apiserver.restart", mode="expire_continues", t=3.1
+                ),
+            ),
+            slo=SloGates(flip_p99_ms=150.0, recovery_s=15.0, min_pace_frac=0.4),
+        ),
+        Scenario(
+            name="rolling_drain",
+            description=(
+                "rolling node drain: every node's pods deleted in waves and "
+                "recreated on replacement nodes while background churn "
+                "continues — sustained delete/create pressure with correct "
+                "used-sum convergence"
+            ),
+            duration_s=8.0,
+            arrival=Arrival(kind="constant", rate_hz=350.0),
+            topology=Topology(pods=4800, throttles=300, groups=150, nodes=12),
+            pattern="drain",
+            # membership churn (deletes + recreates) keeps the 1-core
+            # harness near its knee and the p99 rides co-tenant noise:
+            # gate the stable center tightly and BOUND the degradation
+            slo=SloGates(flip_p50_ms=250.0, flip_p99_ms=2500.0),
+        ),
+        Scenario(
+            name="thundering_herd",
+            description=(
+                "thundering-herd deployment: an 1800-pod create wave lands "
+                "at 25% of the run over ~2s, is deleted again at 65% — "
+                "admission verdicts and flip publication must survive the "
+                "step change in every group's used sum"
+            ),
+            duration_s=8.0,
+            arrival=Arrival(kind="constant", rate_hz=350.0),
+            topology=Topology(pods=4000, throttles=240, groups=120, nodes=8),
+            pattern="herd",
+            herd_size=1800,
+            # same posture as rolling_drain: the herd window saturates the
+            # harness by design — tight p50, bounded p99 degradation
+            slo=SloGates(flip_p50_ms=250.0, flip_p99_ms=2500.0),
+        ),
+        Scenario(
+            name="hotkey_throttle",
+            description=(
+                "hot-key throttle: HALF the pod population shares one label "
+                "group matched by a single throttle whose cpu threshold sits "
+                "at the group's expected sum — the dominant (N,K) column "
+                "flips under churn and its publication must stay inside the "
+                "SLO while every event in the cluster touches its key"
+            ),
+            duration_s=6.0,
+            arrival=Arrival(kind="constant", rate_hz=550.0),
+            topology=Topology(
+                pods=10000, throttles=300, groups=150, hot_frac=0.5, nodes=8
+            ),
+            slo=SloGates(flip_p99_ms=150.0),
+        ),
+        Scenario(
+            name="bad_day",
+            description=(
+                "the composed bad day: diurnal churn + an apiserver restart "
+                "storm (RV reset) + a status-409 conflict burst while the "
+                "backlog drains + watch cuts, then a process-level "
+                "kill-the-leader failover episode through the PR 6 ha.* "
+                "sites (tools/harness.py + tools/hatest.py)"
+            ),
+            duration_s=7.0,
+            arrival=Arrival(kind="diurnal", rate_hz=700.0, trough_frac=0.3, cycles=1.5),
+            topology=Topology(pods=6000, throttles=300, groups=150, nodes=8),
+            faults=(
+                FaultSpec(site="scenario.apiserver.restart", mode="restart", t=2.0),
+                FaultSpec(
+                    site="mock.status.conflict", mode="conflict",
+                    window=(2.6, 4.2), probability=0.25,
+                ),
+                FaultSpec(
+                    site="mock.watch.cut", mode="close",
+                    window=(4.5, 5.0), probability=0.02, times=2,
+                ),
+            ),
+            # 250ms: flips here pay the INJECTED 409-retry storms by
+            # design (refresh+retry per conflict); the clean-storm 150ms
+            # SLO is relist_storm's and hotkey_throttle's gate
+            slo=SloGates(
+                flip_p99_ms=250.0, recovery_s=15.0, min_pace_frac=0.4,
+                failover_window_s=10.0,
+            ),
+            leader_kill=True,
+        ),
+    ]
+
+
+def corpus(include_smoke: bool = False) -> List[Scenario]:
+    out = _scenarios()
+    return out if include_smoke else [s for s in out if s.name != "smoke"]
+
+
+SCENARIOS: Dict[str, Scenario] = {s.name: s for s in _scenarios()}
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; known: {', '.join(sorted(SCENARIOS))}"
+        )

@@ -49,7 +49,17 @@ _spec = importlib.util.spec_from_file_location(
 )
 hatest = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(hatest)
-crashtest = hatest.crashtest
+# the workload/oracle helpers moved to the shared tools/harness.py (PR 8);
+# keep the historical local names the fixtures below use
+from types import SimpleNamespace  # noqa: E402
+
+crashtest = SimpleNamespace(
+    _throttle=hatest.harness.make_throttle,
+    _recompute_status=hatest.harness.recompute_status,
+    _dump_store=hatest.harness.dump_store,
+    _verdicts=hatest.harness.verdicts,
+    _build_plugin=hatest.harness.build_plugin,
+)
 
 
 def _wait(predicate, timeout=5.0, interval=0.02):

@@ -1,0 +1,3 @@
+# tools/ is importable (``from tools import harness``) so the crash, HA,
+# and scenario harnesses can share one child-process toolkit instead of
+# each growing its own copy.

@@ -38,7 +38,6 @@ import os
 import random
 import shutil
 import signal
-import subprocess
 import sys
 import tempfile
 from dataclasses import replace
@@ -47,6 +46,18 @@ from datetime import timedelta
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+from tools import harness  # noqa: E402 — the shared child-process toolkit
+
+# shared fixtures/oracles (tools/harness.py) under their historical names —
+# hatest and the scenario engine import them from harness directly
+N_THROTTLES = harness.N_THROTTLES
+_throttle = harness.make_throttle
+_recompute_status = harness.recompute_status
+_dump_store = harness.dump_store
+_normalized_reasons = harness.normalized_reasons
+_verdicts = harness.verdicts
+_build_plugin = harness.build_plugin
 
 # every registered crash.* site (faults/plan.py KNOWN_SITES)
 CRASH_SITES = (
@@ -67,7 +78,6 @@ DEFAULT_EVENTS = 150
 SNAPSHOT_EVERY = 25
 COMPACT_AFTER = 70
 SNAPSHOT_KEEP = 2
-N_THROTTLES = 4
 
 
 def default_hit(site: str, seed: int) -> int:
@@ -90,81 +100,6 @@ def default_hit(site: str, seed: int) -> int:
 # --------------------------------------------------------------------------
 # child: the workload driver (dies by SIGKILL mid-flight)
 # --------------------------------------------------------------------------
-
-
-def _throttle(i: int):
-    from kube_throttler_tpu.api.types import (
-        LabelSelector,
-        ResourceAmount,
-        Throttle,
-        ThrottleSelector,
-        ThrottleSelectorTerm,
-        ThrottleSpec,
-    )
-
-    return Throttle(
-        name=f"t{i}",
-        namespace="default",
-        spec=ThrottleSpec(
-            throttler_name="kube-throttler",
-            threshold=ResourceAmount.of(
-                pod=3 + i, requests={"cpu": str(1 + i)}
-            ),
-            selector=ThrottleSelector(
-                selector_terms=(
-                    ThrottleSelectorTerm(
-                        LabelSelector(match_labels={"grp": f"g{i}"})
-                    ),
-                )
-            ),
-        ),
-    )
-
-
-def _recompute_status(store, thr):
-    """A deterministic reconcile stand-in: count/sum the Running pods the
-    throttle's matchLabels selector matches and derive throttled flags —
-    enough to populate status.used/throttled/calculatedThreshold through
-    the real status-subresource write path (which the journal records)."""
-    from kube_throttler_tpu.api.types import (
-        CalculatedThreshold,
-        IsResourceAmountThrottled,
-        ResourceAmount,
-        ThrottleStatus,
-    )
-    from kube_throttler_tpu.resourcelist import pod_request_resource_list
-
-    grp = thr.spec.selector.selector_terms[0].pod_selector.match_labels.get("grp")
-    running = [
-        p
-        for p in store.list_pods("default")
-        if p.labels.get("grp") == grp and p.status.phase == "Running"
-    ]
-    cpu = sum(
-        (pod_request_resource_list(p).get("cpu", 0) for p in running), 0
-    )
-    # exact-Fraction quantities go straight into the dataclass (of() parses
-    # strings; these are already canonical)
-    used = ResourceAmount(
-        resource_counts=len(running), resource_requests={"cpu": cpu}
-    )
-    threshold = thr.spec.threshold
-    flags = IsResourceAmountThrottled(
-        resource_counts_pod=(
-            threshold.resource_counts is not None
-            and len(running) >= threshold.resource_counts
-        ),
-        resource_requests={
-            "cpu": cpu >= (threshold.resource_requests or {}).get("cpu", 0)
-        },
-    )
-    return thr.with_status(
-        ThrottleStatus(
-            calculated_threshold=CalculatedThreshold(threshold=threshold),
-            throttled=flags,
-            used=used,
-        )
-    )
 
 
 def run_child(args) -> int:
@@ -336,9 +271,7 @@ def spawn_child(
     events: int,
     timeout: float = 180.0,
 ):
-    cmd = [
-        sys.executable,
-        os.path.abspath(__file__),
+    argv = [
         "child",
         "--dir", data_dir,
         "--seed", str(seed),
@@ -348,55 +281,8 @@ def spawn_child(
         "--keep", str(SNAPSHOT_KEEP),
     ]
     if site:
-        cmd += ["--site", site, "--hit", str(hit)]
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.run(
-        cmd, capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO_ROOT
-    )
-
-
-def _dump_store(store) -> dict:
-    from kube_throttler_tpu.api.serialization import object_to_dict
-
-    return {
-        "Namespace": {n.name: object_to_dict(n) for n in store.list_namespaces()},
-        "Throttle": {t.key: object_to_dict(t) for t in store.list_throttles()},
-        "ClusterThrottle": {
-            t.name: object_to_dict(t) for t in store.list_cluster_throttles()
-        },
-        "Pod": {p.key: object_to_dict(p) for p in store.list_pods()},
-    }
-
-
-def _normalized_reasons(reasons) -> list:
-    out = []
-    for r in reasons:
-        head, _, names = r.partition("=")
-        out.append(f"{head}={','.join(sorted(names.split(',')))}")
-    return sorted(out)
-
-
-def _verdicts(plugin, store) -> dict:
-    out = {}
-    for pod in sorted(store.list_pods(), key=lambda p: p.key):
-        status = plugin.pre_filter(pod)
-        out[pod.key] = (status.code.value, _normalized_reasons(status.reasons))
-    return out
-
-
-def _build_plugin(store):
-    from kube_throttler_tpu.plugin import KubeThrottler, decode_plugin_args
-
-    return KubeThrottler(
-        decode_plugin_args(
-            {"name": "kube-throttler", "targetSchedulerName": "my-scheduler"}
-        ),
-        store,
-        use_device=True,
-        start_workers=False,
-    )
+        argv += ["--site", site, "--hit", str(hit)]
+    return harness.run_child(__file__, argv, timeout=timeout)
 
 
 def run_crash_cycle(

@@ -50,13 +50,11 @@ cycle in tier-1 and the matrix behind ``-m slow``.
 from __future__ import annotations
 
 import argparse
-import importlib.util
 import json
 import os
 import random
 import shutil
 import signal
-import subprocess
 import sys
 import tempfile
 import time
@@ -66,11 +64,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-_ct_spec = importlib.util.spec_from_file_location(
-    "kt_crashtest", os.path.join(REPO_ROOT, "tools", "crashtest.py")
-)
-crashtest = importlib.util.module_from_spec(_ct_spec)
-_ct_spec.loader.exec_module(crashtest)
+from tools import harness  # noqa: E402 — the shared child-process toolkit
 
 HA_SITES = (
     "ha.journal.batch",
@@ -145,9 +139,9 @@ def run_leader(args) -> int:
     if store.get_namespace("default") is None:
         store.create_namespace(Namespace("default"))
     throttles = []
-    for i in range(crashtest.N_THROTTLES):
+    for i in range(harness.N_THROTTLES):
         try:
-            store.create_throttle(crashtest._throttle(i))
+            store.create_throttle(harness.make_throttle(i))
         except ValueError:
             pass
         throttles.append(f"t{i}")
@@ -167,7 +161,7 @@ def run_leader(args) -> int:
         time.sleep(0.01)
 
     def _mk_pod():
-        i = rng.randrange(crashtest.N_THROTTLES)
+        i = rng.randrange(harness.N_THROTTLES)
         pod = make_pod(
             f"p{rng.randrange(10**9)}",
             labels={"grp": f"g{i}"},
@@ -224,7 +218,7 @@ def run_leader(args) -> int:
         else:  # reconcile stand-in: status write (possibly a FLIP)
             name = rng.choice(throttles)
             thr = store.get_throttle("default", name)
-            store.update_throttle_status(crashtest._recompute_status(store, thr))
+            store.update_throttle_status(harness.recompute_status(store, thr))
         time.sleep(EVENT_PACE_S)
 
     # the seeded site never fired: report and idle — the parent SIGKILLs
@@ -279,11 +273,11 @@ def run_standby(args) -> int:
     # re-derived here (the daemon path drives the same sweep through the
     # controllers' two-lane pipeline via HaCoordinator.promote_reconcile)
     for thr in store.list_throttles():
-        store.update_throttle_status(crashtest._recompute_status(store, thr))
+        store.update_throttle_status(harness.recompute_status(store, thr))
 
-    plugin = crashtest._build_plugin(store)
+    plugin = harness.build_plugin(store)
     try:
-        verdicts = crashtest._verdicts(plugin, store)
+        verdicts = harness.verdicts(plugin, store)
     finally:
         plugin.stop()
     t_serving = time.time()
@@ -293,7 +287,7 @@ def run_standby(args) -> int:
         "t_serving": t_serving,
         "epoch": new_epoch,
         "failover_s": ha.failover_duration_s,
-        "dump": crashtest._dump_store(store),
+        "dump": harness.dump_store(store),
         "verdicts": verdicts,
         "replication": {
             "events_applied": replicator.events_applied,
@@ -319,58 +313,11 @@ def run_standby(args) -> int:
 
 
 def _spawn(role: str, extra):
-    cmd = [sys.executable, os.path.abspath(__file__), role] + extra
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.Popen(
-        cmd,
-        cwd=REPO_ROOT,
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-    )
+    return harness.spawn_child(__file__, [role] + extra)
 
 
-def _wait_line(proc, prefix: str, timeout_s: float) -> str:
-    """Read stdout lines until one starts with ``prefix``; the transcript
-    so far rides any assertion."""
-    import queue
-    import threading
-
-    lines: "queue.Queue[str]" = queue.Queue()
-
-    def drain():
-        for line in proc.stdout:
-            lines.put(line)
-
-    t = getattr(proc, "_kt_drain", None)
-    if t is None:
-        proc._kt_lines = lines
-        proc._kt_seen = []
-        t = threading.Thread(target=drain, daemon=True)
-        proc._kt_drain = t
-        t.start()
-    lines = proc._kt_lines
-    deadline = time.time() + timeout_s
-    for line in proc._kt_seen:
-        if line.startswith(prefix):
-            return line
-    while time.time() < deadline:
-        try:
-            line = lines.get(timeout=0.2)
-        except queue.Empty:
-            if proc.poll() is not None and lines.empty():
-                break
-            continue
-        proc._kt_seen.append(line)
-        if line.startswith(prefix):
-            return line
-    raise AssertionError(
-        f"never saw {prefix!r} from {proc.args[2] if len(proc.args) > 2 else proc.args}"
-        f" (rc={proc.poll()}):\n{''.join(proc._kt_seen)}"
-    )
+# the shared line-waiter (tools/harness.py) under its historical name
+_wait_line = harness.wait_line
 
 
 def run_ha_cycle(
@@ -439,10 +386,7 @@ def run_ha_cycle(
         with open(report_path) as f:
             report = json.load(f)
     finally:
-        for p in (leader, standby):
-            if p is not None and p.poll() is None:
-                p.kill()
-                p.wait(timeout=10)
+        harness.kill_children((leader, standby))
 
     # oracle 1: bounded failover window (kill → admission answered). The
     # parent's death-detection can lag the actual SIGKILL by a poll tick;
@@ -465,7 +409,7 @@ def run_ha_cycle(
         pure, os.path.join(pure_dir, "store.journal"), compact_after=10**9
     )
     pure_journal.close()
-    dump_pure = json.loads(json.dumps(crashtest._dump_store(pure)))
+    dump_pure = json.loads(json.dumps(harness.dump_store(pure)))
     assert dump_pure == report["dump"], (
         f"{site} seed={seed} hit={hit}: promoted standby state diverges "
         "from a pure from-genesis replay of its own journal"
@@ -476,7 +420,7 @@ def run_ha_cycle(
     from kube_throttler_tpu.api.serialization import object_to_dict
 
     for thr in pure.list_throttles():
-        expected = crashtest._recompute_status(pure, thr)
+        expected = harness.recompute_status(pure, thr)
         got = report["dump"]["Throttle"][thr.key]["status"]["throttled"]
         want = json.loads(
             json.dumps(object_to_dict(expected)["status"]["throttled"])
@@ -487,9 +431,9 @@ def run_ha_cycle(
         )
 
     # oracle 4: admission equivalence against the pure replay
-    plugin_pure = crashtest._build_plugin(pure)
+    plugin_pure = harness.build_plugin(pure)
     try:
-        v_pure = json.loads(json.dumps(crashtest._verdicts(plugin_pure, pure)))
+        v_pure = json.loads(json.dumps(harness.verdicts(plugin_pure, pure)))
     finally:
         plugin_pure.stop()
     v_standby = json.loads(json.dumps(report["verdicts"]))
@@ -553,7 +497,7 @@ def run_splitbrain(seed: int = 0) -> dict:
 
     server = MockApiServer()
     server.store.create_namespace(Namespace("default"))
-    thr = crashtest._throttle(seed % crashtest.N_THROTTLES)
+    thr = harness.make_throttle(seed % harness.N_THROTTLES)
     server.store.create_throttle(thr)
     server.start()
     try:
@@ -575,7 +519,7 @@ def run_splitbrain(seed: int = 0) -> dict:
                 body,
             )
 
-        status_put(client_a, crashtest._recompute_status(server.store, thr))
+        status_put(client_a, harness.recompute_status(server.store, thr))
         assert server.fencing_epoch == 1 and server.stale_epoch_rejected == 0
 
         # failover: the standby bumps past term 1 and writes
@@ -585,14 +529,14 @@ def run_splitbrain(seed: int = 0) -> dict:
             RestConfig(server=url), qps=None, epoch_provider=epoch_b.current
         )
         thr_live = server.store.get_throttle("default", thr.name)
-        status_put(client_b, crashtest._recompute_status(server.store, thr_live))
+        status_put(client_b, harness.recompute_status(server.store, thr_live))
         assert server.fencing_epoch == 2
 
         # the zombie resumes: direct PUT bounces with FencedError...
         state_before = object_to_dict(server.store.get_throttle("default", thr.name))
         rejected = False
         try:
-            status_put(client_a, crashtest._recompute_status(server.store, thr_live))
+            status_put(client_a, harness.recompute_status(server.store, thr_live))
         except FencedError:
             rejected = True
         assert rejected, "stale-epoch status PUT was accepted (split brain!)"
@@ -629,7 +573,7 @@ def run_splitbrain(seed: int = 0) -> dict:
         )
         committer.start()
         committer.update_throttle_status(
-            crashtest._recompute_status(server.store, thr_live)
+            harness.recompute_status(server.store, thr_live)
         )
         assert fenced.wait(5.0), "committer never fired on_fenced"
         committer.stop()
