@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress crash-test ha-test scenario-test scenario-regression lint gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress crash-test ha-test scenario-test shard-scenario scenario-regression lint gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -19,8 +19,12 @@ crash-test:      ## SIGKILL crash-point matrix: every crash.* site x 3 seeds
 ha-test:         ## kill-the-leader failover matrix: every ha.* site x 3 seeds + split-brain fencing
 	$(PY) tools/hatest.py matrix
 
-scenario-test:   ## trace-driven scenario corpus x 3 seeds, every SLO gate enforced
+scenario-test:   ## trace-driven scenario corpus x 3 seeds, every SLO gate enforced (+ the sharded bad-day variant)
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios matrix
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.sharded --shards 4 --seed 0
+
+shard-scenario:  ## sharded composed bad-day alone: 4 workers, kill-a-shard episode, knee-lift + zero-wrong-verdict gates
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.sharded --shards 4 --seed 0
 
 scenario-regression: ## prove the gates gate: clean vs injected-regression diff report
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios regression --name smoke

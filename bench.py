@@ -1426,6 +1426,359 @@ def run_ingest_sweep() -> None:
     emit(out)
 
 
+def build_sharded_stack(P, T, n_shards, groups=500, label="shards"):
+    """The PR 9 multiprocess stack at scale: scatter-gather admission
+    front in THIS process, ``n_shards`` worker processes (each a full
+    vertical: store+index+device planes+controllers) spawned by the
+    supervisor. Topology (incl. the flip band) is identical to
+    build_served_stack so the rungs compare apples to apples; objects
+    are seeded THROUGH the front in batches (the honest routing cost)."""
+    import os as _os
+
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.sharding.front import AdmissionFront
+    from kube_throttler_tpu.sharding.supervisor import ShardSupervisor
+
+    import random
+
+    rng = random.Random(0)
+    front = AdmissionFront(n_shards)
+    supervisor = ShardSupervisor(
+        front,
+        use_device=True,
+        env={**_os.environ, "KT_SHARD_QUIET": "1", "KT_LOCK_ASSERT": "0"},
+    )
+    t0 = time.perf_counter()
+    supervisor.start(ready_timeout=600.0)
+    log(f"[{label}] {n_shards} workers ready in {time.perf_counter()-t0:.1f}s")
+
+    front.store.create_namespace(Namespace("default"))
+    flip_mc = _flip_band_mc(P, groups)
+    t0 = time.perf_counter()
+    ops = [
+        ("create", "Throttle", _served_throttle(i, groups, flip_band_mc=flip_mc))
+        for i in range(T)
+    ]
+    for s in range(0, len(ops), 512):
+        front.store.apply_events(ops[s : s + 512])
+    t_thr = time.perf_counter() - t0
+    log(f"[{label}] routed {T} throttles in {t_thr:.1f}s")
+
+    from dataclasses import replace as _replace
+
+    t0 = time.perf_counter()
+    pod_ops = []
+    for i in range(P):
+        pod = make_pod(
+            f"p{i}",
+            labels={"grp": f"g{rng.randrange(groups)}"},
+            requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+        )
+        pod = _replace(pod, spec=_replace(pod.spec, node_name="node-1"))
+        pod.status.phase = "Running"
+        pod_ops.append(("create", "Pod", pod))
+    for s in range(0, len(pod_ops), 1024):
+        front.store.apply_events(pod_ops[s : s + 1024])
+    t_pods = time.perf_counter() - t0
+    log(f"[{label}] routed {P} pods in {t_pods:.1f}s "
+        f"({t_pods/P*1e6:.0f}us/event through the routing index)")
+    t0 = time.perf_counter()
+    front.drain(timeout=900.0)
+    log(f"[{label}] shards drained initial reconcile in "
+        f"{time.perf_counter()-t0:.1f}s")
+    stats = front.stats()
+    spread = {
+        sid: s.get("objects", {}) for sid, s in stats["shards"].items()
+    }
+    log(f"[{label}] keyspace spread: {spread}")
+    return front, supervisor
+
+
+def _sharded_drain(front, pipeline, timeout=600.0):
+    if pipeline is not None:
+        pipeline.flush(timeout=timeout)
+    front.drain(timeout=timeout)
+    time.sleep(0.5)  # status pushes ride their own flush cadence
+
+
+def bench_shard_burst(front, label, n=30_000, repeats=2):
+    """Aggregate burst-drain capacity through the sharded stack: N
+    pre-built churn events (producer cost off the clock) through the
+    front's micro-batch pipeline → routing → per-shard ingest → full
+    reconcile drain on every shard. Applied-not-submitted accounting:
+    the count is the front pipeline's events_applied (each a DISTINCT
+    event, applied at its owning shards), the clock stops when every
+    shard reports empty queues+workqueues."""
+    import random
+    from dataclasses import replace as _replace
+
+    from kube_throttler_tpu.api.pod import make_pod
+    from kube_throttler_tpu.engine.ingest import MicroBatchIngest
+    from kube_throttler_tpu.resourcelist import pod_request_resource_list
+
+    rng = random.Random(4)
+    pods = front.store.list_pods()
+    cur_cpu: dict = {}
+
+    def _mk_ops():
+        ops = []
+        for _ in range(n):
+            pod = pods[rng.randrange(len(pods))]
+            prev = cur_cpu.get(pod.name)
+            if prev is None:
+                stored = pod_request_resource_list(pod).get("cpu")
+                prev = int(stored * 1000) if stored else 0
+            new_cpu = rng.randrange(1, 8) * 100
+            if new_cpu == prev:
+                new_cpu = new_cpu % 700 + 100
+            cur_cpu[pod.name] = new_cpu
+            updated = make_pod(
+                pod.name, labels=pod.labels, requests={"cpu": f"{new_cpu}m"}
+            )
+            updated = _replace(updated, spec=_replace(updated.spec, node_name="node-1"))
+            updated.status.phase = "Running"
+            ops.append(("update", "Pod", updated))
+        return ops
+
+    runs = []
+    for rep in range(max(1, int(repeats))):
+        ops = _mk_ops()
+        pipeline = MicroBatchIngest(front.store, batch_policy="adaptive", maxsize=n)
+        t0 = time.perf_counter()
+        pipeline.submit_many(ops)
+        pipeline.flush(timeout=900.0)
+        t_apply = time.perf_counter() - t0
+        front.drain(timeout=900.0)
+        t_total = time.perf_counter() - t0
+        st = pipeline.stats()
+        pipeline.stop()
+        run = {
+            "events": n,
+            "apply_events_per_sec": round(n / t_apply),
+            "events_per_sec_sustained": round(st["events_applied"] / t_total),
+            "events_applied": st["events_applied"],
+            "dropped": st["dropped"],
+        }
+        runs.append(run)
+        log(
+            f"[{label}] shard BURST (run {rep + 1}/{repeats}): {n} events, "
+            f"front apply {run['apply_events_per_sec']:,}/s, fully "
+            f"reconciled across shards in {t_total:.2f}s -> "
+            f"{run['events_per_sec_sustained']:,} ev/s aggregate sustained"
+        )
+    result = dict(max(runs, key=lambda r: r["events_per_sec_sustained"]))
+    result["runs"] = runs
+    return result
+
+
+def bench_shard_streaming(front, label, duration=8.0, pace_hz=0.0):
+    """Paced churn through the sharded stack with crossing-anchored flip
+    measurement ON THE FRONT STORE — the flip clock includes routing,
+    IPC, the owning shard's two-lane reconcile, and the status push back
+    to the front: the end-to-end publication latency an operator sees."""
+    import random
+
+    from kube_throttler_tpu.engine.ingest import MicroBatchIngest
+
+    rng = random.Random(1)
+    pending, flip_pending, pend_lock, lags, flip_lags, _fw, on_throttle_write = (
+        _lag_tracker()
+    )
+    group_keys = _group_keys_of(front.store)
+    flip_watch, run_sums = _flip_watch_of(front.store)
+    front.store.add_event_handler("Throttle", on_throttle_write, replay=False)
+    pipeline = MicroBatchIngest(front.store, max_batch=64, batch_policy="adaptive")
+    try:
+        n_events, t_fired, n_crossings = _drive_pod_churn(
+            front.store, group_keys, pending, pend_lock, rng, duration, pace_hz,
+            flip_state=(flip_watch, run_sums, flip_pending),
+            apply=lambda pod: pipeline.submit("update", "Pod", pod),
+        )
+        t_start = time.perf_counter() - t_fired
+        _sharded_drain(front, pipeline)
+        t_total = time.perf_counter() - t_start
+    finally:
+        front.store.remove_event_handler("Throttle", on_throttle_write)
+        ps = pipeline.stats()
+        pipeline.stop()
+    n_applied = ps["events_applied"]
+    lag_arr = np.asarray(lags) if lags else np.asarray([0.0])
+    flip_arr = np.asarray(flip_lags) if flip_lags else np.asarray([0.0])
+    result = {
+        "events_per_sec_sustained": round(n_applied / t_total),
+        "events_per_sec_fired": round(n_events / t_fired),
+        "events_applied": n_applied,
+        "lag_p99_ms": round(float(np.percentile(lag_arr, 99)) * 1e3, 1),
+        "flip_lag_p50_ms": round(float(np.percentile(flip_arr, 50)) * 1e3, 1),
+        "flip_lag_p99_ms": round(float(np.percentile(flip_arr, 99)) * 1e3, 1),
+        "flip_samples": len(flip_lags),
+        "flip_crossings": n_crossings,
+        "pace_hz": pace_hz,
+    }
+    mode = f"paced {pace_hz:,.0f}/s" if pace_hz else "max rate"
+    log(
+        f"[{label}] sharded churn ({mode}): "
+        f"{result['events_per_sec_sustained']:,} ev/s sustained "
+        f"({result['events_per_sec_fired']:,}/s fired); FLIP p50 "
+        f"{result['flip_lag_p50_ms']}ms / p99 {result['flip_lag_p99_ms']}ms "
+        f"over {result['flip_samples']} flips"
+    )
+    return result
+
+
+def bench_shard_decisions(front, label, threads=4, duration=2.0, groups=500):
+    """Served decisions/s through the scatter-gather front: concurrent
+    callers fan out to the owning shards (one RPC per matching shard)
+    and AND-merge. With selector-affinity sharding a probe touches ONE
+    shard, so N front threads drive N workers concurrently — the
+    multi-core decision path the GIL denies the single process."""
+    import threading as _threading
+
+    from kube_throttler_tpu.api.pod import make_pod
+
+    probes = [
+        make_pod(
+            f"probe{i}",
+            labels={"grp": f"g{i % groups}"},
+            requests={"cpu": f"{(i % 7 + 1) * 100}m"},
+        )
+        for i in range(64)
+    ]
+    front.pre_filter(probes[0])  # warm the RPC path
+
+    def measure(k):
+        stop = _threading.Event()
+        counts = [0] * k
+
+        def worker(idx):
+            j = idx
+            while not stop.is_set():
+                front.pre_filter(probes[j % len(probes)])
+                counts[idx] += 1
+                j += k
+
+        ts = [_threading.Thread(target=worker, args=(w,)) for w in range(k)]
+        for t in ts:
+            t.start()
+        time.sleep(duration)
+        stop.set()
+        for t in ts:
+            t.join(timeout=10)
+        return sum(counts) / duration
+
+    rate1 = measure(1)
+    rate_k = measure(threads)
+    log(
+        f"[{label}] scatter-gather decisions: {rate1:,.0f}/s x1 thread, "
+        f"{rate_k:,.0f}/s x{threads} threads "
+        f"(scaling {rate_k/max(rate1,1e-9):.2f}x)"
+    )
+    return {
+        "decisions_per_sec_1thread": round(rate1),
+        f"decisions_per_sec_{threads}threads": round(rate_k),
+        "thread_scaling": round(rate_k / max(rate1, 1e-9), 2),
+    }
+
+
+def run_shard_sweep() -> None:
+    """``python bench.py --shard-sweep``: the PR 9 acceptance artifact —
+    aggregate ingest, served decisions, and flip p99 per worker count
+    {1,2,4} at the PR 5 topology (100k pods × 10k throttles), written to
+    BENCH_PR9_<platform>_<stamp>.json. The 3× acceptance target assumes
+    ≥4 cores (one per worker + the front); ``host_cores`` is recorded so
+    an under-provisioned run (this container has 1) reads as what it is:
+    the protocol at full scale, not a parallel-speedup measurement."""
+    import os as _os
+
+    platform = "cpu"
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        pass
+    host_cores = len(_os.sched_getaffinity(0))
+    P, T = (100_000, 10_000)
+    if "--quick" in sys.argv:
+        P, T = (10_000, 1_000)
+    shard_counts = [1, 2, 4]
+    pr5_baseline = 3593.0
+    out = {
+        "metric": (
+            "aggregate full-scale sustained ingest / served decisions / "
+            "flip p99 across shared-nothing worker processes "
+            "(scatter-gather front, applied-not-submitted accounting)"
+        ),
+        "platform": platform,
+        "host_cores": host_cores,
+        "scale": [P, T],
+        "pr5_single_core_events_per_sec": pr5_baseline,
+        "shard_counts": {},
+    }
+    for n_shards in shard_counts:
+        label = f"shards{n_shards}"
+        front = supervisor = None
+        try:
+            front, supervisor = build_sharded_stack(P, T, n_shards, label=label)
+            rung = {"workers": n_shards}
+            rung["burst"] = bench_shard_burst(front, label)
+            cap = rung["burst"]["events_per_sec_sustained"]
+            # SLO ladder relative to measured capacity: fastest pace whose
+            # flip p99 meets the 150ms SLO wins (every attempt recorded)
+            attempts = []
+            best = None
+            for frac in (0.85, 0.7, 0.55):
+                pace = max(500.0, cap * frac)
+                att = bench_shard_streaming(
+                    front, f"{label}@{pace:.0f}", duration=10.0, pace_hz=pace
+                )
+                attempts.append(att)
+                if att["flip_lag_p99_ms"] <= 150.0 and (
+                    best is None
+                    or att["events_per_sec_sustained"]
+                    > best["events_per_sec_sustained"]
+                ):
+                    best = att
+            if best is None:
+                best = min(attempts, key=lambda a: a["flip_lag_p99_ms"])
+            rung["slo_window"] = best
+            rung["slo_attempts"] = attempts
+            rung["decisions"] = bench_shard_decisions(front, label)
+            stats = front.stats()
+            rung["per_shard_applied"] = {
+                sid: s.get("ingest", {}).get("events_applied")
+                for sid, s in stats["shards"].items()
+            }
+            rung["route_misses"] = stats["route_misses"]
+            out["shard_counts"][str(n_shards)] = rung
+        except Exception as e:  # noqa: BLE001 — record and continue the sweep
+            log(f"[{label}] FAILED: {e.__class__.__name__}: {e}")
+            log(traceback.format_exc(limit=6))
+            out["shard_counts"][str(n_shards)] = {
+                "workers": n_shards,
+                "error": f"{e.__class__.__name__}: {str(e)[:300]}",
+            }
+        finally:
+            if supervisor is not None:
+                supervisor.stop()
+            if front is not None:
+                front.stop()
+    best4 = (
+        out["shard_counts"].get("4", {}).get("burst", {}).get(
+            "events_per_sec_sustained"
+        )
+    )
+    if best4:
+        out["aggregate_x_pr5"] = round(best4 / pr5_baseline, 2)
+        out["meets_3x"] = bool(best4 >= 3 * pr5_baseline)
+    out["undersubscribed"] = host_cores < max(shard_counts) + 1
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = f"BENCH_PR9_{platform.upper()}_{stamp}.json"
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    log(f"shard sweep written to {path}")
+    emit(out)
+
+
 def run_gang_bench() -> None:
     """``python bench.py --gang``: the gang-admission rung — bursty
     all-or-nothing group arrivals (mixed sizes 2/4/8/16) against ONE hot
@@ -1879,6 +2232,11 @@ def main():
     if "--ingest-sweep" in sys.argv:
         # PR 5 acceptance artifact: the full-scale batch-size sweep alone
         run_ingest_sweep()
+        return
+    if "--shard-sweep" in sys.argv:
+        # PR 9 acceptance artifact: aggregate ingest/decisions/flip p99
+        # across {1,2,4} shared-nothing worker processes
+        run_shard_sweep()
         return
     if "--gang" in sys.argv:
         # gang-admission rung: bursty group arrivals + churn SLO check

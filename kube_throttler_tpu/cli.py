@@ -75,6 +75,64 @@ def _args_from_config(cfg: Dict[str, Any], path: str) -> Dict[str, Any]:
     return {}
 
 
+def _serve_sharded(args, plugin_args, leader_elect: bool, stop) -> int:
+    """``serve --shards N``: the scatter-gather admission front in THIS
+    process, N shard worker processes under a supervisor. The front's
+    store is the merged read view the HTTP surface serves; every local
+    mutation routes to the owning shards; shard status writes stream
+    back (sharding/front.py)."""
+    from .metrics import Registry
+    from .sharding.front import AdmissionFront
+    from .sharding.supervisor import ShardSupervisor
+
+    elector = None
+    if leader_elect:
+        from .utils.leaderelect import FileLeaseElector, default_lease_path
+
+        lock_path = args.lock_file or default_lease_path(plugin_args.name)
+        elector = FileLeaseElector(lock_path)
+        print(f"leader election on {lock_path}: waiting for lease...", flush=True)
+        if not elector.acquire(stop):
+            return 0
+
+    metrics_registry = Registry()
+    front = AdmissionFront(
+        args.shards,
+        metrics_registry=metrics_registry,
+        name=plugin_args.name,
+    )
+    supervisor = ShardSupervisor(
+        front,
+        name=plugin_args.name,
+        target_scheduler=plugin_args.target_scheduler_name,
+        use_device=not args.no_device,
+        data_dir=args.data_dir or None,
+        ingest_batch=getattr(args, "ingest_batch", "adaptive"),
+    )
+    print(f"spawning {args.shards} shard workers...", flush=True)
+    supervisor.start()
+    if front.store.get_namespace("default") is None:
+        front.store.create_namespace(Namespace("default"))
+    server = ThrottlerHTTPServer(front, host=args.host, port=args.port)
+    server.start()
+    print(
+        f"kube-throttler-tpu serving on {args.host}:{server.port} "
+        f"(throttler={plugin_args.name}, "
+        f"scheduler={plugin_args.target_scheduler_name}, "
+        f"shards={args.shards}, device={'off' if args.no_device else 'on'})",
+        flush=True,
+    )
+    stop.wait()
+    server.mark_draining()
+    front.drain(timeout=10.0)
+    server.stop()
+    supervisor.stop()
+    front.stop()
+    if elector is not None:
+        elector.release()
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     # an operator's explicit JAX_PLATFORMS (e.g. =cpu when the TPU is down)
     # must win over ambient platform pinning; must run before any backend
@@ -168,6 +226,17 @@ def main(argv: Optional[list] = None) -> int:
         "(default — batch grows under backlog, collapses to single-event "
         "application when idle), a fixed integer batch size, or 'off' for "
         "per-event application",
+    )
+    serve.add_argument(
+        "--shards",
+        type=int,
+        default=0,
+        help="shared-nothing multiprocess sharding: run N worker processes "
+        "each owning a consistent-hash slice of the Throttle/ClusterThrottle "
+        "keyspace (full vertical per shard: store+index+journal+device "
+        "planes+controllers), behind a scatter-gather admission front on "
+        "this process (docs/PERFORMANCE.md 'Multiprocess keyspace "
+        "sharding'). 0 = single-process engine. Standalone mode only",
     )
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
     serve.add_argument(
@@ -341,9 +410,32 @@ def main(argv: Optional[list] = None) -> int:
         parser.error("--lease-backend http requires --kubeconfig (the "
                      "Lease object lives on that apiserver)")
 
+    # multiprocess sharding flag surface (usage errors before heavy startup)
+    if args.shards > 0:
+        if plugin_args.kubeconfig:
+            parser.error(
+                "--shards runs the standalone sharded store; in --kubeconfig "
+                "mode the apiserver is the state of record — run one replica "
+                "per host with --leader-elect instead"
+            )
+        if args.ha_role != "none":
+            parser.error(
+                "--shards and --ha-role are exclusive: each shard worker "
+                "runs its own fenced leadership (per-shard epoch in its "
+                "data dir); front-level HA is the supervisor's restart path"
+            )
+        if args.nodes > 0:
+            parser.error(
+                "--nodes (embedded scheduler) is not supported with --shards "
+                "yet: run an external scheduler against /v1/prefilter"
+            )
+
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
+
+    if args.shards > 0:
+        return _serve_sharded(args, plugin_args, leader_elect, stop)
 
     rest_config = None
     if plugin_args.kubeconfig:

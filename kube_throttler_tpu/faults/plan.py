@@ -108,6 +108,13 @@ scenario.regression.flip_stall  the deliberately-injected SLO regression:
                             the engine routes this into a per-status-PUT
                             stall (``mock.status.delay``) so the flip-p99
                             gate demonstrably fails (scenarios/slo.py)
+shard.ipc.send              front→shard event-frame send raises: the shard
+                            looks dead to the front — events count as
+                            route misses, the shard goes dirty, and the
+                            supervisor's resync repairs it (sharding/ipc.py)
+shard.worker.kill           SIGKILL the shard worker at the next routed
+                            event batch (the kill-a-shard chaos smoke;
+                            sharding/worker.py handle_events)
 ==========================  ==================================================
 
 Virtual-time rules (the scenario engine's vocabulary): a rule may carry
@@ -183,6 +190,8 @@ KNOWN_SITES = frozenset(
         "scenario.leader.kill",
         "scenario.churn.stall",
         "scenario.regression.flip_stall",
+        "shard.ipc.send",
+        "shard.worker.kill",
     }
 )
 

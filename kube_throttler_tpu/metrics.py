@@ -110,6 +110,15 @@ METRIC_NAMES = frozenset(
         "kube_throttler_scenario_slo_gate",
         "kube_throttler_scenario_flip_p99_seconds",
         "kube_throttler_scenario_recovery_seconds",
+        # multiprocess keyspace sharding (register_shard_metrics /
+        # sharding/front.py): per-shard ingest + liveness, the
+        # scatter-gather fan-out latency, and the failure counters the
+        # degraded-mode runbook watches
+        "kube_throttler_shard_ingest_events_total",
+        "kube_throttler_shard_up",
+        "kube_throttler_shard_scatter_duration_seconds",
+        "kube_throttler_shard_route_misses_total",
+        "kube_throttler_shard_two_phase_aborts_total",
     }
 )
 
@@ -698,6 +707,52 @@ def register_scenario_metrics(registry: Registry) -> Dict[str, object]:
             ["scenario"],
         ),
     }
+
+
+def register_shard_metrics(registry: Registry, front) -> Dict[str, object]:
+    """Multiprocess-sharding observability (sharding/front.py): per-shard
+    ingest throughput and liveness sampled at scrape time from the shard
+    handles, plus the inline-observed scatter-gather fan-out latency and
+    the two failure counters (route misses to a down shard, two-phase
+    reserve aborts) the degraded-mode runbook alerts on."""
+    ingest_c = registry.counter_vec(
+        "kube_throttler_shard_ingest_events_total",
+        "events routed to and accepted by each shard's ingest pipeline",
+        ["shard"],
+    )
+    up_g = registry.gauge_vec(
+        "kube_throttler_shard_up",
+        "shard worker liveness (1=alive, 0=down) as the front sees it",
+        ["shard"],
+    )
+    scatter_h = registry.histogram_vec(
+        "kube_throttler_shard_scatter_duration_seconds",
+        "scatter-gather fan-out latency per RPC op (request fan-out to "
+        "last shard answer, merge excluded)",
+        ["op"],
+    )
+    misses_c = registry.counter_vec(
+        "kube_throttler_shard_route_misses_total",
+        "events that could not be delivered because the owning shard was "
+        "down (repaired by the restart resync)",
+        [],
+    )
+    aborts_c = registry.counter_vec(
+        "kube_throttler_shard_two_phase_aborts_total",
+        "two-phase reserves aborted by the front after a prepare failure",
+        [],
+    )
+
+    def flush() -> None:
+        for sid in range(front.n_shards):
+            handle = front.shards.get(sid)
+            alive = handle is not None and handle.alive
+            up_g.set_key((str(sid),), 1.0 if alive else 0.0)
+            if handle is not None:
+                ingest_c.set_key((str(sid),), float(handle.events_sent))
+
+    registry.register_pre_expose(flush)
+    return {"scatter": scatter_h, "aborts": aborts_c, "misses": misses_c}
 
 
 def register_ingest_metrics(registry: Registry, pipeline) -> None:

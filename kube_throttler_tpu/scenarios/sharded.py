@@ -1,0 +1,332 @@
+"""Sharded composed bad-day scenario: the PR 8 corpus' worst trace
+replayed against the PR 9 multiprocess stack.
+
+The single-process composed harness saturates near ~1k ev/s on one core
+(docs/PERFORMANCE.md "What bounds each path"): the wire-in FIFO leg —
+not the engine — is the knee, and ROADMAP item 1 names multiprocess
+sharding as what raises it. This runner replays the deterministic
+``bad_day`` trace (same ``build_trace`` bytes as the corpus) through
+the scatter-gather front at a pace ABOVE that knee, SIGKILLs one shard
+worker mid-replay (the kill-the-leader episode recast at the shard
+layer: each worker runs its own fenced leadership), and gates:
+
+- **knee lift**: the front sustains the target pace (default 1.4k ev/s,
+  ~1.4× the composed single-process knee) within ``min_pace_frac``;
+- **zero wrong verdicts**: after convergence, every pod's sharded
+  ``pre_filter`` equals a single-process oracle rebuilt from the final
+  state (code + normalized reasons);
+- **bounded recovery**: the killed shard rejoins (restart + resync)
+  within ``recovery_s``;
+- **flip p99**: crossing-anchored flip publication (scenarios/measure.py
+  anchors, measured on the FRONT store — routing + IPC + shard
+  reconcile + status push included) within the bad-day bound, outage
+  window excluded (the recovery gate bounds that instead).
+
+Run: ``python -m kube_throttler_tpu.scenarios.sharded --shards 4``
+(wired into ``make scenario-test``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["run_sharded_bad_day"]
+
+_OUTAGE_PAD_S = 0.25
+
+
+def _build_stack(n_shards: int):
+    from ..sharding.front import AdmissionFront
+    from ..sharding.supervisor import ShardSupervisor
+
+    front = AdmissionFront(n_shards)
+    supervisor = ShardSupervisor(
+        front,
+        # device ON like the composed corpus daemon: the two-lane flip
+        # path (batch flip-candidate detection → priority-lane promotion)
+        # lives on the device mirror — without it flips ride the normal
+        # refresh drains and the flip gate measures backlog, not the lane
+        use_device=True,
+        restart_backoff=0.3,
+        env={**os.environ, "KT_SHARD_QUIET": "1", "KT_LOCK_ASSERT": "0"},
+    )
+    supervisor.start(ready_timeout=300.0)
+    return front, supervisor
+
+
+KNEE_LIFT_PACE_HZ = 1400.0  # > the ~1k ev/s composed single-process knee
+UNDERSUBSCRIBED_PACE_HZ = 700.0  # 1-core fallback: protocol, not knee proof
+
+
+def run_sharded_bad_day(
+    n_shards: int = 4,
+    seed: int = 0,
+    pace_hz: Optional[float] = None,
+    min_pace_frac: float = 0.75,
+    recovery_s: float = 20.0,
+    flip_p99_ms: float = 250.0,
+    kill_at_frac: float = 0.45,
+    scenario_name: str = "bad_day",
+) -> Dict:
+    from .corpus import get_scenario
+    from .engine import _materialize_pod, _seed_remote_store
+    from .measure import (
+        count_watch_of,
+        flip_watch_of,
+        group_keys_of,
+        lag_tracker,
+    )
+    from .trace import build_topology, build_trace, serialize_trace, trace_sha256
+
+    host_cores = len(os.sched_getaffinity(0))
+    undersubscribed = host_cores < n_shards + 1
+    if pace_hz is None or pace_hz <= 0:
+        # the knee-lift gate (1400 > the ~1k composed knee) presumes one
+        # core per worker + the front; an undersubscribed host runs the
+        # same trace at a pace its timesharing can sustain — the gates
+        # still exercise the full protocol, they just don't prove the
+        # knee lift (host_cores in the report says which run this was)
+        pace_hz = UNDERSUBSCRIBED_PACE_HZ if undersubscribed else KNEE_LIFT_PACE_HZ
+    scn = get_scenario(scenario_name)
+    topology = build_topology(scn, seed)
+    header, ops = build_trace(scn, seed)
+    trace_sha = trace_sha256(serialize_trace(header, ops))
+    front, supervisor = _build_stack(n_shards)
+    report: Dict = {
+        "scenario": f"sharded_{scenario_name}",
+        "shards": n_shards,
+        "seed": seed,
+        "trace_sha256": trace_sha,
+        "pace_hz": pace_hz,
+        "host_cores": host_cores,
+        "undersubscribed": undersubscribed,
+        "knee_lift_proven": (not undersubscribed) and pace_hz >= KNEE_LIFT_PACE_HZ,
+        "gates": {},
+    }
+    try:
+        _seed_remote_store(front.store, scn, topology)
+        front.drain(timeout=300.0)
+        time.sleep(0.5)
+
+        # crossing-anchored flip measurement on the front store (the same
+        # anchors bench + the corpus use — scenarios/measure.py)
+        pending, flip_pending, pend_lock, _lags, flip_lags, flip_walls, on_write = (
+            lag_tracker()
+        )
+        group_keys = group_keys_of(front.store)
+        flip_watch, run_sums = flip_watch_of(front.store)
+        count_watch, run_counts = count_watch_of(front.store)
+        front.store.add_event_handler("Throttle", on_write, replay=False)
+
+        from ..engine.ingest import MicroBatchIngest
+
+        pipeline = MicroBatchIngest(front.store, batch_policy="adaptive")
+        kill_idx = int(len(ops) * kill_at_frac)
+        killed_sid: Optional[int] = None
+        outage: List[float] = []  # [t_kill, t_recovered]
+        n_applied_target = 0
+        t0 = time.perf_counter()
+        for i, op in enumerate(ops):
+            # trace order at OUR pace (the knee-lift gate's whole point:
+            # the composed trace replayed faster than one core can)
+            next_at = t0 + i / pace_hz
+            delay = next_at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            if i == kill_idx and supervisor.procs:
+                killed_sid = 0 if n_shards == 1 else 1
+                proc = supervisor.procs.get(killed_sid)
+                if proc is not None and proc.poll() is None:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    outage.append(time.perf_counter())
+            verb = op["verb"]
+            now = time.perf_counter()
+            grp = op.get("grp")
+            with pend_lock:
+                for key in group_keys.get(grp, ()):
+                    pending.setdefault(key, now)
+                if verb in ("update_pod", "create_pod", "delete_pod"):
+                    watch = flip_watch.get(grp)
+                    if watch:
+                        s_old = run_sums.get(grp, 0)
+                        s_new = s_old + op["cpu_m"] - op["prev_m"]
+                        run_sums[grp] = s_new
+                        for key, thr_mc in watch:
+                            if (s_old >= thr_mc) != (s_new >= thr_mc):
+                                flip_pending[key] = now
+                    cwatch = count_watch.get(grp)
+                    if cwatch and verb != "update_pod":
+                        c_old = run_counts.get(grp, 0)
+                        c_new = c_old + (1 if verb == "create_pod" else -1)
+                        run_counts[grp] = c_new
+                        for key, thr_n in cwatch:
+                            if (c_old >= thr_n) != (c_new >= thr_n):
+                                flip_pending[key] = now
+            if verb == "update_pod" or verb == "create_pod":
+                pod = _materialize_pod(
+                    op["name"], op["grp"], op.get("node", "n0"), op["cpu_m"]
+                )
+                pipeline.submit("upsert", "Pod", pod)
+                n_applied_target += 1
+            elif verb == "delete_pod":
+                pipeline.submit("delete", "Pod", f"default/{op['name']}")
+                n_applied_target += 1
+            elif verb == "update_throttle":
+                # the composed trace's spec churn (pod-count class only);
+                # routed like any other spec change
+                try:
+                    thr = front.store.get_throttle("default", op["name"])
+                except Exception:  # noqa: BLE001
+                    continue
+                from dataclasses import replace as _replace
+
+                from ..api.types import ResourceAmount
+
+                front.store.update_throttle_spec(
+                    _replace(
+                        thr,
+                        spec=_replace(
+                            thr.spec,
+                            threshold=ResourceAmount.of(
+                                pod=op.get("pod_threshold", 10)
+                            ),
+                        ),
+                    )
+                )
+        t_fired = time.perf_counter() - t0
+        pipeline.flush(timeout=120.0)
+        front.drain(timeout=300.0)
+        # the sustain clock stops HERE: fire window + ingest drain. The
+        # recovery wait and the settle sleeps below are gate bookkeeping,
+        # not ingest.
+        t_sustain = time.perf_counter() - t0
+        # recovery: the killed shard must be back and clean
+        rec_deadline = time.monotonic() + recovery_s
+        recovered = False
+        while time.monotonic() < rec_deadline:
+            state, _ = front._shards_health()
+            if state == "ok":
+                recovered = True
+                break
+            time.sleep(0.1)
+        if outage:
+            outage.append(time.perf_counter())
+        front.drain(timeout=300.0)
+        time.sleep(1.5)
+        pipe_stats = pipeline.stats()
+        front.store.remove_event_handler("Throttle", on_write)
+        pipeline.stop()
+
+        sustained = pipe_stats["events_applied"] / t_sustain
+        report["events"] = pipe_stats["events_applied"]
+        report["fired_hz"] = round(len(ops) / t_fired, 1)
+        report["sustained_hz"] = round(sustained, 1)
+        report["dropped"] = pipe_stats["dropped"]
+        report["gates"]["pace"] = {
+            "pass": sustained >= pace_hz * min_pace_frac and pipe_stats["dropped"] == 0,
+            "sustained_hz": round(sustained, 1),
+            "target_hz": pace_hz,
+            "min_frac": min_pace_frac,
+        }
+        report["gates"]["recovery"] = {
+            "pass": recovered,
+            "bound_s": recovery_s,
+            "restarts": dict(supervisor.restarts),
+            "killed_shard": killed_sid,
+        }
+
+        # flip p99, outage-excluded: a crossing STAMPED while its shard
+        # was dark cannot publish before the restart+resync closes the
+        # loop — those flips are the recovery gate's jurisdiction
+        # (partition by anchor time = publication wall − lag, the same
+        # restart-outage posture the composed engine takes)
+        if outage and len(outage) == 2:
+            lo, hi = outage[0] - _OUTAGE_PAD_S, outage[1] + _OUTAGE_PAD_S
+            samples = [
+                lag for lag, wall in zip(flip_lags, flip_walls)
+                if not (lo <= (wall - lag) <= hi)
+            ]
+        else:
+            samples = list(flip_lags)
+        if samples:
+            p50 = float(np.percentile(np.asarray(samples), 50)) * 1e3
+            p99 = float(np.percentile(np.asarray(samples), 99)) * 1e3
+        else:
+            p50 = p99 = 0.0
+        report["gates"]["flip_p99"] = {
+            "pass": p99 <= flip_p99_ms,
+            "p50_ms": round(p50, 1),
+            "p99_ms": round(p99, 1),
+            "bound_ms": flip_p99_ms,
+            "samples": len(samples),
+            "outage_excluded": max(0, len(flip_lags) - len(samples)),
+        }
+
+        # zero wrong verdicts vs the rebuilt oracle (tools/harness.py)
+        import tools.harness as H
+        from ..api.pod import Namespace
+        from ..engine.store import Store
+
+        oracle_store = Store()
+        oracle_store.create_namespace(Namespace("default"))
+        for thr in front.store.list_throttles():
+            oracle_store.create_throttle(thr)
+        for pod in front.store.list_pods():
+            oracle_store.create_pod(pod)
+        oracle = H.build_plugin(oracle_store)
+        oracle.run_pending_once()
+        wrong = []
+        for pod in oracle_store.list_pods():
+            got = front.pre_filter(pod)
+            want = oracle.pre_filter(pod)
+            if got.code != want.code or H.normalized_reasons(
+                got.reasons
+            ) != H.normalized_reasons(want.reasons):
+                wrong.append(pod.key)
+        report["gates"]["verdicts"] = {
+            "pass": not wrong,
+            "wrong": len(wrong),
+            "checked": len(oracle_store.list_pods()),
+            "examples": wrong[:5],
+        }
+        report["pass"] = all(g["pass"] for g in report["gates"].values())
+        return report
+    finally:
+        supervisor.stop()
+        front.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="scenarios.sharded")
+    parser.add_argument("--shards", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--pace", type=float, default=0.0,
+        help="replay pace in ev/s; 0 = auto (1400 knee-lift gate on a "
+        ">=shards+1 core host, 700 protocol-check pace otherwise)",
+    )
+    parser.add_argument("--scenario", default="bad_day")
+    parser.add_argument("--json", default="", help="write the report here too")
+    args = parser.parse_args(argv)
+    report = run_sharded_bad_day(
+        n_shards=args.shards, seed=args.seed, pace_hz=args.pace,
+        scenario_name=args.scenario,
+    )
+    print(json.dumps(report, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

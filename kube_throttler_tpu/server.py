@@ -248,10 +248,19 @@ class ThrottlerHTTPServer:
                         "breaker": dm.breaker_state(),
                     }
                 ),
-                "workqueues": {
-                    "throttle": len(self.plugin.throttle_ctr.workqueue),
-                    "clusterthrottle": len(self.plugin.cluster_throttle_ctr.workqueue),
-                },
+                # the sharded front has no local controllers — its
+                # workqueues live in the worker processes (per-shard
+                # depths come back on the shards component instead)
+                "workqueues": (
+                    {
+                        "throttle": len(self.plugin.throttle_ctr.workqueue),
+                        "clusterthrottle": len(
+                            self.plugin.cluster_throttle_ctr.workqueue
+                        ),
+                    }
+                    if hasattr(self.plugin, "throttle_ctr")
+                    else {}
+                ),
             }
             if self.ha is not None:
                 body["role"] = self.ha.role

@@ -1,0 +1,203 @@
+"""Shard worker supervisor: spawn, monitor, restart, resync.
+
+``cli.py serve --shards N`` builds one of these. Each worker is a real
+OS process (``python -m kube_throttler_tpu.sharding.worker``) connected
+over an inherited socketpair — SIGKILLing a worker is exactly the chaos
+case the kill-a-shard smoke drives, and the monitor turns it into:
+mark down (front degrades fail-safe) → respawn → full resync from the
+front's merged store (replay + prune) → shard recomputes and re-pushes
+every status (no lost flips).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .front import AdmissionFront
+from .ipc import ShardClient
+
+logger = logging.getLogger(__name__)
+
+
+class ShardSupervisor:
+    """Spawns and babysits ``n_shards`` worker processes for a front."""
+
+    def __init__(
+        self,
+        front: AdmissionFront,
+        name: str = "kube-throttler",
+        target_scheduler: str = "my-scheduler",
+        use_device: bool = True,
+        data_dir: Optional[str] = None,
+        ingest_batch="adaptive",
+        restart_backoff: float = 0.5,
+        max_restarts: int = 10,
+        worker_args: Optional[List[str]] = None,
+        env: Optional[dict] = None,
+    ):
+        self.front = front
+        self.n_shards = front.n_shards
+        self.name = name
+        self.target_scheduler = target_scheduler
+        self.use_device = use_device
+        self.data_dir = data_dir
+        self.ingest_batch = ingest_batch
+        self.restart_backoff = restart_backoff
+        self.max_restarts = max_restarts
+        self.worker_args = list(worker_args or [])
+        self.env = env
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.restarts: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- spawning
+
+    def _spawn(self, shard_id: int) -> subprocess.Popen:
+        parent_sock, child_sock = socket.socketpair()
+        argv = [
+            sys.executable, "-m", "kube_throttler_tpu.sharding.worker",
+            "--shard-id", str(shard_id),
+            "--shards", str(self.n_shards),
+            "--ipc-fd", str(child_sock.fileno()),
+            "--name", self.name,
+            "--target-scheduler-name", self.target_scheduler,
+            "--ingest-batch", str(self.ingest_batch),
+        ]
+        if not self.use_device:
+            argv.append("--no-device")
+        if self.data_dir:
+            argv += ["--data-dir", os.path.join(self.data_dir, f"shard-{shard_id}")]
+        argv += self.worker_args
+        env = dict(os.environ if self.env is None else self.env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            argv,
+            pass_fds=[child_sock.fileno()],
+            env=env,
+            stdout=subprocess.DEVNULL if env.get("KT_SHARD_QUIET") else None,
+            stderr=None,
+        )
+        child_sock.close()
+        client = ShardClient(
+            shard_id,
+            parent_sock,
+            on_push=self.front.apply_status_push,
+            on_down=self._on_shard_down,
+            faults=self.front.faults,
+        )
+        self.procs[shard_id] = proc
+        self.front.attach_shard(shard_id, client)
+        return proc
+
+    def start(self, ready_timeout: float = 120.0) -> None:
+        """Spawn every worker and block until each answers a ping (the
+        workers compile/prewarm serially on small hosts — be patient)."""
+        for sid in range(self.n_shards):
+            self._spawn(sid)
+        deadline = time.monotonic() + ready_timeout
+        for sid in range(self.n_shards):
+            while True:
+                try:
+                    self.front.shards[sid].request("ping", None, timeout=5.0)
+                    break
+                except Exception:  # noqa: BLE001 — keep waiting until deadline
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"shard {sid} did not become ready in {ready_timeout}s"
+                        ) from None
+                    if self.procs[sid].poll() is not None:
+                        raise RuntimeError(
+                            f"shard {sid} exited rc={self.procs[sid].returncode} "
+                            "during startup"
+                        ) from None
+                    time.sleep(0.1)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="shard-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    # ------------------------------------------------------------ monitoring
+
+    def _on_shard_down(self, shard_id: int) -> None:
+        logger.warning("shard %d transport down", shard_id)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.2):
+            for sid in range(self.n_shards):
+                proc = self.procs.get(sid)
+                if proc is None or proc.poll() is None:
+                    continue
+                if self._stop.is_set():
+                    return
+                self.restarts[sid] += 1
+                if self.restarts[sid] > self.max_restarts:
+                    logger.error(
+                        "shard %d died rc=%s; restart budget exhausted",
+                        sid, proc.returncode,
+                    )
+                    self.procs[sid] = None
+                    continue
+                logger.warning(
+                    "shard %d died rc=%s; restarting (%d/%d)",
+                    sid, proc.returncode, self.restarts[sid], self.max_restarts,
+                )
+                old = self.front.shards.get(sid)
+                if old is not None:
+                    old.close()
+                time.sleep(self.restart_backoff)
+                try:
+                    self._spawn(sid)
+                    # wait for readiness, then replay its keyspace slice
+                    deadline = time.monotonic() + 120.0
+                    while True:
+                        try:
+                            self.front.shards[sid].request("ping", None, timeout=5.0)
+                            break
+                        except Exception:  # noqa: BLE001
+                            if (
+                                time.monotonic() > deadline
+                                or self._stop.is_set()
+                                or self.procs[sid].poll() is not None
+                            ):
+                                raise
+                            time.sleep(0.1)
+                    self.front.resync_shard(sid)
+                except Exception:  # noqa: BLE001 — retried on the next tick
+                    logger.exception("shard %d restart failed", sid)
+
+    # -------------------------------------------------------------- shutdown
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+        for sid, handle in list(self.front.shards.items()):
+            try:
+                handle.close()
+            except Exception:  # noqa: BLE001
+                pass
+        deadline = time.monotonic() + timeout
+        for proc in self.procs.values():
+            if proc is None:
+                continue
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+
+
+__all__ = ["ShardSupervisor"]

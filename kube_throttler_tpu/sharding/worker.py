@@ -1,0 +1,538 @@
+"""One keyspace shard: a full engine vertical behind the IPC server.
+
+Each worker process owns its slice of the Throttle/ClusterThrottle
+keyspace end to end — store + SelectorIndex + journal + snapshot/
+recovery + device planes + micro-batch ingest + both controllers — and
+answers the front's scatter-gather RPCs. Nothing is shared between
+workers: no locks, no memory, no GIL. PR 6's fenced leadership runs
+independently per shard when a data dir is given (per-shard epoch file,
+per-shard journal fencing; a standby for shard *i* replicates from
+shard *i* alone).
+
+Run as a process:
+
+    python -m kube_throttler_tpu.sharding.worker \
+        --shard-id 0 --shards 4 --ipc-fd 3 [--data-dir DIR] [--no-device]
+
+The supervisor passes the socketpair fd; everything else arrives over
+the socket (events to ingest, RPCs to answer).
+
+Two-phase reserve, shard side: ``reserve_prepare`` performs the real
+reserve on this shard's matching throttles and parks the transaction in
+a pending table; ``txn_commit`` finalizes (drops the table entry, the
+reservation stays); ``txn_abort`` unreserves. A prepared transaction
+whose front died before deciding is ABORTED by the reaper once it ages
+past ``prepare_ttl`` — a prepare-crash can never leave an orphan
+reservation (tests/test_sharding.py pins this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.lockorder import guard_attrs, make_lock
+
+logger = logging.getLogger(__name__)
+
+# control verbs carried in-stream with store ops (front → shard)
+RESYNC_PRUNE = "__prune__"
+
+
+@guard_attrs
+class ShardCore:
+    """The shard's engine stack + RPC dispatch, transport-agnostic.
+
+    ``push(items)`` (settable) receives ``[(kind, obj), ...]`` status
+    events the shard's controllers wrote — the worker main sends them to
+    the front as ``push`` frames; tests wire it straight into the
+    front's applier.
+    """
+
+    GUARDED_BY = {
+        "_pending_txns": "self._txn_lock",
+        "_pending_gangs": "self._txn_lock",
+        "_gang_members": "self._txn_lock",
+        "reaped_txns": "self._txn_lock",
+        "_push_buf": "self._push_lock",
+    }
+
+    def __init__(
+        self,
+        shard_id: int,
+        n_shards: int,
+        name: str = "kube-throttler",
+        target_scheduler: str = "my-scheduler",
+        use_device: bool = True,
+        data_dir: Optional[str] = None,
+        ingest_batch="adaptive",
+        faults=None,
+        prepare_ttl: float = 30.0,
+        snapshot_every: int = 5000,
+    ):
+        from ..engine.store import Store
+        from ..engine.ingest import MicroBatchIngest
+        from ..plugin import KubeThrottler, decode_plugin_args
+
+        self.shard_id = shard_id
+        self.n_shards = n_shards
+        self.faults = faults
+        self.prepare_ttl = prepare_ttl
+        self.store = Store()
+        self.journal = None
+        self.recovery = None
+        self.snapshotter = None
+        self.epoch = None
+        self.ha = None
+        if data_dir:
+            from ..engine.recovery import RecoveryManager
+            from ..engine.replication import FencingEpoch, HaCoordinator
+            from ..engine.snapshot import SnapshotManager
+
+            os.makedirs(data_dir, exist_ok=True)
+            self.recovery = RecoveryManager(data_dir)
+            self.journal = self.recovery.recover_store(self.store)
+            self.snapshotter = SnapshotManager(data_dir, self.store)
+            # PR 6 fenced leadership, per shard: this process claims a
+            # term for ITS keyspace slice; journal appends and snapshot
+            # cuts refuse once the epoch goes stale
+            self.epoch = FencingEpoch(data_dir)
+            self.epoch.observe(self.recovery.report.epoch)
+            self.journal.fencing = self.epoch
+            self.snapshotter.fencing = self.epoch
+            self.ha = HaCoordinator(
+                self.epoch, role="leader", journal=self.journal,
+                snapshotter=self.snapshotter,
+            )
+            self.ha.become_leader()
+        self.plugin = KubeThrottler(
+            decode_plugin_args(
+                {"name": name, "targetSchedulerName": target_scheduler}
+            ),
+            self.store,
+            use_device=use_device,
+            start_workers=True,
+        )
+        if self.recovery is not None:
+            caches = {
+                "throttle": self.plugin.throttle_ctr.cache,
+                "clusterthrottle": self.plugin.cluster_throttle_ctr.cache,
+            }
+            self.recovery.restore_reservations(caches)
+            self.plugin.gang.journal = self.journal
+            self.recovery.restore_gangs(self.plugin.gang, self.journal)
+            self.recovery.reconcile(
+                self.plugin.informers,
+                device_manager=self.plugin.device_manager,
+                enqueue={
+                    "throttle": self.plugin.throttle_ctr.enqueue,
+                    "clusterthrottle": self.plugin.cluster_throttle_ctr.enqueue,
+                },
+            )
+            self.snapshotter.reservations = caches
+            self.snapshotter.gang_ledger = self.plugin.gang
+            self.snapshotter.device_manager = self.plugin.device_manager
+            self.snapshotter.bind_journal(self.journal, every_lines=snapshot_every)
+        if ingest_batch in ("off", "none", "", None):
+            ingest_batch = 1
+        self.pipeline = MicroBatchIngest(
+            self.store, batch_policy=ingest_batch, faults=faults
+        )
+        # two-phase reserve bookkeeping
+        self._txn_lock = make_lock(f"shard.txn.{shard_id}")
+        self._pending_txns: Dict[str, Tuple[object, float]] = {}  # txn → (pod, t)
+        self._pending_gangs: Dict[str, Tuple[str, float]] = {}  # txn → (group, t)
+        # NON-owner shards hold a gang's member reservations as plain
+        # reservations (the authoritative ledger record lives only on the
+        # group's hash-owner shard): group → member pods, so a rollback
+        # releases them without a ledger
+        self._gang_members: Dict[str, List[object]] = {}
+        self.reaped_txns = 0
+        # status push plumbing: handlers append under the push lock (they
+        # run inside the store lock and must stay informer-cheap); the
+        # pusher thread flushes batches to ``push``
+        self.push = None  # set by the transport wrapper
+        self._push_lock = make_lock(f"shard.push.{shard_id}")
+        self._push_cond = threading.Condition(self._push_lock)
+        self._push_buf: List[Tuple[str, object]] = []
+        self._stop = threading.Event()
+        for kind in ("Throttle", "ClusterThrottle"):
+            self.store.add_event_handler(kind, self._on_status_event, replay=False)
+        self._pusher = threading.Thread(
+            target=self._push_loop, name=f"shard{shard_id}-push", daemon=True
+        )
+        self._pusher.start()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name=f"shard{shard_id}-reaper", daemon=True
+        )
+        self._reaper.start()
+
+    # ----------------------------------------------------------- status push
+
+    def _on_status_event(self, event) -> None:
+        from ..engine.store import EventType
+
+        if event.type is not EventType.MODIFIED or event.old_obj is None:
+            return
+        if event.obj.status == event.old_obj.status:
+            return  # spec echo routed by the front — not ours to re-publish
+        with self._push_cond:
+            self._push_buf.append((event.kind, event.obj))
+            self._push_cond.notify()
+
+    def _push_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._push_cond:
+                while not self._push_buf and not self._stop.is_set():
+                    self._push_cond.wait(0.2)
+                buf, self._push_buf = self._push_buf, []
+            if buf and self.push is not None:
+                try:
+                    self.push(buf)
+                except Exception:  # noqa: BLE001 — front gone; supervisor acts
+                    logger.warning("shard %d: status push failed", self.shard_id,
+                                   exc_info=True)
+
+    # ---------------------------------------------------------------- events
+
+    def handle_events(self, ops: Sequence[Tuple[str, str, object]]) -> None:
+        """Apply a routed event batch through the micro-batch pipeline.
+        Control ops (resync prune) are handled in-stream, in order."""
+        if self.faults is not None:
+            fault = self.faults.check("shard.worker.kill")
+            if fault is not None and fault.mode == "kill":
+                fault.kill()
+        batch: List[Tuple[str, str, object]] = []
+        for op in ops:
+            if op[0] == RESYNC_PRUNE:
+                if batch:
+                    self.pipeline.submit_many(batch)
+                    batch = []
+                self._prune(op[2])
+                continue
+            batch.append(op)
+        if batch:
+            self.pipeline.submit_many(batch)
+
+    def _prune(self, want: Dict[str, Sequence[str]]) -> None:
+        """Resync epilogue: everything this shard holds that the front's
+        replay did not name was deleted while the shard was down — drop
+        it (the StandbyReplicator bootstrap rule, applied over IPC)."""
+        from ..engine.store import key_of
+
+        self.pipeline.flush(timeout=30.0)
+        ops = []
+        for kind, lister in (
+            ("Pod", self.store.list_pods),
+            ("Throttle", self.store.list_throttles),
+            ("ClusterThrottle", self.store.list_cluster_throttles),
+            ("Namespace", self.store.list_namespaces),
+        ):
+            have = set(want.get(kind, ()))
+            for obj in lister():
+                if key_of(kind, obj) not in have:
+                    ops.append(("delete", kind, key_of(kind, obj)))
+        if ops:
+            self.store.apply_events(ops)
+
+    # ------------------------------------------------------------------- RPC
+
+    def rpc(self, op: str, payload) -> Tuple[bool, object]:
+        """Dispatch one RPC; returns (ok, body). Never raises."""
+        try:
+            handler = getattr(self, f"_rpc_{op}", None)
+            if handler is None:
+                return False, f"unknown rpc {op!r}"
+            return True, handler(payload)
+        except Exception as e:  # noqa: BLE001 — reported to the front
+            return False, f"{e.__class__.__name__}: {e}"
+
+    def _rpc_ping(self, _payload):
+        return {
+            "shard": self.shard_id,
+            "epoch": self.epoch.current() if self.epoch is not None else 0,
+        }
+
+    def _rpc_pre_filter(self, pod):
+        """Shard-local admission check: both kinds' ``check_throttled``
+        against this shard's throttles. Returns per-kind name lists —
+        the front AND-merges and composes the reason strings."""
+        out = {}
+        for kind, ctr in (
+            ("throttle", self.plugin.throttle_ctr),
+            ("clusterthrottle", self.plugin.cluster_throttle_ctr),
+        ):
+            try:
+                active, insufficient, exceeds, _ = ctr.check_throttled(pod, False)
+            except Exception as e:  # noqa: BLE001 — the per-kind error contract
+                out[kind] = {"error": str(e)}
+                continue
+            out[kind] = {
+                "active": [t.key for t in active],
+                "insufficient": [t.key for t in insufficient],
+                "exceeds": [t.key for t in exceeds],
+            }
+        return out
+
+    def _rpc_pre_filter_batch(self, _payload):
+        return self.plugin.pre_filter_batch()
+
+    def _rpc_reserve_prepare(self, payload):
+        txn, pod = payload["txn"], payload["pod"]
+        status = self.plugin.reserve(pod)
+        if not status.is_success():
+            raise RuntimeError("; ".join(status.reasons) or "reserve failed")
+        with self._txn_lock:
+            self._pending_txns[txn] = (pod, time.monotonic())
+        return True
+
+    def _rpc_txn_commit(self, payload):
+        with self._txn_lock:
+            self._pending_txns.pop(payload["txn"], None)
+            self._pending_gangs.pop(payload["txn"], None)
+        return True
+
+    def _rpc_txn_abort(self, payload):
+        with self._txn_lock:
+            entry = self._pending_txns.pop(payload["txn"], None)
+            gang = self._pending_gangs.pop(payload["txn"], None)
+        if entry is not None:
+            self.plugin.unreserve(entry[0])
+        if gang is not None:
+            self._gang_release(gang[0])
+        return True
+
+    def _rpc_unreserve(self, pod):
+        self.plugin.unreserve(pod)
+        return True
+
+    def _rpc_gang_check(self, payload):
+        status = self.plugin.pre_filter_gang(payload["group"], payload["pods"])
+        return {"code": status.code.value, "reasons": list(status.reasons)}
+
+    def _rpc_gang_prepare(self, payload):
+        """Gang prepare. On the group's hash-OWNER shard this is the real
+        ledger reserve (all-or-nothing locally, GANG journal stamps, TTL
+        authority). On other matching shards the members reserve as plain
+        reservations — the ledger record exists on exactly one shard."""
+        txn, group, pods = payload["txn"], payload["group"], payload["pods"]
+        owner = bool(payload.get("owner", True))
+        if owner:
+            status = self.plugin.reserve_gang(group, pods)
+            if not status.is_success():
+                raise RuntimeError("; ".join(status.reasons) or "gang reserve failed")
+        else:
+            reserved: List[object] = []
+            try:
+                for pod in pods:
+                    st = self.plugin.reserve(pod)
+                    if not st.is_success():
+                        raise RuntimeError("; ".join(st.reasons) or "member reserve failed")
+                    reserved.append(pod)
+            except Exception:
+                for pod in reserved:
+                    self.plugin.unreserve(pod)
+                raise
+            with self._txn_lock:
+                self._gang_members[group] = list(pods)
+        with self._txn_lock:
+            self._pending_gangs[txn] = (group, time.monotonic())
+        return True
+
+    def _gang_release(self, group: str) -> None:
+        with self._txn_lock:
+            members = self._gang_members.pop(group, None)
+        if members is not None:
+            for pod in members:
+                self.plugin.unreserve(pod)
+        self.plugin.unreserve_gang(group)  # no-op where no ledger record
+
+    def _rpc_gang_rollback(self, payload):
+        self._gang_release(payload["group"])
+        return True
+
+    def _rpc_gang_groups(self, _payload):
+        """Group keys with live ledger records on this shard (tests pin
+        the one-owner property of the authoritative ledger entry)."""
+        return sorted(self.plugin.gang.snapshot_state().keys())
+
+    def _rpc_stats(self, _payload):
+        ps = self.pipeline.stats()
+        with self._txn_lock:
+            reaped = self.reaped_txns
+            pending = len(self._pending_txns) + len(self._pending_gangs)
+        return {
+            "shard": self.shard_id,
+            "ingest": ps,
+            "workqueues": {
+                "throttle": len(self.plugin.throttle_ctr.workqueue),
+                "clusterthrottle": len(self.plugin.cluster_throttle_ctr.workqueue),
+            },
+            "objects": {
+                "pods": len(self.store.list_pods()),
+                "throttles": len(self.store.list_throttles()),
+                "clusterthrottles": len(self.store.list_cluster_throttles()),
+            },
+            "reaped_txns": reaped,
+            "pending_txns": pending,
+            "epoch": self.epoch.current() if self.epoch is not None else 0,
+        }
+
+    def _rpc_drain(self, payload):
+        timeout = float(payload.get("timeout", 5.0)) if payload else 5.0
+        flushed = self.pipeline.flush(timeout=timeout)
+        return {
+            "flushed": flushed,
+            "queue": self.pipeline.qsize(),
+            "workqueues": {
+                "throttle": len(self.plugin.throttle_ctr.workqueue),
+                "clusterthrottle": len(self.plugin.cluster_throttle_ctr.workqueue),
+            },
+            "applied": self.pipeline.stats()["events_applied"],
+        }
+
+    # ---------------------------------------------------------------- reaper
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(min(1.0, self.prepare_ttl / 4 or 1.0)):
+            self.reap_stale_txns()
+
+    def reap_stale_txns(self, now: Optional[float] = None) -> int:
+        """Abort prepared transactions older than ``prepare_ttl`` (the
+        front died between prepare and commit). Returns aborts done."""
+        now = time.monotonic() if now is None else now
+        stale_pods, stale_gangs = [], []
+        with self._txn_lock:
+            for txn, (pod, t0) in list(self._pending_txns.items()):
+                if now - t0 >= self.prepare_ttl:
+                    stale_pods.append(pod)
+                    del self._pending_txns[txn]
+            for txn, (group, t0) in list(self._pending_gangs.items()):
+                if now - t0 >= self.prepare_ttl:
+                    stale_gangs.append(group)
+                    del self._pending_gangs[txn]
+            self.reaped_txns += len(stale_pods) + len(stale_gangs)
+        for pod in stale_pods:
+            self.plugin.unreserve(pod)
+        for group in stale_gangs:
+            self._gang_release(group)
+        return len(stale_pods) + len(stale_gangs)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._push_cond:
+            self._push_cond.notify_all()
+        self.pipeline.stop()
+        self.plugin.stop()
+        if self.snapshotter is not None:
+            self.snapshotter.write(reason="shutdown")
+        if self.journal is not None:
+            self.journal.close()
+
+
+def serve(core: ShardCore, sock: socket.socket) -> None:
+    """The worker's IPC loop: read frames until EOF. Events apply via the
+    ingest pipeline (non-blocking); RPCs answer from a small pool so a
+    long batch call cannot park the event stream."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .ipc import read_frame, send_frame
+
+    send_lock = make_lock(f"shard.serve.{core.shard_id}")
+    core.push = lambda items: send_frame(sock, send_lock, "push", 0, items)
+    pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="shard-rpc")
+    rfile = sock.makefile("rb")
+
+    def answer(rid: int, op: str, payload) -> None:
+        result = core.rpc(op, payload)
+        try:
+            send_frame(sock, send_lock, "res", rid, result)
+        except OSError:
+            pass  # front went away; the supervisor restarts us if needed
+
+    try:
+        while True:
+            frame = read_frame(rfile)
+            if frame is None:
+                return
+            mtype, rid, body = frame
+            if mtype == "evt":
+                core.handle_events(body)
+            elif mtype == "req":
+                op, payload = body
+                pool.submit(answer, rid, op, payload)
+    except OSError:
+        return
+    finally:
+        pool.shutdown(wait=False)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from ..utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    parser = argparse.ArgumentParser(prog="kube-throttler-shard")
+    parser.add_argument("--shard-id", type=int, required=True)
+    parser.add_argument("--shards", type=int, required=True)
+    parser.add_argument("--ipc-fd", type=int, required=True)
+    parser.add_argument("--name", default="kube-throttler")
+    parser.add_argument("--target-scheduler-name", default="my-scheduler")
+    parser.add_argument("--data-dir", default="")
+    parser.add_argument("--no-device", action="store_true")
+    parser.add_argument("--ingest-batch", default="adaptive")
+    parser.add_argument("--prepare-ttl", type=float, default=30.0)
+    parser.add_argument("--fault-seed", type=int, default=0)
+    parser.add_argument(
+        "--fault-site", default="",
+        help="arm one seeded fault rule (site[:mode[:after]]) — the chaos "
+        "harness's kill/err injection, e.g. shard.worker.kill:kill:25",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s %(levelname).1s shard{args.shard_id} %(name)s] %(message)s",
+    )
+    faults = None
+    if args.fault_site:
+        from ..faults.plan import FaultPlan
+
+        parts = args.fault_site.split(":")
+        site = parts[0]
+        mode = parts[1] if len(parts) > 1 else "error"
+        after = int(parts[2]) if len(parts) > 2 else 0
+        faults = FaultPlan(seed=args.fault_seed).rule(
+            site, mode=mode, after=after, times=1
+        )
+    ingest_batch = args.ingest_batch
+    if ingest_batch not in ("adaptive", "off", "none", ""):
+        ingest_batch = int(ingest_batch)
+    core = ShardCore(
+        args.shard_id,
+        args.shards,
+        name=args.name,
+        target_scheduler=args.target_scheduler_name,
+        use_device=not args.no_device,
+        data_dir=args.data_dir or None,
+        ingest_batch=ingest_batch,
+        faults=faults,
+        prepare_ttl=args.prepare_ttl,
+    )
+    sock = socket.socket(fileno=args.ipc_fd)
+    print(f"shard {args.shard_id}/{args.shards} ready", flush=True)
+    try:
+        serve(core, sock)
+    finally:
+        core.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
