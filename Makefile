@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress crash-test ha-test scenario-test shard-scenario scenario-regression lint gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress crash-test ha-test scenario-test shard-scenario scenario-regression lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -29,9 +29,12 @@ shard-scenario:  ## sharded composed bad-day alone: 4 workers, kill-a-shard epis
 scenario-regression: ## prove the gates gate: clean vs injected-regression diff report
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios regression --name smoke
 
-lint:            ## static analyzer (lock discipline, JAX purity, registries) + syntax sanity
+lint:            ## 8-checker static analyzer (locks, purity, registries, blocking, threads, excsafety, protocol) + syntax sanity
 	$(PY) -m compileall -q kube_throttler_tpu tools bench.py __graft_entry__.py
 	$(PY) -m kube_throttler_tpu.analysis
+
+ci:              ## the CI gate: lint + fast smoke tier (hack/ci.sh) — lint failures fail CI, not review
+	hack/ci.sh
 
 gen:             ## regenerate deploy/crd.yaml from the typed API model
 	$(PY) tools/gen_crd.py

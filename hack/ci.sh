@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# CI gate: `make ci`. Static analysis failures fail CI, not review —
+# the analyzer (8 checkers + the stale-waiver gate) runs first, then a
+# fast smoke tier that proves the analyzer and the runtime lock
+# assassin themselves work. The full tier-1 suite stays `make test`;
+# this script is the cheap always-on gate (<~1 min).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint: compileall + 8-checker static analysis + stale-waiver gate =="
+make lint
+
+echo "== smoke: analyzer fixtures, lock assassin + hold budgets, journal =="
+env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_analysis.py tests/test_lockorder.py tests/test_journal.py \
+    -q -p no:cacheprovider
+
+echo "ci gate: OK"

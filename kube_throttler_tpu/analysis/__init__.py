@@ -1,48 +1,99 @@
-"""Repo-native static analyzer: lock discipline, JAX trace purity, and
-string-keyed registry consistency.
+"""Repo-native static analyzer: lock discipline, JAX trace purity,
+string-keyed registry consistency, and (second generation) blocking-
+under-lock, thread-lifecycle, exception-safety, and cross-process
+protocol checking.
 
 Run as ``python -m kube_throttler_tpu.analysis`` (or ``make lint``).
 Checkers:
 
 - ``guarded``   — guarded-by attribute discipline (guarded.py)
 - ``lockorder`` — static lock-acquisition order graph (lockgraph.py)
-- ``purity``    — JAX trace purity over ops/ and parallel/ (purity.py)
+- ``purity``    — JAX trace purity over ops/, parallel/, sharding/ (purity.py)
 - ``registry``  — fault-site and metric-name registries (registry.py)
+- ``blocking``  — blocking calls reached under a named lock (blocking.py)
+- ``threads``   — silent thread death / daemon-under-lock / unbounded
+  shutdown joins (threads.py)
+- ``excsafety`` — fd/lock/reservation leaks on exception paths (excsafety.py)
+- ``protocol``  — journal control lines, IPC frame types, fencing-epoch
+  domination (protocol.py)
 
-The runtime counterpart — the instrumented-lock assassin enabled by
-``KT_LOCK_ASSERT=1`` — lives in ``kube_throttler_tpu.utils.lockorder``.
-See docs/STATIC_ANALYSIS.md.
+The runtime counterparts — the instrumented-lock assassin and the
+per-lock hold-time budgets enabled by ``KT_LOCK_ASSERT=1`` — live in
+``kube_throttler_tpu.utils.lockorder``. See docs/STATIC_ANALYSIS.md.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from . import guarded, lockgraph, purity, registry
+from . import blocking, excsafety, guarded, lockgraph, protocol, purity, registry, threads
 from .core import Finding, Module, apply_baseline, load_baseline, load_package
 
 PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "lockorder_allow.txt")
+DEFAULT_BLOCKING_ALLOWLIST = os.path.join(
+    os.path.dirname(__file__), "blocking_allow.txt"
+)
 
-CHECKERS = ("guarded", "lockorder", "purity", "registry")
+CHECKERS = (
+    "guarded",
+    "lockorder",
+    "purity",
+    "registry",
+    "blocking",
+    "threads",
+    "excsafety",
+    "protocol",
+)
 
 
 def run_checks(
     modules: Sequence[Module],
     checks: Sequence[str] = CHECKERS,
     allowlist_path: Optional[str] = DEFAULT_ALLOWLIST,
+    blocking_allowlist_path: Optional[str] = DEFAULT_BLOCKING_ALLOWLIST,
+    stale_allow_out: Optional[Dict[str, List[Tuple[str, str]]]] = None,
 ) -> List[Finding]:
+    """Run the selected checkers over ``modules``. ``stale_allow_out``
+    (when given) maps checker name -> dead allowlist pairs so the CLI can
+    error on (and ``--prune-stale``) waiver rot."""
     findings: List[Finding] = []
     if "guarded" in checks:
         findings.extend(guarded.check(modules))
     if "lockorder" in checks:
-        findings.extend(lockgraph.check(modules, allowlist_path=allowlist_path))
+        stale: Optional[List[Tuple[str, str]]] = (
+            stale_allow_out.setdefault("lockorder", [])
+            if stale_allow_out is not None
+            else None
+        )
+        findings.extend(
+            lockgraph.check(modules, allowlist_path=allowlist_path, stale_out=stale)
+        )
     if "purity" in checks:
         findings.extend(purity.check(modules))
     if "registry" in checks:
         findings.extend(registry.check(modules))
+    if "blocking" in checks:
+        stale = (
+            stale_allow_out.setdefault("blocking", [])
+            if stale_allow_out is not None
+            else None
+        )
+        findings.extend(
+            blocking.check(
+                modules,
+                allowlist_path=blocking_allowlist_path,
+                stale_out=stale,
+            )
+        )
+    if "threads" in checks:
+        findings.extend(threads.check(modules))
+    if "excsafety" in checks:
+        findings.extend(excsafety.check(modules))
+    if "protocol" in checks:
+        findings.extend(protocol.check(modules))
     findings.sort(key=lambda f: (f.relpath or f.path, f.line, f.checker, f.message))
     return findings
 
@@ -52,9 +103,17 @@ def run_repo(
     checks: Sequence[str] = CHECKERS,
     baseline_path: Optional[str] = DEFAULT_BASELINE,
     allowlist_path: Optional[str] = DEFAULT_ALLOWLIST,
+    blocking_allowlist_path: Optional[str] = DEFAULT_BLOCKING_ALLOWLIST,
+    stale_allow_out: Optional[Dict[str, List[Tuple[str, str]]]] = None,
 ):
     """(new, waived, stale) findings for the package at ``root``."""
     modules = load_package(root)
-    findings = run_checks(modules, checks, allowlist_path)
+    findings = run_checks(
+        modules,
+        checks,
+        allowlist_path,
+        blocking_allowlist_path=blocking_allowlist_path,
+        stale_allow_out=stale_allow_out,
+    )
     baseline = load_baseline(baseline_path) if baseline_path else {}
     return apply_baseline(findings, baseline)

@@ -1,10 +1,12 @@
 """CLI for the repo-native static analyzer.
 
 Exit status: 0 when every finding is either absent or waived in the
-baseline; 1 when new findings exist (they are printed ``path:line:
-[checker] message``). Stale baseline entries (waivers whose finding no
-longer exists) are reported as warnings so they get deleted, but do not
-fail the run.
+baseline AND every waiver is still live; 1 when new findings exist (they
+are printed ``path:line: [checker] message``) OR any baseline/allowlist
+entry is stale (a waiver whose finding no longer exists is waiver rot —
+it hides nothing today and will silently hide a regression tomorrow).
+``--prune-stale`` rewrites the baseline and allow files dropping the
+dead entries instead of failing on them.
 """
 
 from __future__ import annotations
@@ -16,17 +18,21 @@ from . import (
     CHECKERS,
     DEFAULT_ALLOWLIST,
     DEFAULT_BASELINE,
+    DEFAULT_BLOCKING_ALLOWLIST,
     PACKAGE_ROOT,
     run_checks,
 )
-from .core import apply_baseline, load_baseline, load_package
+from .core import apply_baseline, load_baseline, load_package, prune_file_lines
 from .lockgraph import build_edges
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kube_throttler_tpu.analysis",
-        description="lock discipline / JAX purity / registry static analyzer",
+        description=(
+            "lock discipline / JAX purity / registry / blocking / thread / "
+            "exception-safety / protocol static analyzer"
+        ),
     )
     ap.add_argument("--root", default=PACKAGE_ROOT, help="package root to analyze")
     ap.add_argument(
@@ -35,7 +41,8 @@ def main(argv=None) -> int:
         help=f"comma-separated subset of: {', '.join(CHECKERS)}",
     )
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
-    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    ap.add_argument("--allowlist", default=None)
+    ap.add_argument("--blocking-allowlist", default=None)
     ap.add_argument(
         "--no-baseline",
         action="store_true",
@@ -45,6 +52,11 @@ def main(argv=None) -> int:
         "--write-baseline",
         action="store_true",
         help="append new findings to the baseline with TODO justifications",
+    )
+    ap.add_argument(
+        "--prune-stale",
+        action="store_true",
+        help="delete stale baseline/allowlist entries instead of failing on them",
     )
     ap.add_argument(
         "--dump-lock-graph",
@@ -65,7 +77,39 @@ def main(argv=None) -> int:
             print(f"{a} -> {b}    # {path}:{line} ({ctx})")
         return 0
 
-    findings = run_checks(modules, checks, allowlist_path=args.allowlist)
+    # stale-allowlist enforcement only makes sense when the allow file
+    # and the analyzed tree belong together: the default allow files
+    # against the default root (the repo gate), or an explicitly given
+    # file (fixture tests). A custom --root against the repo's defaults
+    # is mismatched by construction — findings are still filtered, but
+    # unmatched entries are not waiver rot.
+    import os as _os
+
+    root_is_default = _os.path.abspath(args.root) == _os.path.abspath(PACKAGE_ROOT)
+    enforce_stale = {
+        "lockorder": root_is_default or args.allowlist is not None,
+        "blocking": root_is_default or args.blocking_allowlist is not None,
+    }
+    allowlist = args.allowlist if args.allowlist is not None else DEFAULT_ALLOWLIST
+    blocking_allowlist = (
+        args.blocking_allowlist
+        if args.blocking_allowlist is not None
+        else DEFAULT_BLOCKING_ALLOWLIST
+    )
+
+    stale_allow: dict = {}
+    findings = run_checks(
+        modules,
+        checks,
+        allowlist_path=allowlist,
+        blocking_allowlist_path=blocking_allowlist,
+        stale_allow_out=stale_allow,
+    )
+    stale_allow = {
+        checker: pairs
+        for checker, pairs in stale_allow.items()
+        if enforce_stale.get(checker)
+    }
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new, waived, stale = apply_baseline(findings, baseline)
 
@@ -77,15 +121,46 @@ def main(argv=None) -> int:
                 fh.write(f"{f.key()}  # TODO: justify or fix\n")
         print(f"wrote {len(new)} new waiver(s) to {args.baseline}", file=sys.stderr)
         return 0
+
+    allow_paths = {"lockorder": allowlist, "blocking": blocking_allowlist}
+    n_stale_allow = sum(len(v) for v in stale_allow.values())
+    if args.prune_stale:
+        pruned = 0
+        if stale:
+            stale_set = set(stale)
+            pruned += prune_file_lines(
+                args.baseline, lambda body: body in stale_set
+            )
+        for checker, pairs in stale_allow.items():
+            if not pairs:
+                continue
+            dead = {f"{a} -> {b}" for a, b in pairs}
+
+            def _is_stale(body: str, dead=dead) -> bool:
+                a, _, b = body.partition("->")
+                return f"{a.strip()} -> {b.strip()}" in dead
+
+            pruned += prune_file_lines(allow_paths[checker], _is_stale)
+        if pruned and not args.quiet:
+            print(f"pruned {pruned} stale waiver(s)", file=sys.stderr)
+        stale, stale_allow, n_stale_allow = [], {}, 0
+
+    for k in stale:
+        print(f"error: stale baseline entry (fix: --prune-stale): {k}")
+    for checker, pairs in stale_allow.items():
+        for a, b in pairs:
+            print(
+                f"error: stale {checker} allowlist entry (fix: --prune-stale): "
+                f"{a} -> {b}"
+            )
     if not args.quiet:
-        for k in stale:
-            print(f"warning: stale baseline entry (delete it): {k}", file=sys.stderr)
         print(
             f"analysis: {len(new)} new finding(s), {len(waived)} waived, "
-            f"{len(stale)} stale waiver(s) over {len(modules)} file(s)",
+            f"{len(stale) + n_stale_allow} stale waiver(s) over "
+            f"{len(modules)} file(s)",
             file=sys.stderr,
         )
-    return 1 if new else 0
+    return 1 if (new or stale or n_stale_allow) else 0
 
 
 if __name__ == "__main__":

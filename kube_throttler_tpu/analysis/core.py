@@ -48,6 +48,7 @@ class Module:
     def __post_init__(self) -> None:
         if not self.lines:
             self.lines = self.source.splitlines()
+        self._nodes: Optional[List[ast.AST]] = None
 
     @property
     def modname(self) -> str:
@@ -55,15 +56,38 @@ class Module:
         rel = self.relpath[:-3] if self.relpath.endswith(".py") else self.relpath
         return rel.replace(os.sep, ".").replace("/", ".")
 
+    def walk(self) -> List[ast.AST]:
+        """Every node of the tree, computed once. Eight checkers walk the
+        same 90-odd files; materializing the node list once per file keeps
+        full-repo ``make lint`` comfortably inside its latency budget."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+
+# (path, mtime_ns, size) -> Module — parses survive across run_checks
+# calls in one process (the test tier drives the pipeline dozens of
+# times; the CLI benefits when checkers re-load scoped subsets)
+_PARSE_CACHE: Dict[Tuple[str, int, int], Module] = {}
+
 
 def load_module(path: str, relpath: Optional[str] = None) -> Optional[Module]:
     try:
+        st = os.stat(path)
+        cache_key = (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+        cached = _PARSE_CACHE.get(cache_key)
+        if cached is not None and cached.relpath == (relpath or path):
+            return cached
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
         tree = ast.parse(source, filename=path)
     except (OSError, SyntaxError):
         return None
-    return Module(path=path, relpath=relpath or path, source=source, tree=tree)
+    mod = Module(path=path, relpath=relpath or path, source=source, tree=tree)
+    if len(_PARSE_CACHE) > 4096:  # a bound, not an eviction policy
+        _PARSE_CACHE.clear()
+    _PARSE_CACHE[cache_key] = mod
+    return mod
 
 
 def load_package(root: str, subdirs: Optional[Sequence[str]] = None) -> List[Module]:
@@ -154,3 +178,45 @@ def apply_baseline(
         (waived if k in baseline else new).append(f)
     stale = [k for k in baseline if k not in seen_keys]
     return new, waived, stale
+
+
+# ------------------------------------------------------------- allow files
+
+
+def load_pair_allowlist(path: Optional[str]) -> Dict[Tuple[str, str], str]:
+    """``nodeA -> nodeB  # why`` lines -> {(a, b): justification}. The
+    shared format of lockorder_allow.txt and blocking_allow.txt."""
+    out: Dict[Tuple[str, str], str] = {}
+    if not path or not os.path.exists(path):
+        return out
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            body, _, comment = raw.partition("#")
+            body = body.strip()
+            if not body or "->" not in body:
+                continue
+            a, _, b = body.partition("->")
+            out[(a.strip(), b.strip())] = comment.strip()
+    return out
+
+
+def prune_file_lines(path: str, is_stale) -> int:
+    """Rewrite ``path`` dropping every non-comment line for which
+    ``is_stale(stripped_body)`` is true (comment/blank lines survive).
+    Returns the number of lines removed — the ``--prune-stale`` autofix."""
+    if not os.path.exists(path):
+        return 0
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+    kept: List[str] = []
+    dropped = 0
+    for raw in lines:
+        body = raw.split("#", 1)[0].strip() if not raw.lstrip().startswith("#") else ""
+        if body and is_stale(body):
+            dropped += 1
+            continue
+        kept.append(raw)
+    if dropped:
+        with open(path, "w", encoding="utf-8") as f:
+            f.writelines(kept)
+    return dropped

@@ -263,6 +263,56 @@ class _FnScan:
         self.calls: List[Tuple[Tuple[str, ...], FrozenSet[str], int]] = []
 
 
+def resolve_lock_node(
+    expr: ast.AST,
+    info: Optional[_ClassInfo],
+    mod_locks: _ModuleLocks,
+    by_bare_name: Optional[Dict[str, List[_ClassInfo]]] = None,
+) -> Optional[str]:
+    """Canonical lock-node name for a ``with``-site expression, or None
+    when the expression does not resolve to a discovered lock. Shared by
+    the lockorder, blocking, and threads checkers so they all agree on
+    what counts as "holding a named lock"."""
+    if isinstance(expr, ast.Name) and expr.id in mod_locks.names:
+        return f"{mod_locks.module.modname}.{expr.id}"
+    if isinstance(expr, ast.Call):
+        attr = _attr_base_chain(expr)
+        if info is not None and attr in info.accessor_alias:
+            return f"{info.qual}.{info.accessor_alias[attr]}"
+        return None
+    node = expr
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if not isinstance(node, ast.Attribute):
+        return None
+    base = node.value
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    if isinstance(base, ast.Name):
+        # self._lock / cls._lock / ClassName._lock (class-level lock)
+        if base.id in ("self", "cls") or (
+            info is not None and base.id == info.cls.name
+        ):
+            return info.node_for_attr(node.attr) if info is not None else None
+        return None
+    # self.<obj>.<lockattr>: one level of attribute-type inference —
+    # NOT this class's lock (misattributing it would fabricate
+    # self-edges and hide real cross-object orderings)
+    if (
+        info is not None
+        and by_bare_name is not None
+        and isinstance(base, ast.Attribute)
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "self"
+    ):
+        tname = info.attr_types.get(base.attr)
+        if tname is not None:
+            cands = by_bare_name.get(tname, [])
+            if len(cands) == 1:
+                return cands[0].node_for_attr(node.attr)
+    return None
+
+
 def _scan_function(
     fn: ast.AST,
     info: Optional[_ClassInfo],
@@ -271,44 +321,7 @@ def _scan_function(
     by_bare_name: Optional[Dict[str, List[_ClassInfo]]] = None,
 ) -> None:
     def lock_node(expr: ast.AST) -> Optional[str]:
-        if isinstance(expr, ast.Name) and expr.id in mod_locks.names:
-            return f"{mod_locks.module.modname}.{expr.id}"
-        if isinstance(expr, ast.Call):
-            attr = _attr_base_chain(expr)
-            if info is not None and attr in info.accessor_alias:
-                return f"{info.qual}.{info.accessor_alias[attr]}"
-            return None
-        node = expr
-        while isinstance(node, ast.Subscript):
-            node = node.value
-        if not isinstance(node, ast.Attribute):
-            return None
-        base = node.value
-        while isinstance(base, ast.Subscript):
-            base = base.value
-        if isinstance(base, ast.Name):
-            # self._lock / cls._lock / ClassName._lock (class-level lock)
-            if base.id in ("self", "cls") or (
-                info is not None and base.id == info.cls.name
-            ):
-                return info.node_for_attr(node.attr) if info is not None else None
-            return None
-        # self.<obj>.<lockattr>: one level of attribute-type inference —
-        # NOT this class's lock (misattributing it would fabricate
-        # self-edges and hide real cross-object orderings)
-        if (
-            info is not None
-            and by_bare_name is not None
-            and isinstance(base, ast.Attribute)
-            and isinstance(base.value, ast.Name)
-            and base.value.id == "self"
-        ):
-            tname = info.attr_types.get(base.attr)
-            if tname is not None:
-                cands = by_bare_name.get(tname, [])
-                if len(cands) == 1:
-                    return cands[0].node_for_attr(node.attr)
-        return None
+        return resolve_lock_node(expr, info, mod_locks, by_bare_name)
 
     def visit(node: ast.AST, held: FrozenSet[str]) -> None:
         if isinstance(node, (ast.With, ast.AsyncWith)):
@@ -371,8 +384,13 @@ def _load_allowlist(path: Optional[str]) -> Set[Tuple[str, str]]:
 
 
 def check(
-    modules: Sequence[Module], allowlist_path: Optional[str] = None
+    modules: Sequence[Module],
+    allowlist_path: Optional[str] = None,
+    stale_out: Optional[List[Tuple[str, str]]] = None,
 ) -> List[Finding]:
+    """``stale_out`` (when given) receives allowlist edges that no longer
+    match any acquired-while-holding edge — dead waivers the CLI turns
+    into errors (prunable with ``--prune-stale``)."""
     classes: Dict[str, _ClassInfo] = {}
     by_bare_name: Dict[str, List[_ClassInfo]] = {}
     mod_locks: Dict[str, _ModuleLocks] = {}
@@ -466,6 +484,8 @@ def check(
                     graph.add(h, inner, (relpath, line, ctx + " -> " + callee[1]))
 
     allow = _load_allowlist(allowlist_path)
+    if stale_out is not None:
+        stale_out.extend(sorted(a for a in allow if a not in graph.edges))
     edges = {e: w for e, w in graph.edges.items() if e not in allow}
 
     findings: List[Finding] = []

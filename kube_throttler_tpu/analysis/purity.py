@@ -1,6 +1,7 @@
 """Checker 3: JAX trace purity.
 
-Entry points are the traced bodies in ``ops/`` and ``parallel/``:
+Entry points are the traced bodies in ``ops/``, ``parallel/``, and
+``sharding/``:
 
 - functions decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``;
 - local functions handed to ``shard_map(...)`` (first positional arg);
@@ -306,10 +307,13 @@ def _traced_branch_findings(
 
 
 def check(modules: Sequence[Module]) -> List[Finding]:
+    # sharding/ carries no jit entries today, but its workers own full
+    # device planes — a kernel landing there must be scanned, not missed
+    # by a stale scope list (the PR 10 purity-gap audit)
     scoped = [
         m
         for m in modules
-        if m.relpath.replace("\\", "/").startswith(("ops/", "parallel/"))
+        if m.relpath.replace("\\", "/").startswith(("ops/", "parallel/", "sharding/"))
     ] or list(modules)
     index = _FnIndex(scoped)
     entries = _entry_points(scoped)

@@ -233,18 +233,24 @@ class SharedIndexInformer:
 
         def loop() -> None:
             while not stop.wait(self._resync_period):
-                for key in self.indexer.keys():
-                    with self._dispatch_lock:
-                        # re-read under the dispatch lock: if the object was
-                        # deleted since the snapshot, skip — a sync event
-                        # must never resurrect a deleted object downstream
-                        obj = self.indexer.get(key)
-                        if obj is None:
-                            continue
-                        with self._lock:
-                            handlers = list(self._handlers)
-                        for h in handlers:
-                            h(Event(EventType.MODIFIED, self.kind, obj, old_obj=obj))
+                # loop-level routing (threads checker): a handler raising
+                # must not silently kill periodic resync for good
+                try:
+                    for key in self.indexer.keys():
+                        with self._dispatch_lock:
+                            # re-read under the dispatch lock: if the object
+                            # was deleted since the snapshot, skip — a sync
+                            # event must never resurrect a deleted object
+                            # downstream
+                            obj = self.indexer.get(key)
+                            if obj is None:
+                                continue
+                            with self._lock:
+                                handlers = list(self._handlers)
+                            for h in handlers:
+                                h(Event(EventType.MODIFIED, self.kind, obj, old_obj=obj))
+                except Exception:  # noqa: BLE001 — keep resyncing
+                    logger.exception("%s resync sweep failed", self.kind)
 
         self._resync_thread = threading.Thread(
             target=loop, name=f"resync-{self.kind}", daemon=True

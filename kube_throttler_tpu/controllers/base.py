@@ -370,7 +370,18 @@ class ControllerBase:
                 key, was_hi = self.workqueue.get_lane()
             except ShutDown:
                 return
-            self._process_batch(self._drain_more(key, first_hi=was_hi))
+            # loop-level routing (threads checker): per-key reconcile
+            # errors are requeued inside _process_batch; this backstop is
+            # for the UNEXPECTED — a worker dying here would silently
+            # stop reconciliation for its share of the queue while every
+            # probe stayed green (the PR 6 silent-death class)
+            try:
+                self._process_batch(self._drain_more(key, first_hi=was_hi))
+            except Exception:  # noqa: BLE001 — keep the worker alive
+                logger.exception(
+                    "%s worker: unexpected reconcile-batch failure (key=%s)",
+                    self.name, key,
+                )
 
     def run_pending_once(self, max_items: int = 10000) -> int:
         """Synchronously drain currently-ready queue items on the calling

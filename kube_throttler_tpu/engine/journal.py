@@ -582,6 +582,23 @@ class StoreJournal:
                     new_sha.update(data)
                     new_bytes += len(data)
                     lines += 1
+                for group, entry in sorted(self.gang_ops.items()):
+                    # compaction must not erase an in-flight gang reserve
+                    # either (protocol checker: control types survive the
+                    # re-emit): a begin-without-commit tail is how recovery
+                    # learns a mid-reserve crash needs rolling back —
+                    # committed/rolled-back groups carry no future meaning
+                    # and are dropped with the rest of the history
+                    if entry.get("op") != "begin":
+                        continue
+                    record = {"type": "GANG", "op": "begin", "group": group}
+                    if "members" in entry:
+                        record["members"] = list(entry["members"])
+                    data = (json.dumps(record) + "\n").encode("utf-8")
+                    f.write(data.decode("utf-8"))
+                    new_sha.update(data)
+                    new_bytes += len(data)
+                    lines += 1
                 for kind, obj in objs:
                     data = (
                         json.dumps(
@@ -620,6 +637,13 @@ class StoreJournal:
         """Force a compaction now (operational hook + the chaos soak's
         heal-the-log step): the journal becomes a clean snapshot of the
         live store, erasing any torn/corrupt interior lines."""
+        if self.fencing is not None and self.fencing.is_stale():
+            # a fenced (stale) leader rewriting its journal is still a
+            # durable write after leadership loss — refuse, like every
+            # other guarded write path (protocol checker)
+            self.stale_epoch_rejected += 1
+            logger.warning("journal %s: compaction refused (fenced)", self.path)
+            return
         # store lock FIRST — the same order as the dispatch path
         # (store._dispatch_locked -> _on_event -> journal lock). Taking
         # only the journal lock here and letting _compact_locked's
@@ -688,6 +712,12 @@ class StoreJournal:
                 return  # terms only move forward; duplicates add no info
             self.last_epoch = epoch
             if self._file is None:
+                return
+            if self.fencing is not None and self.fencing.is_stale():
+                # a fenced journal must not extend the log with ANY line,
+                # control lines included (protocol checker: every durable
+                # write dominated by a fencing check)
+                self.stale_epoch_rejected += 1
                 return
             data = (json.dumps({"type": "EPOCH", "epoch": epoch}) + "\n").encode(
                 "utf-8"

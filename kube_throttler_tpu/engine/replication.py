@@ -628,6 +628,7 @@ class StandbyReplicator:
     def _apply_lines(self, data: bytes) -> int:
         ops: List[Tuple[str, str, object]] = []
         epochs: List[int] = []
+        gangs: List[Tuple[str, str, Optional[list]]] = []
         for raw in data.split(b"\n"):
             line = raw.strip()
             if not line:
@@ -636,6 +637,20 @@ class StandbyReplicator:
                 event = json.loads(line.decode("utf-8"))
                 if event.get("type") == "EPOCH":
                     epochs.append(int(event.get("epoch", 0)))
+                    continue
+                if event.get("type") == "GANG":
+                    # gang control line (protocol checker): forward into
+                    # OUR journal so a promoted standby still knows which
+                    # groups have a begin-without-commit tail to roll back
+                    # — dropping it here counted the line as corruption
+                    # and silently lost the mid-reserve crash marker
+                    gangs.append(
+                        (
+                            str(event.get("op", "")),
+                            str(event.get("group", "")),
+                            event.get("members"),
+                        )
+                    )
                     continue
                 kind = event["kind"]
                 obj = object_from_dict({**event["object"], "kind": kind})
@@ -659,6 +674,9 @@ class StandbyReplicator:
             if self.epoch is not None:
                 self.epoch.observe(e)
             self.journal.set_epoch(e)
+        for op, group, members in gangs:
+            if group:
+                self.journal.append_gang(op, group, members)
         return len(ops)
 
     # -- lifecycle -----------------------------------------------------------
@@ -695,6 +713,13 @@ class StandbyReplicator:
                 # polling — the lease decides when WE take over, not the
                 # socket
                 pass
+            except Exception:  # noqa: BLE001 — route the death, keep polling
+                # the PR 6 silent-replicator-death class: an unexpected
+                # exception (malformed header, apply bug) must not kill
+                # the thread while health keeps reporting a live standby —
+                # count it where health_state/probes can see it and retry
+                self.apply_errors += 1
+                logger.exception("standby replicator poll failed; retrying")
             self._stop.wait(self.poll_interval)
 
     def stop(self) -> None:

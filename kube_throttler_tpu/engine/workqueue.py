@@ -46,6 +46,7 @@ subscribe hook, keeping FakeClock tests deterministic.
 from __future__ import annotations
 
 import heapq
+import logging
 import threading
 import time
 from datetime import timedelta
@@ -53,6 +54,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..utils.clock import Clock, RealClock
 from ..utils.lockorder import assert_held, guard_attrs, make_rlock
+
+logger = logging.getLogger(__name__)
 
 _BASE_DELAY = 0.005  # 5ms
 _MAX_DELAY = 1000.0  # 1000s
@@ -322,16 +325,22 @@ class RateLimitingQueue:
         notifies when a new item becomes the earliest."""
         with self._waker_cond:
             while True:
-                if self._shutdown:
-                    return
-                now = self._now_ts()
-                while self._delayed and self._delayed[0][0] <= now:
-                    _, _, item = heapq.heappop(self._delayed)
-                    if item not in self._dirty:
-                        self._dirty.add(item)
-                        self._enqueue_ts.setdefault(item, time.monotonic())
-                        if item not in self._processing:
-                            self._queue.append(item)
-                            self._cond.notify()
-                timeout = self._delayed[0][0] - now if self._delayed else None
-                self._waker_cond.wait(timeout=timeout)
+                # loop-level routing (threads checker): a dead waker means
+                # delayed retries are never delivered again — silently
+                try:
+                    if self._shutdown:
+                        return
+                    now = self._now_ts()
+                    while self._delayed and self._delayed[0][0] <= now:
+                        _, _, item = heapq.heappop(self._delayed)
+                        if item not in self._dirty:
+                            self._dirty.add(item)
+                            self._enqueue_ts.setdefault(item, time.monotonic())
+                            if item not in self._processing:
+                                self._queue.append(item)
+                                self._cond.notify()
+                    timeout = self._delayed[0][0] - now if self._delayed else None
+                    self._waker_cond.wait(timeout=timeout)
+                except Exception:  # noqa: BLE001 — keep the waker alive
+                    logger.exception("delay-queue waker error")
+                    self._waker_cond.wait(timeout=0.1)

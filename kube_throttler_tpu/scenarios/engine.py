@@ -299,6 +299,19 @@ class _Replayer:
 
 
 class _Engine:
+    # analyzer annotations (PR 10): the crossing-anchored flip bookkeeping
+    # is shared between the replayer thread and the remote store's status
+    # handler — both sides take pend_lock (measure.lag_tracker hands the
+    # dicts and their lock out together). The assignment itself happens in
+    # build(), single-threaded construction before any replay thread
+    # exists (waived in baseline.txt). restart/resync/caughtup lists are
+    # single-writer-per-index: note_restart appends (replayer thread),
+    # each poll thread writes only its own index, readers join first.
+    GUARDED_BY = {
+        "pending": "self.pend_lock",
+        "flip_pending": "self.pend_lock",
+    }
+
     def __init__(self, scn: Scenario, seed: int, workdir: str,
                  regression: Optional[str] = None, registry=None):
         self.scn = scn
@@ -363,7 +376,12 @@ class _Engine:
                     return
                 time.sleep(0.05)
 
-        threading.Thread(target=poll, daemon=True, name=f"resync-poll-{idx}").start()
+        # a dead poller leaves resync_times[idx] None, which the recovery
+        # gate reports as an unrecovered restart — the death is observable
+        # by construction, so no extra routing is needed
+        threading.Thread(  #: thread: fire-and-forget
+            target=poll, daemon=True, name=f"resync-poll-{idx}"
+        ).start()
 
     # -- stack construction -------------------------------------------------
 

@@ -549,23 +549,29 @@ class Scheduler:
         def loop() -> None:
             last_flush = time.monotonic()
             while not self._stop_event.is_set():
-                key = self.schedule_one()
-                now = time.monotonic()
-                if now - last_flush >= flush_interval:
-                    last_flush = now
-                    self._wake_unschedulable()
-                    continue
-                if key is None:
-                    with self._cv:
-                        if self._stop_event.is_set():
-                            return
-                        gates = [q.not_before for q in self._active]
-                        if any(g <= now for g in gates):
-                            continue  # work arrived between cycle and here
-                        next_gate = min((g for g in gates if g > now), default=None)
-                        deadline = last_flush + flush_interval
-                        wake_at = deadline if next_gate is None else min(next_gate, deadline)
-                        self._cv.wait(timeout=max(wake_at - now, 0.0))
+                # loop-level routing (threads checker): a scheduling bug
+                # must not silently stop the scheduler loop for good
+                try:
+                    key = self.schedule_one()
+                    now = time.monotonic()
+                    if now - last_flush >= flush_interval:
+                        last_flush = now
+                        self._wake_unschedulable()
+                        continue
+                    if key is None:
+                        with self._cv:
+                            if self._stop_event.is_set():
+                                return
+                            gates = [q.not_before for q in self._active]
+                            if any(g <= now for g in gates):
+                                continue  # work arrived between cycle and here
+                            next_gate = min((g for g in gates if g > now), default=None)
+                            deadline = last_flush + flush_interval
+                            wake_at = deadline if next_gate is None else min(next_gate, deadline)
+                            self._cv.wait(timeout=max(wake_at - now, 0.0))
+                except Exception:  # noqa: BLE001 — keep scheduling
+                    logger.exception("scheduler loop error")
+                    self._stop_event.wait(0.1)
 
         self._thread = threading.Thread(target=loop, name="scheduler", daemon=True)
         self._thread.start()

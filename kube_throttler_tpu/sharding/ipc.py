@@ -173,35 +173,44 @@ class ShardClient:
             return len(self._queue)
 
     def _send_loop(self) -> None:
-        while True:
-            with self._qcond:
-                while not self._queue and not self._closed:
-                    self._qcond.wait(0.2)
-                if self._closed and not self._queue:
-                    return
-                batch = [
-                    self._queue.popleft()
-                    for _ in range(min(len(self._queue), self.EVT_BATCH))
-                ]
-            try:
-                if self.faults is not None:
-                    fault = self.faults.check("shard.ipc.send")
-                    if fault is not None:
-                        raise OSError(
-                            f"injected IPC send failure (hit {fault.hit})"
-                        )
-                send_frame(self.sock, self._send_lock, "evt", 0, batch)
-                self.events_sent += len(batch)
-                self.frames_sent += 1
-            except OSError:
-                # shard gone mid-send: these events are lost to it — the
-                # supervisor's restart+resync repairs the gap
+        # top-level routing (threads checker): ANY death of the sender —
+        # transport failure or a bug — must surface as a down shard (the
+        # supervisor restarts + resyncs), never as a silently growing
+        # queue behind a dead thread
+        try:
+            while True:
                 with self._qcond:
-                    self.dropped += len(batch)
-                    self.dirty = True
-                if not self._closed:
-                    self._mark_down()
-                return
+                    while not self._queue and not self._closed:
+                        self._qcond.wait(0.2)
+                    if self._closed and not self._queue:
+                        return
+                    batch = [
+                        self._queue.popleft()
+                        for _ in range(min(len(self._queue), self.EVT_BATCH))
+                    ]
+                try:
+                    if self.faults is not None:
+                        fault = self.faults.check("shard.ipc.send")
+                        if fault is not None:
+                            raise OSError(
+                                f"injected IPC send failure (hit {fault.hit})"
+                            )
+                    send_frame(self.sock, self._send_lock, "evt", 0, batch)
+                    self.events_sent += len(batch)
+                    self.frames_sent += 1
+                except OSError:
+                    # shard gone mid-send: these events are lost to it — the
+                    # supervisor's restart+resync repairs the gap
+                    with self._qcond:
+                        self.dropped += len(batch)
+                        self.dirty = True
+                    if not self._closed:
+                        self._mark_down()
+                    return
+        except Exception:  # noqa: BLE001 — route the death, don't hide it
+            logger.exception("shard %d: sender died", self.shard_id)
+            if not self._closed:
+                self._mark_down()
 
     # ---------------------------------------------------------------- RPC
 

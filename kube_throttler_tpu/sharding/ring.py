@@ -81,7 +81,14 @@ def route_key_for(kind: str, obj) -> str:
 
 
 class HashRing:
-    """Immutable consistent-hash ring: ``shard_of(route_key) -> shard id``."""
+    """Immutable consistent-hash ring: ``shard_of(route_key) -> shard id``.
+
+    Analyzer note (PR 10): every field is written once in ``__init__``
+    and only read afterwards — immutability IS the concurrency
+    discipline here, so there is deliberately no ``GUARDED_BY`` table
+    and no lock. Do not add mutating methods; rebuild a new ring for a
+    new shard count (shard-count rebalancing is restart + resync by
+    design, see ROADMAP item 1)."""
 
     def __init__(self, n_shards: int, vnodes: int = 128):
         if n_shards < 1:

@@ -20,14 +20,24 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..utils.lockorder import guard_attrs, make_lock
 from .front import AdmissionFront
 from .ipc import ShardClient
 
 logger = logging.getLogger(__name__)
 
 
+@guard_attrs
 class ShardSupervisor:
     """Spawns and babysits ``n_shards`` worker processes for a front."""
+
+    # the proc/restart tables are shared between the spawning thread
+    # (start), the monitor thread, and stop() — snapshot under the lock,
+    # operate on locals (never hold it across a spawn or a sleep)
+    GUARDED_BY = {
+        "procs": "self._proc_lock",
+        "restarts": "self._proc_lock",
+    }
 
     def __init__(
         self,
@@ -53,6 +63,7 @@ class ShardSupervisor:
         self.max_restarts = max_restarts
         self.worker_args = list(worker_args or [])
         self.env = env
+        self._proc_lock = make_lock("shard.supervisor.procs")
         self.procs: Dict[int, subprocess.Popen] = {}
         self.restarts: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
         self._stop = threading.Event()
@@ -62,46 +73,55 @@ class ShardSupervisor:
 
     def _spawn(self, shard_id: int) -> subprocess.Popen:
         parent_sock, child_sock = socket.socketpair()
-        argv = [
-            sys.executable, "-m", "kube_throttler_tpu.sharding.worker",
-            "--shard-id", str(shard_id),
-            "--shards", str(self.n_shards),
-            "--ipc-fd", str(child_sock.fileno()),
-            "--name", self.name,
-            "--target-scheduler-name", self.target_scheduler,
-            "--ingest-batch", str(self.ingest_batch),
-        ]
-        if not self.use_device:
-            argv.append("--no-device")
-        if self.data_dir:
-            argv += ["--data-dir", os.path.join(self.data_dir, f"shard-{shard_id}")]
-        argv += self.worker_args
-        env = dict(os.environ if self.env is None else self.env)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        proc = subprocess.Popen(
-            argv,
-            pass_fds=[child_sock.fileno()],
-            env=env,
-            stdout=subprocess.DEVNULL if env.get("KT_SHARD_QUIET") else None,
-            stderr=None,
-        )
-        child_sock.close()
-        client = ShardClient(
-            shard_id,
-            parent_sock,
-            on_push=self.front.apply_status_push,
-            on_down=self._on_shard_down,
-            faults=self.front.faults,
-        )
-        self.procs[shard_id] = proc
+        try:
+            argv = [
+                sys.executable, "-m", "kube_throttler_tpu.sharding.worker",
+                "--shard-id", str(shard_id),
+                "--shards", str(self.n_shards),
+                "--ipc-fd", str(child_sock.fileno()),
+                "--name", self.name,
+                "--target-scheduler-name", self.target_scheduler,
+                "--ingest-batch", str(self.ingest_batch),
+            ]
+            if not self.use_device:
+                argv.append("--no-device")
+            if self.data_dir:
+                argv += ["--data-dir", os.path.join(self.data_dir, f"shard-{shard_id}")]
+            argv += self.worker_args
+            env = dict(os.environ if self.env is None else self.env)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            proc = subprocess.Popen(
+                argv,
+                pass_fds=[child_sock.fileno()],
+                env=env,
+                stdout=subprocess.DEVNULL if env.get("KT_SHARD_QUIET") else None,
+                stderr=None,
+            )
+            child_sock.close()
+            client = ShardClient(
+                shard_id,
+                parent_sock,
+                on_push=self.front.apply_status_push,
+                on_down=self._on_shard_down,
+                faults=self.front.faults,
+            )
+        except BaseException:
+            # a failed exec (or client construction) must not leak the
+            # socketpair: each monitor-driven respawn retry would strand
+            # two fds, and fd exhaustion then takes down the FRONT — the
+            # exact lease-elector leak class from the PR 6 review
+            parent_sock.close()
+            child_sock.close()
+            raise
+        with self._proc_lock:
+            self.procs[shard_id] = proc
         self.front.attach_shard(shard_id, client)
         return proc
 
     def start(self, ready_timeout: float = 120.0) -> None:
         """Spawn every worker and block until each answers a ping (the
         workers compile/prewarm serially on small hosts — be patient)."""
-        for sid in range(self.n_shards):
-            self._spawn(sid)
+        spawned = [self._spawn(sid) for sid in range(self.n_shards)]
         deadline = time.monotonic() + ready_timeout
         for sid in range(self.n_shards):
             while True:
@@ -113,9 +133,9 @@ class ShardSupervisor:
                         raise RuntimeError(
                             f"shard {sid} did not become ready in {ready_timeout}s"
                         ) from None
-                    if self.procs[sid].poll() is not None:
+                    if spawned[sid].poll() is not None:
                         raise RuntimeError(
-                            f"shard {sid} exited rc={self.procs[sid].returncode} "
+                            f"shard {sid} exited rc={spawned[sid].returncode} "
                             "during startup"
                         ) from None
                     time.sleep(0.1)
@@ -131,47 +151,62 @@ class ShardSupervisor:
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(0.2):
-            for sid in range(self.n_shards):
+            # loop-level routing (threads checker): the monitor IS the
+            # restart policy — if it died of an unexpected exception, dead
+            # shards would stay dead forever while the front reports
+            # degraded and nothing ever repairs it
+            try:
+                self._monitor_tick()
+            except Exception:  # noqa: BLE001 — keep the restart policy alive
+                logger.exception("shard monitor tick failed")
+
+    def _monitor_tick(self) -> None:
+        for sid in range(self.n_shards):
+            with self._proc_lock:
                 proc = self.procs.get(sid)
-                if proc is None or proc.poll() is None:
-                    continue
-                if self._stop.is_set():
-                    return
+            if proc is None or proc.poll() is None:
+                continue
+            if self._stop.is_set():
+                return
+            with self._proc_lock:
                 self.restarts[sid] += 1
-                if self.restarts[sid] > self.max_restarts:
-                    logger.error(
-                        "shard %d died rc=%s; restart budget exhausted",
-                        sid, proc.returncode,
-                    )
-                    self.procs[sid] = None
-                    continue
-                logger.warning(
-                    "shard %d died rc=%s; restarting (%d/%d)",
-                    sid, proc.returncode, self.restarts[sid], self.max_restarts,
+                budget_spent = self.restarts[sid] > self.max_restarts
+                attempt = self.restarts[sid]
+            if budget_spent:
+                logger.error(
+                    "shard %d died rc=%s; restart budget exhausted",
+                    sid, proc.returncode,
                 )
-                old = self.front.shards.get(sid)
-                if old is not None:
-                    old.close()
-                time.sleep(self.restart_backoff)
-                try:
-                    self._spawn(sid)
-                    # wait for readiness, then replay its keyspace slice
-                    deadline = time.monotonic() + 120.0
-                    while True:
-                        try:
-                            self.front.shards[sid].request("ping", None, timeout=5.0)
-                            break
-                        except Exception:  # noqa: BLE001
-                            if (
-                                time.monotonic() > deadline
-                                or self._stop.is_set()
-                                or self.procs[sid].poll() is not None
-                            ):
-                                raise
-                            time.sleep(0.1)
-                    self.front.resync_shard(sid)
-                except Exception:  # noqa: BLE001 — retried on the next tick
-                    logger.exception("shard %d restart failed", sid)
+                with self._proc_lock:
+                    self.procs[sid] = None
+                continue
+            logger.warning(
+                "shard %d died rc=%s; restarting (%d/%d)",
+                sid, proc.returncode, attempt, self.max_restarts,
+            )
+            old = self.front.shards.get(sid)
+            if old is not None:
+                old.close()
+            time.sleep(self.restart_backoff)
+            try:
+                fresh = self._spawn(sid)
+                # wait for readiness, then replay its keyspace slice
+                deadline = time.monotonic() + 120.0
+                while True:
+                    try:
+                        self.front.shards[sid].request("ping", None, timeout=5.0)
+                        break
+                    except Exception:  # noqa: BLE001
+                        if (
+                            time.monotonic() > deadline
+                            or self._stop.is_set()
+                            or fresh.poll() is not None
+                        ):
+                            raise
+                        time.sleep(0.1)
+                self.front.resync_shard(sid)
+            except Exception:  # noqa: BLE001 — retried on the next tick
+                logger.exception("shard %d restart failed", sid)
 
     # -------------------------------------------------------------- shutdown
 
@@ -185,14 +220,12 @@ class ShardSupervisor:
             except Exception:  # noqa: BLE001
                 pass
         deadline = time.monotonic() + timeout
-        for proc in self.procs.values():
-            if proc is None:
-                continue
+        with self._proc_lock:
+            procs = [p for p in self.procs.values() if p is not None]
+        for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
-        for proc in self.procs.values():
-            if proc is None:
-                continue
+        for proc in procs:
             try:
                 proc.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:

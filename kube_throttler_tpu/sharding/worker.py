@@ -187,16 +187,24 @@ class ShardCore:
 
     def _push_loop(self) -> None:
         while not self._stop.is_set():
-            with self._push_cond:
-                while not self._push_buf and not self._stop.is_set():
-                    self._push_cond.wait(0.2)
-                buf, self._push_buf = self._push_buf, []
-            if buf and self.push is not None:
-                try:
-                    self.push(buf)
-                except Exception:  # noqa: BLE001 — front gone; supervisor acts
-                    logger.warning("shard %d: status push failed", self.shard_id,
-                                   exc_info=True)
+            # loop-level routing (threads checker): a pusher killed by an
+            # unexpected exception would silently stop ALL status flow to
+            # the front while every probe stayed green — the PR 6 silent-
+            # replicator-death class, shard-flavored
+            try:
+                with self._push_cond:
+                    while not self._push_buf and not self._stop.is_set():
+                        self._push_cond.wait(0.2)
+                    buf, self._push_buf = self._push_buf, []
+                if buf and self.push is not None:
+                    try:
+                        self.push(buf)
+                    except Exception:  # noqa: BLE001 — front gone; supervisor acts
+                        logger.warning("shard %d: status push failed", self.shard_id,
+                                       exc_info=True)
+            except Exception:  # noqa: BLE001 — keep the pusher alive
+                logger.exception("shard %d: push loop error", self.shard_id)
+                self._stop.wait(0.05)
 
     # ---------------------------------------------------------------- events
 
@@ -400,7 +408,12 @@ class ShardCore:
 
     def _reap_loop(self) -> None:
         while not self._stop.wait(min(1.0, self.prepare_ttl / 4 or 1.0)):
-            self.reap_stale_txns()
+            # loop-level routing (threads checker): a dead reaper means
+            # orphaned prepares hold reservations forever — silently
+            try:
+                self.reap_stale_txns()
+            except Exception:  # noqa: BLE001 — keep the reaper alive
+                logger.exception("shard %d: txn reaper error", self.shard_id)
 
     def reap_stale_txns(self, now: Optional[float] = None) -> int:
         """Abort prepared transactions older than ``prepare_ttl`` (the
@@ -472,6 +485,7 @@ def serve(core: ShardCore, sock: socket.socket) -> None:
         return
     finally:
         pool.shutdown(wait=False)
+        rfile.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -531,6 +545,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         serve(core, sock)
     finally:
         core.stop()
+        sock.close()
     return 0
 
 

@@ -102,18 +102,24 @@ class GcHygieneThread(threading.Thread):
 
     def run(self) -> None:
         while not self._stop_requested.wait(self.interval_s):
-            t0 = time.perf_counter()
-            unreachable = gc.collect()
-            gc.freeze()
-            pause = time.perf_counter() - t0
-            self.last_pause_s = pause
-            self.ticks += 1
-            if self.tracer is not None:
-                self.tracer.observe("gc_full_collect", pause)
-            logger.info(
-                "gc hygiene: full collect freed %d cyclic objects in %.0fms "
-                "(%d now frozen)", unreachable, pause * 1e3, gc.get_freeze_count(),
-            )
+            # loop-level routing (threads checker): the backstop must not
+            # die of a tracer/logging hiccup — a silently dead hygiene
+            # thread re-grows the gen2 heap for the process lifetime
+            try:
+                t0 = time.perf_counter()
+                unreachable = gc.collect()
+                gc.freeze()
+                pause = time.perf_counter() - t0
+                self.last_pause_s = pause
+                self.ticks += 1
+                if self.tracer is not None:
+                    self.tracer.observe("gc_full_collect", pause)
+                logger.info(
+                    "gc hygiene: full collect freed %d cyclic objects in %.0fms "
+                    "(%d now frozen)", unreachable, pause * 1e3, gc.get_freeze_count(),
+                )
+            except Exception:  # noqa: BLE001 — keep the backstop alive
+                logger.exception("gc hygiene tick failed")
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop_requested.set()

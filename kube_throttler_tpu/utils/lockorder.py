@@ -15,6 +15,13 @@ created through :func:`make_lock`/:func:`make_rlock` is wrapped so that:
   silently deadlocking;
 - :func:`assert_held` lets ``*_locked`` helpers enforce their "caller
   holds the lock" contract;
+- per-lock HOLD-TIME budgets (:func:`set_hold_budget`, fnmatch patterns
+  over lock names, or a global default via ``KT_LOCK_HOLD_BUDGET``
+  seconds): a release after holding longer than the budget raises
+  :class:`LockHoldBudgetExceeded` — the runtime twin of the static
+  ``blocking`` checker, keeping ``blocking_allow.txt`` honest: a waived
+  "intended hold" that silently grows past its budget fails the suite
+  instead of surfacing as a flip-p99 regression two PRs later;
 - :func:`guard_attrs` (a class decorator) turns a class's ``GUARDED_BY``
   table — the same one the static analyzer reads — into a ``__setattr__``
   check: rebinding a guarded attribute after ``__init__`` without holding
@@ -29,15 +36,18 @@ to be *observed* once.
 
 from __future__ import annotations
 
+import fnmatch
 import functools
 import os
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = [
     "LockOrderViolation",
     "LockAssertionError",
+    "LockHoldBudgetExceeded",
     "enabled",
     "make_lock",
     "make_rlock",
@@ -46,6 +56,8 @@ __all__ = [
     "held_by_me",
     "guard_attrs",
     "reset_graph",
+    "set_hold_budget",
+    "clear_hold_budgets",
 ]
 
 
@@ -55,6 +67,10 @@ class LockOrderViolation(RuntimeError):
 
 class LockAssertionError(RuntimeError):
     """A lock-holding contract was violated (lock not held / wrong owner)."""
+
+
+class LockHoldBudgetExceeded(LockAssertionError):
+    """A lock was held longer than its configured hold-time budget."""
 
 
 def enabled() -> bool:
@@ -77,6 +93,53 @@ def reset_graph() -> None:
     with _graph_lock:
         _edges.clear()
         _edge_sites.clear()
+
+
+# ------------------------------------------------------- hold-time budgets
+
+# (fnmatch pattern over lock names, seconds); first match wins. Seeded
+# from KT_LOCK_HOLD_BUDGET (a global default budget in seconds) when set.
+_hold_budgets: List[Tuple[str, float]] = []
+_budget_epoch = 0  # bumped on every change so per-lock caches invalidate
+
+
+def _env_default_budget() -> Optional[float]:
+    raw = os.environ.get("KT_LOCK_HOLD_BUDGET", "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def set_hold_budget(pattern: str, seconds: float) -> None:
+    """Arm a hold-time budget for every lock whose name fnmatches
+    ``pattern`` (``"journal"``, ``"shard.*"``, ``"*"``). Releasing a lock
+    after holding it longer than its budget raises
+    :class:`LockHoldBudgetExceeded` — AFTER the release, so the failure
+    cannot wedge other threads. First matching pattern wins; re-arming a
+    pattern replaces its budget. Test-tier only (inert when
+    instrumentation is off)."""
+    global _budget_epoch
+    with _graph_lock:
+        _hold_budgets[:] = [(p, s) for p, s in _hold_budgets if p != pattern]
+        _hold_budgets.append((str(pattern), float(seconds)))
+        _budget_epoch += 1
+
+
+def clear_hold_budgets() -> None:
+    global _budget_epoch
+    with _graph_lock:
+        _hold_budgets.clear()
+        _budget_epoch += 1
+
+
+def _budget_for(name: str) -> Optional[float]:
+    for pattern, seconds in _hold_budgets:
+        if fnmatch.fnmatch(name, pattern):
+            return seconds
+    return _env_default_budget()
 
 
 def _held() -> List["_InstrumentedLock"]:
@@ -150,7 +213,7 @@ class _InstrumentedLock:
     ``_release_save``/``_acquire_restore`` protocol can keep the held
     bookkeeping exact across ``wait()``."""
 
-    __slots__ = ("name", "reentrant", "_inner", "_owner", "_count")
+    __slots__ = ("name", "reentrant", "_inner", "_owner", "_count", "_t0")
 
     def __init__(self, name: str, reentrant: bool):
         self.name = name
@@ -158,6 +221,7 @@ class _InstrumentedLock:
         self._inner = threading.Lock()
         self._owner: Optional[int] = None
         self._count = 0
+        self._t0 = 0.0  # monotonic instant the current hold began
 
     # -- core protocol ----------------------------------------------------
 
@@ -176,6 +240,7 @@ class _InstrumentedLock:
         if ok:
             self._owner = me
             self._count = 1
+            self._t0 = time.monotonic()
             _held().append(self)
         return ok
 
@@ -188,11 +253,20 @@ class _InstrumentedLock:
             )
         self._count -= 1
         if self._count == 0:
+            held_for = time.monotonic() - self._t0
             self._owner = None
             h = _held()
             if self in h:
                 h.remove(self)
             self._inner.release()
+            # budget check AFTER the release: the raise must report the
+            # over-hold, never extend it (or wedge the other threads)
+            budget = _budget_for(self.name)
+            if budget is not None and held_for > budget:
+                raise LockHoldBudgetExceeded(
+                    f"lock '{self.name}' held {held_for * 1e3:.1f}ms, over "
+                    f"its {budget * 1e3:.1f}ms hold budget\n{_site()}"
+                )
 
     def __enter__(self) -> "_InstrumentedLock":
         self.acquire()
@@ -229,6 +303,9 @@ class _InstrumentedLock:
         self._inner.acquire()
         self._owner = threading.get_ident()
         self._count = saved
+        # the wait()ed stretch does not count against the hold budget —
+        # a fresh hold starts when the condition hands the lock back
+        self._t0 = time.monotonic()
         _held().append(self)
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid

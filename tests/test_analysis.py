@@ -518,3 +518,657 @@ class TestCli:
             timeout=300,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ----------------------------------------------------------------- blocking
+
+
+class TestBlocking:
+    def test_direct_blocking_under_lock_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+                import time
+
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def bad(self):
+                        with self._lock:
+                            time.sleep(0.5)
+                ''',
+            },
+        )
+        found = findings_for(root, ("blocking",))
+        assert len(found) == 1
+        assert found[0].line == 11
+        assert "sleep()" in found[0].message
+        assert "mod.Box._lock" in found[0].message
+
+    def test_interprocedural_fsync_under_lock(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import os
+                import threading
+
+
+                class J:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._file = None
+
+                    def _sync(self):
+                        os.fsync(self._file.fileno())
+
+                    def append(self, line):
+                        with self._lock:
+                            self._sync()
+                ''',
+            },
+        )
+        found = findings_for(root, ("blocking",))
+        assert len(found) == 1
+        assert "os.fsync()" in found[0].message and "append -> _sync" in found[0].message
+
+    def test_dispatch_bridge_catches_blocking_io_under_store_lock(self, tmp_path):
+        """The historical PR 8 class: a store's handler fan-out runs under
+        the store lock, and a registered journal handler does file I/O —
+        the blocking reaches the store lock through the observer seam."""
+        root = write_tree(
+            tmp_path,
+            {
+                "store.py": '''\
+                import threading
+
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._handlers = []
+
+                    def add_event_handler(self, kind, fn):
+                        self._handlers.append(fn)
+
+                    def _dispatch_locked(self, event):
+                        for h in self._handlers:
+                            h(event)
+
+                    def update_status(self, event):
+                        with self._lock:
+                            self._dispatch_locked(event)
+                ''',
+                "journal.py": '''\
+                import os
+
+
+                class Journal:
+                    def __init__(self, store):
+                        self._file = None
+                        store.add_event_handler("Throttle", self._on_event)
+
+                    def _on_event(self, event):
+                        os.fsync(self._file.fileno())
+                ''',
+            },
+        )
+        found = findings_for(root, ("blocking",))
+        assert any(
+            "os.fsync()" in f.message and "store.Store._lock" in f.message
+            for f in found
+        ), [f.render() for f in found]
+
+    def test_allowlist_and_stale_detection(self, tmp_path):
+        from kube_throttler_tpu.analysis import blocking
+        from kube_throttler_tpu.analysis.core import load_package
+
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+                import time
+
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def bad(self):
+                        with self._lock:
+                            time.sleep(0.5)
+                ''',
+            },
+        )
+        allow = tmp_path / "allow.txt"
+        allow.write_text(
+            "mod.Box._lock -> sleep()  # vetted\n"
+            "mod.Box._lock -> os.fsync()  # DEAD waiver\n"
+        )
+        stale = []
+        found = blocking.check(
+            load_package(str(root)), allowlist_path=str(allow), stale_out=stale
+        )
+        assert found == []
+        assert stale == [("mod.Box._lock", "os.fsync()")]
+
+
+# ------------------------------------------------------------------ threads
+
+
+_SILENT_THREAD_SRC = {
+    "mod.py": '''\
+    import threading
+
+
+    class Pump:
+        def __init__(self):
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                self.step()
+
+        def step(self):
+            pass
+    '''
+}
+
+
+class TestThreads:
+    def test_silent_death_fires_at_target(self, tmp_path):
+        found = findings_for(write_tree(tmp_path, _SILENT_THREAD_SRC), ("threads",))
+        assert len(found) == 1
+        f = found[0]
+        assert f.relpath == "mod.py"
+        assert f.line == 9  # the _loop def
+        assert "no top-level exception routing" in f.message
+
+    def test_broad_handler_passes(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class Pump:
+                    def __init__(self):
+                        threading.Thread(target=self._loop, daemon=True).start()
+
+                    def _loop(self):
+                        while True:
+                            try:
+                                self.step()
+                            except Exception:
+                                self.note_death()
+
+                    def step(self):
+                        pass
+
+                    def note_death(self):
+                        pass
+                ''',
+            },
+        )
+        assert findings_for(root, ("threads",)) == []
+
+    def test_waiver_comment_silences(self, tmp_path):
+        src = dict(_SILENT_THREAD_SRC)
+        src["mod.py"] = src["mod.py"].replace(
+            "self._t = threading.Thread(target=self._loop, daemon=True)",
+            "#: thread: fire-and-forget\n"
+            "        self._t = threading.Thread(target=self._loop, daemon=True)",
+        )
+        assert findings_for(write_tree(tmp_path, src), ("threads",)) == []
+
+    def test_spawn_under_lock_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import threading
+
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def go(self):
+                        with self._lock:
+                            t = threading.Thread(target=run, daemon=True)
+                            t.start()
+
+
+                def run():
+                    try:
+                        pass
+                    finally:
+                        pass
+                ''',
+            },
+        )
+        found = findings_for(root, ("threads",))
+        assert len(found) == 1
+        assert "spawned while holding mod.Box._lock" in found[0].message
+
+    def test_unbounded_shutdown_join_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                class Box:
+                    def stop(self):
+                        self._t.join()
+
+                    def other(self):
+                        self._t.join()  # not a shutdown path: not flagged
+
+                    def fmt(self, xs):
+                        return ",".join(xs)  # str.join: not flagged
+                ''',
+            },
+        )
+        found = findings_for(root, ("threads",))
+        assert len(found) == 1
+        assert found[0].line == 3
+        assert "without timeout in shutdown path Box.stop" in found[0].message
+
+
+# ---------------------------------------------------------------- excsafety
+
+
+class TestExcSafety:
+    def test_fd_leak_on_exception_path_fires(self, tmp_path):
+        """The historical FileLeaseElector class: os.open, then a fallible
+        call, then ownership transfer — the fd leaks if the call raises."""
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import fcntl
+                import os
+
+
+                class Elector:
+                    def try_take(self):
+                        fd = os.open("/tmp/x", os.O_RDWR)
+                        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        self._fd = fd
+                        return True
+                ''',
+            },
+        )
+        found = findings_for(root, ("excsafety",))
+        assert len(found) == 1
+        assert found[0].line == 7
+        assert "os.open()" in found[0].message and "fcntl.flock" in found[0].message
+
+    def test_except_path_close_passes(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import fcntl
+                import os
+
+
+                class Elector:
+                    def try_take(self):
+                        fd = os.open("/tmp/x", os.O_RDWR)
+                        try:
+                            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        except BaseException:
+                            os.close(fd)
+                            raise
+                        self._fd = fd
+                        return True
+                ''',
+            },
+        )
+        assert findings_for(root, ("excsafety",)) == []
+
+    def test_with_form_and_never_closed(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                def good(path):
+                    with open(path) as f:
+                        return f.read()
+
+
+                def bad(path):
+                    f = open(path)
+                    return None
+                ''',
+            },
+        )
+        found = findings_for(root, ("excsafety",))
+        assert len(found) == 1
+        assert found[0].line == 7
+        assert "never closed" in found[0].message
+
+    def test_acquire_without_finally_release(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                class Box:
+                    def bad(self):
+                        self._lock.acquire()
+                        self.work()
+                        self._lock.release()
+
+                    def good(self):
+                        self._lock.acquire()
+                        try:
+                            self.work()
+                        finally:
+                            self._lock.release()
+                ''',
+            },
+        )
+        found = findings_for(root, ("excsafety",))
+        assert len(found) == 1
+        assert found[0].line == 3
+        assert "no finally-release" in found[0].message
+
+    def test_prepare_loop_without_compensator(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                class Gang:
+                    def gang_prepare_bad(self, pods):
+                        for pod in pods:
+                            self.plugin.reserve(pod)
+
+                    def gang_prepare_good(self, pods):
+                        done = []
+                        try:
+                            for pod in pods:
+                                self.plugin.reserve(pod)
+                                done.append(pod)
+                        except Exception:
+                            for pod in done:
+                                self.plugin.unreserve(pod)
+                            raise
+                ''',
+            },
+        )
+        found = findings_for(root, ("excsafety",))
+        assert len(found) == 1
+        assert found[0].line == 4
+        assert "no compensating unreserve/rollback" in found[0].message
+
+
+# ----------------------------------------------------------------- protocol
+
+
+class TestProtocol:
+    def test_unhandled_control_type_fires_per_venue(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "engine/journal.py": '''\
+                import json
+
+
+                class StoreJournal:
+                    def _apply(self, event):
+                        etype = event["type"]
+                        if etype == "EPOCH":
+                            return
+
+                    def _compact_locked(self):
+                        self._file.write(json.dumps({"type": "EPOCH", "epoch": 1}))
+
+                    def emit(self):
+                        self._file.write(json.dumps({"type": "GANG", "op": "begin"}))
+                ''',
+                "engine/replication.py": '''\
+                class StandbyReplicator:
+                    def _apply_lines(self, data):
+                        for event in data:
+                            if event.get("type") == "EPOCH":
+                                continue
+                ''',
+            },
+        )
+        found = findings_for(root, ("protocol",))
+        msgs = [f.message for f in found]
+        assert any("'GANG'" in m and "_apply" in m for m in msgs)
+        assert any("'GANG'" in m and "_apply_lines" in m for m in msgs)
+        assert any("'GANG'" in m and "_compact_locked" in m for m in msgs)
+        # EPOCH is dispatched everywhere: no finding for it
+        assert not any("'EPOCH'" in m for m in msgs)
+
+    def test_ipc_mtype_without_worker_handler_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/front.py": '''\
+                from .ipc import send_frame
+
+
+                class Front:
+                    def send(self, sock, lock):
+                        send_frame(sock, lock, "evt", 0, [])
+                        send_frame(sock, lock, "zap", 0, [])
+                ''',
+                "sharding/worker.py": '''\
+                def serve(rfile, sock, lock):
+                    while True:
+                        mtype, rid, body = read_frame(rfile)
+                        if mtype == "evt":
+                            pass
+                ''',
+                "sharding/ipc.py": '''\
+                def send_frame(sock, lock, mtype, rid, body):
+                    pass
+
+
+                def read_frame(rfile):
+                    return None
+                ''',
+            },
+        )
+        found = findings_for(root, ("protocol",))
+        assert any(
+            "'zap'" in f.message and "no worker-side dispatch arm" in f.message
+            for f in found
+        ), [f.render() for f in found]
+
+    def test_unfenced_durable_write_fires_and_domination_passes(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "engine/journal.py": '''\
+                class StoreJournal:
+                    def __init__(self):
+                        self.fencing = None
+                        self._file = None
+
+                    def bad_append(self, line):
+                        self._file.write(line)
+
+                    def good_append(self, line):
+                        if self.fencing is not None and self.fencing.is_stale():
+                            return
+                        self._writer()
+
+                    def _writer(self):
+                        self._file.write("x")
+                ''',
+            },
+        )
+        found = findings_for(root, ("protocol",))
+        assert len(found) == 1
+        assert found[0].line == 7
+        assert "bad_append" in found[0].message
+        assert "not dominated by a fencing-epoch check" in found[0].message
+
+
+# ------------------------------------------------------------- stale waivers
+
+
+class TestStaleWaivers:
+    def test_dead_baseline_waiver_fails_and_prunes(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": "x = 1\n"})
+        baseline = tmp_path / "baseline.txt"
+        baseline.write_text(
+            "# comment survives\n"
+            "guarded|gone.py|read of '_x' outside its lock in G.f  # dead\n"
+        )
+        rc = analysis_main(
+            ["--root", str(root), "--baseline", str(baseline), "-q"]
+        )
+        assert rc == 1  # stale waiver is an ERROR, not a warning
+        rc = analysis_main(
+            ["--root", str(root), "--baseline", str(baseline), "--prune-stale", "-q"]
+        )
+        assert rc == 0
+        text = baseline.read_text()
+        assert "gone.py" not in text and "# comment survives" in text
+        # pruned file is clean on the next run
+        assert analysis_main(
+            ["--root", str(root), "--baseline", str(baseline), "-q"]
+        ) == 0
+
+    def test_dead_blocking_allow_entry_fails_and_prunes(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": "x = 1\n"})
+        baseline = tmp_path / "baseline.txt"
+        baseline.write_text("")
+        allow = tmp_path / "blocking_allow.txt"
+        allow.write_text("mod.Box._lock -> os.fsync()  # dead waiver\n")
+        args = [
+            "--root", str(root), "--baseline", str(baseline),
+            "--blocking-allowlist", str(allow), "-q",
+        ]
+        assert analysis_main(args) == 1
+        assert analysis_main(args + ["--prune-stale"]) == 0
+        assert "os.fsync" not in allow.read_text()
+        assert analysis_main(args) == 0
+
+
+# ------------------------------------------------------ purity scope (PR 10)
+
+
+class TestPurityScope:
+    def test_sharding_jit_entry_is_scanned(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/kernels.py": '''\
+                import time
+
+                import jax
+
+
+                @jax.jit
+                def shard_tick(x):
+                    t = time.monotonic()
+                    return x + t
+                ''',
+            },
+        )
+        found = findings_for(root, ("purity",))
+        assert len(found) == 1
+        assert "time.monotonic()" in found[0].message
+
+    def test_real_repo_gang_check_entries_reachable(self):
+        from kube_throttler_tpu.analysis import PACKAGE_ROOT, purity
+        from kube_throttler_tpu.analysis.core import load_package
+
+        modules = [
+            m
+            for m in load_package(PACKAGE_ROOT)
+            if m.relpath.replace("\\\\", "/").startswith(
+                ("ops/", "parallel/", "sharding/")
+            )
+        ]
+        entries = purity._entry_points(modules)
+        entry_files = {m.relpath.replace("\\\\", "/") for m, _, _, _ in entries}
+        assert "ops/gang_check.py" in entry_files, sorted(entry_files)
+
+
+# ------------------------------------------- registry coverage (PR 8/9 families)
+
+
+class TestRegistryCoverage:
+    def test_real_known_sites_cover_new_families(self):
+        from kube_throttler_tpu.analysis import PACKAGE_ROOT
+        from kube_throttler_tpu.analysis.core import load_package
+        from kube_throttler_tpu.analysis.registry import _find_module, _literal_str_set
+
+        modules = load_package(PACKAGE_ROOT)
+        sites = _literal_str_set(_find_module(modules, "faults/plan.py"), "KNOWN_SITES")
+        for expected in (
+            "scenario.leader.kill", "shard.ipc.send", "shard.worker.kill",
+            "ha.journal.batch", "gang.reserve.partial", "mock.lease",
+        ):
+            assert expected in sites
+        names = _literal_str_set(_find_module(modules, "metrics.py"), "METRIC_NAMES")
+        for expected in (
+            "kube_throttler_shard_scatter_duration_seconds",
+            "kube_throttler_scenario_slo_gate",
+        ):
+            assert expected in names
+
+    @pytest.mark.parametrize(
+        "bad_site",
+        [
+            "scenario.leader.typo",
+            "shard.ipc.typo",
+            "ha.journal.typo",
+            "gang.reserve.typo",
+            "mock.lease2",
+        ],
+    )
+    def test_one_miss_per_family_fires(self, tmp_path, bad_site):
+        root = write_tree(
+            tmp_path,
+            {
+                "faults/plan.py": '''\
+                KNOWN_SITES = frozenset({
+                    "scenario.leader.kill", "shard.ipc.send", "ha.journal.batch",
+                    "gang.reserve.partial", "mock.lease",
+                })
+                ''',
+                "metrics.py": "METRIC_NAMES = frozenset({'kube_throttler_shard_up'})\n",
+                "mod.py": f'''\
+                def f(self):
+                    self.faults.check("{bad_site}")
+                ''',
+            },
+        )
+        found = findings_for(root, ("registry",))
+        assert len(found) == 1
+        assert bad_site in found[0].message
+
+    def test_shard_metric_family_miss_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "faults/plan.py": "KNOWN_SITES = frozenset({'mock.lease'})\n",
+                "metrics.py": (
+                    "METRIC_NAMES = frozenset({"
+                    "'kube_throttler_shard_up', 'kube_throttler_scenario_slo_gate'})\n"
+                ),
+                "mod.py": '''\
+                def setup(registry):
+                    registry.gauge_vec("kube_throttler_shard_up", "h", ["a"])
+                    registry.gauge_vec("kube_throttler_shard_upp", "h", ["a"])
+                ''',
+            },
+        )
+        found = findings_for(root, ("registry",))
+        assert len(found) == 1
+        assert "kube_throttler_shard_upp" in found[0].message

@@ -190,3 +190,45 @@ def test_guard_attrs_inert_without_table():
     p = Plain()
     p.x = 2
     assert p.x == 2
+
+
+def test_hold_budget_raises_on_over_hold_and_lock_survives():
+    """PR 10 runtime half of the blocking checker: a lock held past its
+    budget raises AFTER release (the raise reports the over-hold, never
+    extends it), and the lock stays usable afterwards."""
+    import time
+
+    lockorder.clear_hold_budgets()
+    a = make_lock("budget.test.a")
+    other = make_lock("other.unbudgeted")
+    lockorder.set_hold_budget("budget.test.*", 0.02)
+    try:
+        with a:
+            pass  # fast hold: under budget, no raise
+        with pytest.raises(lockorder.LockHoldBudgetExceeded, match="hold budget"):
+            with a:
+                time.sleep(0.05)
+        # unmatched locks fall through to the (unset) env default: no raise
+        with other:
+            time.sleep(0.05)
+    finally:
+        lockorder.clear_hold_budgets()
+    # the over-hold released the lock before raising: still acquirable
+    with a:
+        assert a._is_owned()
+
+
+def test_hold_budget_rearm_and_clear():
+    import time
+
+    lockorder.clear_hold_budgets()
+    a = make_lock("budget.rearm")
+    lockorder.set_hold_budget("budget.rearm", 0.01)
+    lockorder.set_hold_budget("budget.rearm", 5.0)  # re-arm replaces
+    try:
+        with a:
+            time.sleep(0.02)  # over the old budget, under the new: fine
+    finally:
+        lockorder.clear_hold_budgets()
+    with a:
+        time.sleep(0.02)  # budgets cleared: no raise
