@@ -2228,7 +2228,334 @@ def bench_selector_index(label, T=10_000, n_pods=200):
         log(f"[{label}] pod-event row recompute vs T={T} ({name}): {dt*1e6:.1f}us/event")
 
 
+def _gc_pause_tracker():
+    """Attach a gc callback recording collection pause durations; returns
+    the mutable stats dict (max/count) and the callback (for removal)."""
+    import gc
+
+    state = {"max_s": 0.0, "count": 0, "_t0": None}
+
+    def cb(phase, info):
+        if phase == "start":
+            state["_t0"] = time.perf_counter()
+        elif state["_t0"] is not None:
+            pause = time.perf_counter() - state["_t0"]
+            state["_t0"] = None
+            state["count"] += 1
+            if pause > state["max_s"]:
+                state["max_s"] = pause
+
+    gc.callbacks.append(cb)
+    return state, cb
+
+
+def _heap_objects() -> int:
+    """Tracked containers + the permanent generation (frozen) — the
+    comparable total across the freeze/no-freeze postures."""
+    import gc
+
+    return len(gc.get_objects()) + gc.get_freeze_count()
+
+
+def _maxrss_mb() -> float:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _mega_status_write_rate(store, rounds=3) -> dict:
+    """Batched status-write throughput through the LIVE stack: rewrite
+    every throttle's status via the store's batched UpdateStatus (the
+    controllers' commit path — device-mirror echo, informer mirror, and
+    controller handlers all subscribed). Median of ``rounds``."""
+    import dataclasses
+
+    thrs = store.list_throttles()
+    rates = []
+    for _ in range(rounds):
+        batch = [t.with_status(dataclasses.replace(t.status)) for t in store.list_throttles()]
+        t0 = time.perf_counter()
+        store.update_throttle_statuses(batch)
+        dt = time.perf_counter() - t0
+        rates.append(len(batch) / dt)
+    return {
+        "throttles": len(thrs),
+        "writes_per_sec_median": float(np.median(rates)),
+        "writes_per_sec_runs": [round(r) for r in rates],
+    }
+
+
+def _mega_churn_window(store, plugin, P, groups, seconds=20.0, batch=256) -> dict:
+    """Paced pod-churn window: request-size updates (the cfg5 shape)
+    through ``apply_events`` batches, with GC pauses tracked. Returns
+    applied events/s + max GC pause inside the window."""
+    import random
+
+    from kube_throttler_tpu.api.pod import make_pod
+    from dataclasses import replace as _replace
+
+    # replay build_served_stack's label assignment (same seed + draw
+    # order) so churn is the cfg5 REQUEST-RESIZE shape — a wrong group
+    # would turn every event into a label move (reservation migration +
+    # index row re-match), a different and far heavier workload
+    grp_rng = random.Random(0)
+    grp_of = []
+    for _ in range(P):
+        grp_of.append(grp_rng.randrange(groups))
+        grp_rng.randrange(1, 8)
+    rng = random.Random(7)
+    gc_stats, cb = _gc_pause_tracker()
+    applied = 0
+    t0 = time.perf_counter()
+    try:
+        while time.perf_counter() - t0 < seconds:
+            ops = []
+            for _ in range(batch):
+                i = rng.randrange(P)
+                pod = make_pod(
+                    f"p{i}",
+                    labels={"grp": f"g{grp_of[i]}"},
+                    requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+                )
+                pod = _replace(pod, spec=_replace(pod.spec, node_name="node-1"))
+                pod.status.phase = "Running"
+                ops.append(("upsert", "Pod", pod))
+            store.apply_events(ops)
+            applied += len(ops)
+    finally:
+        import gc
+
+        gc.callbacks.remove(cb)
+    dt = time.perf_counter() - t0
+    return {
+        "events_applied": applied,
+        "events_per_sec": round(applied / dt, 1),
+        "window_s": round(dt, 1),
+        "gc_collections": gc_stats["count"],
+        "gc_max_pause_ms": round(gc_stats["max_s"] * 1e3, 2),
+    }
+
+
+def _mega_equivalence_sweep(n_pods=1500, n_thr=120, seed=11) -> dict:
+    """Seeded columnar ≡ frozen-dict ≡ batched ≡ sequential sweep: one op
+    stream (creates / label moves / request updates / deletes / status
+    recomputes) applied to (a) a columnar store batched, (b) a columnar
+    store sequentially, (c) the frozen-dict reference store — asserting
+    identical store dumps, identical published st_* planes, and identical
+    pre_filter verdicts. The bench-level twin of
+    tests/test_columnar_store.py's sweep, run at a larger shape."""
+    import random
+
+    from dataclasses import replace as _replace
+
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.engine.store import Store
+    from tools.harness import build_plugin, dump_store, make_throttle, recompute_status, verdicts
+
+    def op_stream():
+        rng = random.Random(seed)
+        ops = []
+        for i in range(n_thr):
+            ops.append(("create", "Throttle", _replace(make_throttle(i % 40), name=f"t{i}")))
+        for i in range(n_pods):
+            pod = make_pod(
+                f"p{i}", labels={"grp": f"g{rng.randrange(40)}"},
+                requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+            )
+            pod = _replace(pod, spec=_replace(pod.spec, node_name="node-1"))
+            pod.status.phase = "Running"
+            ops.append(("create", "Pod", pod))
+        for _ in range(n_pods // 2):
+            i = rng.randrange(n_pods)
+            verb = rng.choice(["move", "resize", "delete", "revive"])
+            if verb == "delete":
+                ops.append(("delete", "Pod", f"default/p{i}"))
+            else:
+                pod = make_pod(
+                    f"p{i}", labels={"grp": f"g{rng.randrange(40)}"},
+                    requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+                )
+                pod = _replace(pod, spec=_replace(pod.spec, node_name="node-1"))
+                pod.status.phase = "Running"
+                ops.append(("upsert", "Pod", pod))
+        return ops
+
+    # ONE op stream shared by all three runs: uids come from a process
+    # counter, so regenerating per run would make the dumps differ by
+    # uid alone (objects are immutable-by-convention — sharing them
+    # across stores is safe, and the columnar absorb only canonicalizes
+    # label/annotation dict identity, never content)
+    shared_ops = op_stream()
+    shared_ns = Namespace("default")  # one uid across all three runs
+
+    def run(columnar: bool, batched: bool):
+        store = Store(columnar=columnar)
+        plugin = build_plugin(store)
+        store.create_namespace(shared_ns)
+        ops = shared_ops
+        if batched:
+            for s in range(0, len(ops), 64):
+                store.apply_events(ops[s : s + 64])
+        else:
+            for op in ops:
+                store.apply_events([op])
+        # deterministic status writes (no wall-clock in the payload)
+        for thr in store.list_throttles():
+            store.update_throttle_status(recompute_status(store, thr))
+        return (
+            dump_store(store),
+            plugin.device_manager.published_flags(),
+            verdicts(plugin, store),
+        )
+
+    col_b = run(True, batched=True)
+    col_s = run(True, batched=False)
+    ref = run(False, batched=False)
+    return {
+        "pods": n_pods,
+        "throttles": n_thr,
+        "batched_eq_sequential": col_b == col_s,
+        "columnar_eq_reference": col_s == ref,
+        "dumps_equal": col_b[0] == col_s[0] == ref[0],
+        "planes_equal": col_b[1] == col_s[1] == ref[1],
+        "verdicts_equal": col_b[2] == col_s[2] == ref[2],
+    }
+
+
+def run_mega() -> None:
+    """``python bench.py --mega``: the PR 11 acceptance artifact — the
+    columnar-arena ladder up to 1M pods × 100k throttles on one host,
+    recording RSS high-water, heap object count, and max GC pause
+    alongside throughput; plus the 100k×10k status-write rung against the
+    PR 2 frozen-dict baseline and the seeded equivalence sweep. Written
+    to BENCH_PR11_<platform>_<stamp>.json."""
+    import gc
+
+    platform = "cpu"
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        pass
+    out: dict = {
+        "metric": (
+            "columnar arena store ladder: 1M pods x 100k throttles on one "
+            "host (RSS high-water, heap objects, max GC pause) + "
+            "status-write throughput vs the PR 2 frozen-dict baseline"
+        ),
+        "platform": platform,
+        "host_cores": os.cpu_count(),
+        "columnar": True,
+        "rungs": {},
+    }
+
+    log("[mega] seeded equivalence sweep (columnar vs frozen-dict reference)")
+    eq = _mega_equivalence_sweep()
+    out["equivalence"] = eq
+    log(f"[mega] equivalence: {eq}")
+    if not (eq["dumps_equal"] and eq["planes_equal"] and eq["verdicts_equal"]):
+        log("[mega] EQUIVALENCE FAILED — aborting before the ladder")
+        out["value"] = 0.0
+        emit(out)
+        return
+
+    # PR 2 reference: the measured frozen-dict status-write ceiling
+    # (docs/PERFORMANCE.md "What bounds each path")
+    PR2_STATUS_WRITES_PER_SEC = 8000.0
+    ladder = [
+        ("100kx10k", 100_000, 10_000, 500),
+        ("1Mx100k", 1_000_000, 100_000, 5000),
+    ]
+    for name, P, T, groups in ladder:
+        log(f"[mega] ==== rung {name}: {P} pods x {T} throttles ====")
+        gc_build, cb = _gc_pause_tracker()
+        rss_before, heap_before = _maxrss_mb(), _heap_objects()
+        t0 = time.perf_counter()
+        store, plugin = build_served_stack(P, T, groups=groups, label=f"mega-{name}")
+        build_s = time.perf_counter() - t0
+        gc.callbacks.remove(cb)
+        rung: dict = {
+            "pods": P,
+            "throttles": T,
+            "build_seconds": round(build_s, 1),
+            "rss_high_water_mb": round(_maxrss_mb(), 1),
+            "rss_delta_mb": round(_maxrss_mb() - rss_before, 1),
+            "heap_objects": _heap_objects(),
+            "heap_objects_delta": _heap_objects() - heap_before,
+            "heap_objects_per_pod": round((_heap_objects() - heap_before) / P, 4),
+            "rss_bytes_per_pod": round((_maxrss_mb() - rss_before) * 1024 * 1024 / P),
+            "build_gc_max_pause_ms": round(gc_build["max_s"] * 1e3, 2),
+            "arena": store.pod_arena.stats() if store.pod_arena else None,
+        }
+        try:
+            sw = _mega_status_write_rate(store)
+            rung["status_writes"] = sw
+            rung["status_writes_x_pr2"] = round(
+                sw["writes_per_sec_median"] / PR2_STATUS_WRITES_PER_SEC, 2
+            )
+            log(
+                f"[mega:{name}] status writes {sw['writes_per_sec_median']:,.0f}/s "
+                f"({rung['status_writes_x_pr2']}x the PR2 8k/s baseline)"
+            )
+            churn = _mega_churn_window(store, plugin, P, groups)
+            rung["churn"] = churn
+            log(
+                f"[mega:{name}] churn {churn['events_per_sec']:,.0f} ev/s, "
+                f"max GC pause {churn['gc_max_pause_ms']}ms "
+                f"({churn['gc_collections']} collections)"
+            )
+            r = host_percentiles(
+                lambda: plugin.pre_filter(
+                    make_probe_pod(groups)
+                ),
+                300,
+                warmup=20,
+                max_seconds=30.0,
+            )
+            rung["prefilter_p50_ms"] = round(r["p50"] * 1e3, 3)
+            rung["prefilter_p99_ms"] = round(r["p99"] * 1e3, 3)
+            log(
+                f"[mega:{name}] pre_filter p50 {rung['prefilter_p50_ms']}ms / "
+                f"p99 {rung['prefilter_p99_ms']}ms"
+            )
+        finally:
+            plugin.stop()
+            del store, plugin
+            gc.collect()
+        out["rungs"][name] = rung
+        log(
+            f"[mega:{name}] RSS {rung['rss_high_water_mb']}MB "
+            f"({rung['rss_bytes_per_pod']}B/pod), heap {rung['heap_objects']:,} "
+            f"objects ({rung['heap_objects_per_pod']}/pod), build {build_s:.0f}s"
+        )
+
+    big = out["rungs"].get("1Mx100k", {})
+    out["value"] = float(big.get("churn", {}).get("events_per_sec", 0.0))
+    out["unit"] = "events/s sustained at 1M pods x 100k throttles"
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = f"BENCH_PR11_{platform.upper()}_{stamp}.json"
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    log(f"mega ladder written to {path}")
+    emit(out)
+
+
+def make_probe_pod(groups: int):
+    import random
+
+    from kube_throttler_tpu.api.pod import make_pod
+
+    i = random.randrange(groups)
+    return make_pod(
+        f"probe{i}", labels={"grp": f"g{i}"}, requests={"cpu": "300m"}
+    )
+
+
 def main():
+    if "--mega" in sys.argv:
+        # PR 11 acceptance artifact: the 1M x 100k columnar-arena ladder
+        run_mega()
+        return
     if "--ingest-sweep" in sys.argv:
         # PR 5 acceptance artifact: the full-scale batch-size sweep alone
         run_ingest_sweep()

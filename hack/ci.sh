@@ -15,4 +15,7 @@ env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_lockorder.py tests/test_journal.py \
     -q -p no:cacheprovider
 
+echo "== memory: 50k-pod columnar-arena build vs committed per-pod bounds =="
+env JAX_PLATFORMS=cpu python tools/memsmoke.py
+
 echo "ci gate: OK"

@@ -810,11 +810,19 @@ def main(argv: Optional[list] = None) -> int:
         wire.start()
         print(f"wire-protocol apiserver on {args.host}:{wire.port}", flush=True)
 
+    # columnar arena observability (slots live/recycled, intern pool,
+    # lazy-edge materializations) on the serving registry
+    from .metrics import register_store_metrics
+
+    register_store_metrics(metrics_registry, store)
+
     # last step before taking traffic: freeze the startup heap (store,
     # device mirror, kernel caches) so automatic full GCs never rescan it
     # — at 100k×10k those paused every thread 500-750ms, straight into the
-    # flip-publication tail; the hygiene thread is the periodic
-    # collect-and-refreeze leak backstop (utils/gchygiene.py)
+    # flip-publication tail; with the columnar arena most heaps stay under
+    # the freeze floor and the call is a measured no-op (gchygiene.py);
+    # the hygiene thread is the periodic collect-and-refreeze leak
+    # backstop (utils/gchygiene.py)
     from .utils.gchygiene import GcHygieneThread, enabled as gc_hygiene_enabled
 
     gc_hygiene = None

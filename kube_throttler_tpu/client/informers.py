@@ -29,31 +29,61 @@ Handler = Callable[[Event], None]
 
 
 class Indexer:
-    """Keyed object cache with named secondary indexes."""
+    """Keyed object cache with named secondary indexes.
+
+    With a ``resolver`` (the columnar store's ``materialize_pod``) the
+    indexer retains NO objects: it keeps keys + the index values computed
+    at upsert time and materializes through the resolver on every read —
+    the informer cache stops being a second full copy of the pod
+    population (at 1M pods that copy alone was ~10 heap objects/pod).
+    The resolver is a LEAF call (arena lock only), so holding ``_lock``
+    across it cannot invert any order."""
 
     GUARDED_BY = {
         "_objects": "self._lock",
+        "_meta": "self._lock",
         "_indices": "self._lock",
     }
 
-    def __init__(self, index_funcs: Optional[Dict[str, Callable[[object], List[str]]]] = None):
+    def __init__(
+        self,
+        index_funcs: Optional[Dict[str, Callable[[object], List[str]]]] = None,
+        resolver: Optional[Callable[[str], Optional[object]]] = None,
+    ):
         self._lock = make_rlock("informers.indexer")
         self._objects: Dict[str, object] = {}
+        self._resolver = resolver
+        # resolver mode: key -> {index name: values tuple} computed at
+        # upsert (single-value indexes store the bare string — zero
+        # per-key container objects for the namespace index)
+        self._meta: Dict[str, dict] = {}
         self._index_funcs = index_funcs or {}
         # index name -> index value -> set of object keys
         self._indices: Dict[str, Dict[str, Set[str]]] = {
             name: defaultdict(set) for name in self._index_funcs
         }
 
+    @staticmethod
+    def _pack_values(values: List[str]):
+        return values[0] if len(values) == 1 else tuple(values)
+
+    @staticmethod
+    def _unpack_values(packed) -> tuple:
+        return (packed,) if isinstance(packed, str) else packed
+
+    def _unindex_values_locked(self, key: str, name: str, values) -> None:
+        assert_held(self._lock, "Indexer._unindex_values_locked")
+        for value in values:
+            bucket = self._indices[name].get(value)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._indices[name][value]
+
     def _unindex_locked(self, key: str, obj: object) -> None:
         assert_held(self._lock, "Indexer._unindex_locked")
         for name, fn in self._index_funcs.items():
-            for value in fn(obj):
-                bucket = self._indices[name].get(value)
-                if bucket is not None:
-                    bucket.discard(key)
-                    if not bucket:
-                        del self._indices[name][value]
+            self._unindex_values_locked(key, name, fn(obj))
 
     def _index_locked(self, key: str, obj: object) -> None:
         assert_held(self._lock, "Indexer._index_locked")
@@ -63,6 +93,22 @@ class Indexer:
 
     def upsert(self, key: str, obj: object) -> None:
         with self._lock:
+            if self._resolver is not None:
+                old_meta = self._meta.get(key)
+                new_meta = {
+                    name: self._pack_values(fn(obj))
+                    for name, fn in self._index_funcs.items()
+                }
+                self._meta[key] = new_meta
+                if old_meta == new_meta and old_meta is not None:
+                    return
+                if old_meta is not None:
+                    for name, packed in old_meta.items():
+                        self._unindex_values_locked(
+                            key, name, self._unpack_values(packed)
+                        )
+                self._index_locked(key, obj)
+                return
             old = self._objects.get(key)
             self._objects[key] = obj
             if old is not None:
@@ -79,12 +125,22 @@ class Indexer:
 
     def delete(self, key: str) -> None:
         with self._lock:
+            if self._resolver is not None:
+                old_meta = self._meta.pop(key, None)
+                if old_meta is not None:
+                    for name, packed in old_meta.items():
+                        self._unindex_values_locked(
+                            key, name, self._unpack_values(packed)
+                        )
+                return
             old = self._objects.pop(key, None)
             if old is not None:
                 self._unindex_locked(key, old)
 
     def get(self, key: str):
         with self._lock:
+            if self._resolver is not None:
+                return self._resolver(key) if key in self._meta else None
             return self._objects.get(key)
 
     def get_many(self, keys) -> List[object]:
@@ -93,26 +149,43 @@ class Indexer:
         decision; per-key get() paid a lock acquire + two frames each
         (~3µs × K measured at the 100k×10k scale)."""
         with self._lock:
+            if self._resolver is not None:
+                r, meta = self._resolver, self._meta
+                return [r(k) if k in meta else None for k in keys]
             g = self._objects.get
             return [g(k) for k in keys]
 
     def list(self) -> List[object]:
         with self._lock:
+            if self._resolver is not None:
+                r = self._resolver
+                out = [r(k) for k in self._meta]
+                return [o for o in out if o is not None]
             return list(self._objects.values())
 
     def keys(self) -> List[str]:
         with self._lock:
+            if self._resolver is not None:
+                return list(self._meta.keys())
             return list(self._objects.keys())
 
     def snapshot(self) -> Dict[str, object]:
         """Keyed copy of the cache under one lock hold (recovery's
         first-relist reconcile walks this rather than the raw store)."""
         with self._lock:
+            if self._resolver is not None:
+                r = self._resolver
+                out = {k: r(k) for k in self._meta}
+                return {k: o for k, o in out.items() if o is not None}
             return dict(self._objects)
 
     def by_index(self, index_name: str, value: str) -> List[object]:
         with self._lock:
             keys = self._indices[index_name].get(value, set())
+            if self._resolver is not None:
+                r, meta = self._resolver, self._meta
+                out = [r(k) for k in keys if k in meta]
+                return [o for o in out if o is not None]
             return [self._objects[k] for k in keys if k in self._objects]
 
 
@@ -132,7 +205,15 @@ class SharedIndexInformer:
         index_funcs = {}
         if kind in ("Pod", "Throttle"):
             index_funcs[NAMESPACE_INDEX] = lambda obj: [obj.namespace]
-        self.indexer = Indexer(index_funcs)
+        # columnar store: the Pod informer cache holds keys only and
+        # materializes through the arena on read — no second full copy of
+        # the pod population
+        resolver = (
+            store.materialize_pod
+            if kind == "Pod" and getattr(store, "pod_arena", None) is not None
+            else None
+        )
+        self.indexer = Indexer(index_funcs, resolver=resolver)
         self._handlers: List[Handler] = []
         self._lock = make_rlock(f"informers.{kind}.handlers")
         # ALL handler deliveries (store events and resync) serialize through

@@ -258,10 +258,14 @@ def _pad_pow2(idx: np.ndarray, lo: int = 8) -> np.ndarray:
 class _KindState:
     """Staging arrays + index for one kind."""
 
-    def __init__(self, kind: str, dims: DimRegistry):
+    def __init__(self, kind: str, dims: DimRegistry, interner=None):
         self.kind = kind
         self.dims = dims
-        self.index = SelectorIndex(kind)
+        self.index = SelectorIndex(kind, interner=interner)
+        # columnar store arena (engine/columnar.py), wired by the manager
+        # when the store carries one: pod request encodes come from the
+        # interned request-shape cache instead of per-pod Fraction math
+        self.arena = None
         self.R = dims.capacity
         pcap, tcap = self.index.capacities
         self._alloc_pods(pcap)
@@ -578,7 +582,13 @@ class _KindState:
     def pod_request_entries(self, pod: Pod) -> List[Tuple[int, int]]:
         """(dim index, milli value) pairs for a pod's effective requests —
         the registry-dependent half of the row encode. Valid for any
-        consumer sharing this instance's ``dims``."""
+        consumer sharing this instance's ``dims``. Arena-absorbed pods
+        carry their interned request-shape id, so the entries come from
+        the per-shape cache — zero per-pod dict hydration or Fraction
+        arithmetic on the hot path."""
+        arena = self.arena
+        if arena is not None and getattr(pod, "_kt_arena", None) is arena.token:
+            return arena.entries_for(pod.__dict__["_kt_req_sid"], self.dims)
         return [
             (self.dims.index_of(name), to_milli(q))
             for name, q in pod_request_resource_list(pod).items()
@@ -779,16 +789,17 @@ class _KindState:
             return self._device_pods, None
         if (
             self._device_mask is None
-            or self._device_mask.shape != self.index.mask.shape
+            or self._device_mask.shape != self.index.capacities
             or len(self._mask_dirty_rows) > self.row_scatter_max
         ):
-            # the live numpy mask already includes every pending row change
+            # materialized dense from the sparse rows (the dense device
+            # route only activates at small K/T ratios — see _rebuild_cols)
             self._device_mask = jnp.asarray(self.index.mask)
             self._mask_dirty_rows.clear()
         elif self._mask_dirty_rows:
             rows = _pad_pow2(np.fromiter(self._mask_dirty_rows, dtype=np.int64))
             self._device_mask = self._device_mask.at[rows].set(
-                self.index.mask[rows, :]
+                self.index.mask_rows(rows)
             )
             self._mask_dirty_rows.clear()
         return self._device_pods, self._device_mask
@@ -800,27 +811,26 @@ class _KindState:
         invalidation bookkeeping)."""
         return self._device_cols
 
-    def _cols_from_mask(self, mask: np.ndarray, K: int) -> np.ndarray:
-        """[P,T] bool → int32[P,K] matched cols per row, -1 padded (O(nnz))."""
-        P = mask.shape[0]
-        out = np.full((P, K), -1, dtype=np.int32)
-        rows, cols = np.nonzero(mask)  # row-major ⇒ rows sorted
-        if rows.size:
-            counts = mask.sum(axis=1)
-            starts = np.zeros(P + 1, dtype=np.int64)
-            np.cumsum(counts, out=starts[1:])
-            slot = np.arange(rows.size, dtype=np.int64) - starts[rows]
-            out[rows, slot] = cols
+    @staticmethod
+    def _strip_sentinel(block: np.ndarray, counts: np.ndarray, K: int) -> np.ndarray:
+        """Sparse-row block (sentinel-padded, kcap wide) → the device's
+        int32[*, K] cols encoding (-1 padded)."""
+        n = block.shape[0]
+        out = np.full((n, K), -1, dtype=np.int32)
+        w = min(K, block.shape[1])
+        sub = block[:, :w]
+        keep = np.arange(w, dtype=np.int32)[None, :] < counts[:, None]
+        out[:, :w] = np.where(keep, sub, -1)
         return out
 
     def _rebuild_cols(self) -> None:
-        """Full sparse-cols rebuild from the live numpy mask. Chooses the
+        """Full sparse-cols rebuild from the index's sparse rows (which
+        ARE the [P,K] encoding — one sentinel→-1 strip away). Chooses the
         ladder-padded K from the max per-row match count; opts OUT of the
         sparse path (sets None) when K stops being ≪ T — a near-dense mask
         gathers most of the state anyway, at worse locality than the
         broadcast kernel."""
-        mask = self.index.mask
-        nnz_max = int(mask.sum(axis=1).max()) if mask.size else 0
+        nnz_max = self.index.nnz_max()
         # TRUE pow2 here, not the ×4 shape ladder: K is a property of the
         # CLUSTER STATE (max matches per pod), not of a per-call burst — it
         # changes only on rung escalation, so compile count stays tiny
@@ -834,7 +844,8 @@ class _KindState:
             self._device_cols = None
             self._cols_K = 0
             return
-        self._cols_host = self._cols_from_mask(mask, K)
+        row_cols, row_n, _kcap = self.index.sparse_snapshot()
+        self._cols_host = self._strip_sentinel(row_cols, row_n, K)
         self._device_cols = jnp.asarray(self._cols_host)
         self._cols_K = K
 
@@ -843,11 +854,11 @@ class _KindState:
         rows; escalates to a full rebuild if a row outgrew K."""
         if self._cols_host is None:
             return
-        sub = self.index.mask[rows, :]
-        if sub.size and int(sub.sum(axis=1).max()) > self._cols_K:
+        block, counts = self.index.row_cols_block(rows)
+        if counts.size and int(counts.max()) > self._cols_K:
             self._rebuild_cols()  # K ladder rung grew
             return
-        self._cols_host[rows] = self._cols_from_mask(sub, self._cols_K)
+        self._cols_host[rows] = self._strip_sentinel(block, counts, self._cols_K)
         self._device_cols = self._device_cols.at[rows].set(self._cols_host[rows])
 
     def refresh_mask(self) -> None:
@@ -868,7 +879,7 @@ class _KindState:
         if row is None or not self.pod_valid[row] or not self.counted[row]:
             return None
         if cols is None:
-            cols = np.nonzero(self.index.mask[row, :])[0].astype(np.int32)
+            cols = self.index.row_cols(row)
         if cols.size == 0:
             return None
         return (cols, self.pod_req[row].copy(), self.pod_present[row].copy())
@@ -992,12 +1003,14 @@ class _KindState:
         req = np.zeros((tcap, R), dtype=np.int64)
         ctb = np.zeros((tcap, R), dtype=np.int32)
         rows = np.flatnonzero(self.pod_valid & self.counted)
-        mask = self.index.mask
         CHUNK = self._REBASE_CHUNK  # bounds the row-gather temp + limb exactness
         for s in range(0, rows.size, CHUNK):
             rr = rows[s : s + CHUNK]
-            pr, pc = np.nonzero(mask[rr, :tcap])
+            block, counts = self.index.row_cols_block(rr)
+            keep = np.arange(block.shape[1], dtype=np.int32)[None, :] < counts[:, None]
+            pr, slot = np.nonzero(keep)
             if pr.size:
+                pc = block[pr, slot]
                 self._bincount_scatter(
                     pc, self.pod_req[rr[pr]], self.pod_present[rr[pr]], tcap, cnt, req, ctb
                 )
@@ -1010,19 +1023,31 @@ class _KindState:
         Caller holds the main lock; steal_agg_work escalates to a full
         rebase past max(256, tcap/4) columns (the strided column gather
         scales worse than the row-major full scan)."""
-        eligible = self.pod_valid & self.counted
+        eligible_rows = np.flatnonzero(self.pod_valid & self.counted)
         n = cols.size
         cnt = np.zeros(n, dtype=np.int64)
         req = np.zeros((n, self.R), dtype=np.int64)
         ctb = np.zeros((n, self.R), dtype=np.int32)
-        CCHUNK = max(1, (self._REBASE_CHUNK * 4096) // max(self.pcap, 1))
-        for s in range(0, n, CCHUNK):
-            cc = cols[s : s + CCHUNK]
-            sub = self.index.mask[:, cc] & eligible[:, None]
-            pr, pc = np.nonzero(sub)
+        if n == 0:
+            return cnt, req, ctb
+        # map col id → position in ``cols`` via one sorted lookup table;
+        # membership resolves against the sparse rows (sorted, so a
+        # searchsorted hit test replaces the dense [pcap, c] gather)
+        order = np.argsort(cols, kind="stable")
+        sorted_cols = cols[order]
+        CHUNK = self._REBASE_CHUNK
+        for s in range(0, eligible_rows.size, CHUNK):
+            rr = eligible_rows[s : s + CHUNK]
+            block, counts = self.index.row_cols_block(rr)
+            keep = np.arange(block.shape[1], dtype=np.int32)[None, :] < counts[:, None]
+            pos = np.searchsorted(sorted_cols, block)
+            pos_c = np.minimum(pos, n - 1)
+            hit = keep & (sorted_cols[pos_c] == block)
+            pr, slot = np.nonzero(hit)
             if pr.size:
+                pc = order[pos_c[pr, slot]]
                 self._bincount_scatter(
-                    pc + s, self.pod_req[pr], self.pod_present[pr], n, cnt, req, ctb
+                    pc, self.pod_req[rr[pr]], self.pod_present[rr[pr]], n, cnt, req, ctb
                 )
         return cnt, req, ctb
 
@@ -1296,8 +1321,19 @@ class DeviceStateManager:
         # kernel only without the native lib. KT_SINGLE_CHECK_DEVICE=1/0
         # forces either route (parity tests force both).
         self._single_check_device: Optional[bool] = None
-        self.throttle = _KindState("throttle", self.dims)
-        self.clusterthrottle = _KindState("clusterthrottle", self.dims)
+        # columnar store: both kinds' indexes share the arena's intern
+        # pool (one interning per label string per process), retain no pod
+        # objects (Store.materialize_pod resolves the rare full-object
+        # needs), and the staging encodes requests from the arena's
+        # per-shape cache
+        arena = getattr(store, "pod_arena", None)
+        interner = arena.pool if arena is not None else None
+        self.throttle = _KindState("throttle", self.dims, interner=interner)
+        self.clusterthrottle = _KindState("clusterthrottle", self.dims, interner=interner)
+        if arena is not None:
+            for ks in (self.throttle, self.clusterthrottle):
+                ks.arena = arena
+                ks.index.pod_resolver = store.materialize_pod
         # per-kind aggregate-flush locks: agg_* arrays are touched only
         # under these, so the reconcile's device dispatches never hold the
         # main lock (lock order: agg → main; nothing takes main → agg)
@@ -1606,10 +1642,9 @@ class DeviceStateManager:
             entries = (
                 None
                 if event.type == EventType.DELETED
-                else [
-                    (self.dims.index_of(name), to_milli(q))
-                    for name, q in pod_request_resource_list(pod).items()
-                ]
+                # arena-absorbed pods resolve from the interned
+                # request-shape cache (zero per-pod Fraction math)
+                else self.throttle.pod_request_entries(pod)
             )
             # labels+namespace unchanged ⇒ neither kind's mask row can have
             # moved ⇒ delta-capture may reuse begin's matched cols (skips
@@ -1659,7 +1694,7 @@ class DeviceStateManager:
         if cols is None and etype != EventType.DELETED:
             row = ks.index.pod_row(pod.key)
             if row is not None:
-                cols = np.nonzero(ks.index.mask[row, :])[0]
+                cols = ks.index.row_cols(row)
         if cols is None:
             return None
         ck = ks.index._col_keys
@@ -1713,10 +1748,7 @@ class DeviceStateManager:
                     and pod.is_scheduled()
                 )
                 counted = count_in and pod.is_not_finished()
-                entries = [
-                    (self.dims.index_of(name), to_milli(q))
-                    for name, q in pod_request_resource_list(pod).items()
-                ]
+                entries = self.throttle.pod_request_entries(pod)
                 plans.append((key, ev, counted, count_in, entries))
             for ks in (self.throttle, self.clusterthrottle):
                 # phase 1: old contributions for every distinct pod (no
@@ -2009,7 +2041,7 @@ class DeviceStateManager:
                     ks = self._kind(kind)
                     prow = ks.index.pod_row(pod.key)
                     if prow is not None:
-                        cols = np.nonzero(ks.index.mask[prow, : ks.tcap])[0]
+                        cols = ks.index.row_cols(prow)
                     else:
                         # pending pod not yet stored: compiled-row match,
                         # same path as check_pod's PreFilter case
@@ -2153,7 +2185,7 @@ class DeviceStateManager:
                                 row = ks.index.pod_row(pod_key)
                                 if row is None:
                                     continue
-                                if ks.count_in[row] and ks.index.mask[row, col]:
+                                if ks.count_in[row] and ks.index.row_has_col(row, col):
                                     pod = ks.index.indexed_pod(pod_key)
                                     if pod is not None:
                                         unres.append(pod)
@@ -2337,7 +2369,7 @@ class DeviceStateManager:
                 row_req, row_present = self._encoded_row(ks, pod)
                 prow = ks.index.pod_row(pod.key)
                 if prow is not None:
-                    mask_row = ks.index.mask[prow : prow + 1, :].copy()
+                    mask_row = ks.index.mask_rows(np.array([prow]))
                 else:
                     # pod not (yet) in the store — the PreFilter common case:
                     # evaluate its row via the index's compiled columns
@@ -2469,7 +2501,7 @@ class DeviceStateManager:
                 row_req, row_present = self._encoded_row(ks, pod)
                 prow = ks.index.pod_row(pod.key)
                 if prow is not None:
-                    cols = np.nonzero(ks.index.mask[prow, :tcap])[0]
+                    cols = ks.index.row_cols(prow)
                 else:
                     with ks.index._lock:  # noqa: SLF001 — same-package access
                         rowm = ks.index.match_row_cached_locked(pod) & ks.index._thr_valid

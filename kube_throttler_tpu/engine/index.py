@@ -25,6 +25,24 @@ updates.
 Capacity management: arrays grow geometrically and rows/columns are
 free-listed, so the mask object handed to the device keeps a stable shape
 between growth events (no kernel recompilation on object churn).
+
+Storage (PR 11): the match matrix is SPARSE — per-row sorted matched-col
+arrays ``int32[pcap, kcap]`` (sentinel-padded) with per-row counts,
+where ``kcap`` tracks the max per-pod match count. A dense ``[P, T]``
+bool plane is 100 GB at the 1M-pod × 100k-throttle target; the sparse
+rows are ~K×4 bytes/pod and double as the device's ``[P, K]`` cols
+encoding directly. ``mask`` remains available as a property that
+materializes the dense plane on demand (tests, the dense-kernel batch
+route at small scale); hot consumers read the sparse accessors
+(``row_cols`` / ``rows_of_col`` / ``row_cols_block``).
+
+Object retention (PR 11): with a ``pod_resolver`` wired (the columnar
+store's ``materialize_pod``), the index retains NO Pod objects — per row
+it keeps only the key, namespace, and a reference to the store's shared
+interned labels dict. The rare consumers that need a full object
+(general-tier selector evaluation, ``matched_pods``) materialize through
+the resolver at call time. Without a resolver (standalone use) the
+index retains event objects exactly as before.
 """
 
 from __future__ import annotations
@@ -48,6 +66,9 @@ AnyThrottle = Union[Throttle, ClusterThrottle]
 
 _MISSING = -1  # pod lacks the label key
 _ANY = -2  # term does not constrain this key
+# sparse-row padding sentinel: sorts AFTER every valid column id, so a
+# plain ascending sort keeps valid cols as a sorted prefix
+_SENT = np.iinfo(np.int32).max
 
 
 class _Interner:
@@ -90,6 +111,9 @@ class SelectorIndex:
         "_gen": "self._lock",
         "_pod_rows": "self._lock",
         "_row_pods": "self._lock",
+        "_row_keys": "self._lock",
+        "_row_ns_names": "self._lock",
+        "_row_labels": "self._lock",
         "_row_prev": "self._lock",
         "_free_rows": "self._lock",
         "_pcap": "self._lock",
@@ -106,7 +130,6 @@ class SelectorIndex:
         "_thr_valid": "self._lock",
         "_namespaces": "self._lock",
         "_ns_label_ids": "self._lock",
-        "mask": "self._lock",
     }
 
     def __init__(
@@ -115,14 +138,21 @@ class SelectorIndex:
         pod_capacity: int = 64,
         throttle_capacity: int = 16,
         use_native: bool = True,
+        interner=None,
     ):
         assert kind in ("throttle", "clusterthrottle")
         self.kind = kind
         self._lock = make_rlock(f"index.{kind}")
 
-        self._values = _Interner()
+        # label keys+values share one pool with the store's arena when the
+        # owner wires it (``interner``) — one interning per string per
+        # process instead of one per kind
+        self._values = interner if interner is not None else _Interner()
         self._ns_ids = _Interner()
-        self._key_ids = _Interner()
+        self._key_ids = self._values if interner is not None else _Interner()
+        # columnar-store materializer (Store.materialize_pod). When set,
+        # the index retains NO pod objects (see module docstring).
+        self.pod_resolver: Optional[callable] = None
 
         # probe-row cache for NOT-stored pods (the PreFilter common case):
         # a selector match depends only on (namespace, labels), and the
@@ -142,7 +172,15 @@ class SelectorIndex:
 
         # pods
         self._pod_rows: Dict[str, int] = {}
+        # row → retained event object (LEGACY/standalone mode only: with a
+        # pod_resolver the index retains no objects and the three light
+        # row-meta dicts below carry what matching needs — the key, the
+        # namespace name, and a reference to the store's SHARED interned
+        # labels dict)
         self._row_pods: Dict[int, Pod] = {}
+        self._row_keys: Dict[int, str] = {}
+        self._row_ns_names: Dict[int, str] = {}
+        self._row_labels: Dict[int, dict] = {}
         # single-slot previous (row, object, mask-row) cache: lets the
         # MODIFIED handler's old-side affected query reuse the row the index
         # JUST replaced instead of re-evaluating T columns. One slot is
@@ -177,7 +215,120 @@ class SelectorIndex:
         # interned {key_id: value_id} per namespace, for the native row path
         self._ns_label_ids: Dict[str, Dict[int, int]] = {}
 
-        self.mask = np.zeros((self._pcap, self._tcap), dtype=bool)
+        # sparse match matrix: per-row SORTED matched cols (sentinel-padded)
+        # + per-row counts; kcap tracks the max per-pod match count.
+        # DELIBERATELY outside the GUARDED_BY table: mutations all run
+        # under self._lock, but the public sparse accessors below read
+        # lock-free under the owner's single-mutator coherence — exactly
+        # the stance external consumers of the former dense ``mask``
+        # plane took (devicestate reads these under ITS main lock, which
+        # serializes against the mutating dispatch path).
+        self._kcap = 8
+        self._row_cols = np.full((self._pcap, self._kcap), _SENT, dtype=np.int32)
+        self._row_n = np.zeros(self._pcap, dtype=np.int32)
+
+    @property
+    def mask(self) -> np.ndarray:
+        """Dense ``bool[pcap, tcap]`` materialized from the sparse rows —
+        compatibility/readout surface (tests, the dense-kernel device
+        mask). O(P×T) memory: production hot paths use the sparse
+        accessors instead (``row_cols`` / ``rows_of_col`` / ...)."""
+        with self._lock:
+            dense = np.zeros((self._pcap, self._tcap), dtype=bool)
+            valid = self._row_cols != _SENT
+            if valid.any():
+                rows = np.nonzero(valid)[0]
+                dense[rows, self._row_cols[valid]] = True
+            return dense
+
+    # ----------------------------------------------------- sparse row plane
+
+    def _grow_k_locked(self) -> None:
+        new_k = self._kcap * 2
+        grown = np.full((self._row_cols.shape[0], new_k), _SENT, dtype=np.int32)
+        grown[:, : self._kcap] = self._row_cols
+        self._row_cols = grown
+        self._kcap = new_k
+
+    def _set_row_sparse_locked(self, row: int, cols: np.ndarray) -> None:
+        """Replace one row's matched-col set (``cols`` sorted ascending,
+        no sentinel)."""
+        n = int(cols.size)
+        while n > self._kcap:
+            self._grow_k_locked()
+        rc = self._row_cols[row]
+        rc[:] = _SENT
+        rc[:n] = cols
+        self._row_n[row] = n
+
+    def _rows_of_col_locked(self, col: int) -> np.ndarray:
+        """Rows currently containing ``col`` — O(P×kcap) vectorized scan
+        (column membership changes are the rare direction; rows are the
+        hot one)."""
+        return np.nonzero((self._row_cols == col).any(axis=1))[0]
+
+    def _set_col_sparse_locked(self, col: int, match: np.ndarray) -> None:
+        """Make column ``col``'s membership equal ``match`` (bool[pcap])
+        by diffing against the rows that currently hold it."""
+        new_rows = np.flatnonzero(match[: self._row_cols.shape[0]])
+        old_rows = self._rows_of_col_locked(col)
+        remove = np.setdiff1d(old_rows, new_rows, assume_unique=True)
+        insert = np.setdiff1d(new_rows, old_rows, assume_unique=True)
+        if remove.size:
+            sub = self._row_cols[remove]
+            sub[sub == col] = _SENT
+            sub.sort(axis=1)
+            self._row_cols[remove] = sub
+            self._row_n[remove] -= 1
+        if insert.size:
+            while int(self._row_n[insert].max()) + 1 > self._kcap:
+                self._grow_k_locked()
+            sub = self._row_cols[insert]
+            sub[np.arange(insert.size), self._row_n[insert]] = col
+            sub.sort(axis=1)
+            self._row_cols[insert] = sub
+            self._row_n[insert] += 1
+
+    # public sparse accessors (devicestate's hot-path reads; all return
+    # COPIES unless noted — callers run outside this lock by the same
+    # single-mutator coherence the dense mask relied on)
+
+    def row_cols(self, row: int) -> np.ndarray:
+        """Sorted matched cols of one row (copy)."""
+        return self._row_cols[row, : self._row_n[row]].copy()
+
+    def rows_of_col(self, col: int) -> np.ndarray:
+        return np.nonzero((self._row_cols == col).any(axis=1))[0]
+
+    def row_has_col(self, row: int, col: int) -> bool:
+        n = int(self._row_n[row])
+        rc = self._row_cols[row, :n]
+        i = int(np.searchsorted(rc, col))
+        return i < n and rc[i] == col
+
+    def row_cols_block(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(cols block with sentinel padding, per-row counts) for a set of
+        rows — the aggregate-rebase gather."""
+        return self._row_cols[rows], self._row_n[rows]
+
+    def sparse_snapshot(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(row_cols, row_n, kcap) LIVE references — the device cols
+        rebuild reads them under the owner's coherence rules."""
+        return self._row_cols, self._row_n, self._kcap
+
+    def nnz_max(self) -> int:
+        return int(self._row_n.max()) if self._row_n.size else 0
+
+    def mask_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Dense bool[len(rows), tcap] of the given rows."""
+        with self._lock:
+            out = np.zeros((len(rows), self._tcap), dtype=bool)
+            sub = self._row_cols[rows]
+            valid = sub != _SENT
+            if valid.any():
+                rr = np.nonzero(valid)[0]
+                out[rr, sub[valid]] = True
+            return out
 
     # ------------------------------------------------------------------ pods
 
@@ -204,9 +355,12 @@ class SelectorIndex:
                 grown = np.full(new_cap, _MISSING, dtype=np.int32)
                 grown[: self._pcap] = arr
                 store[key] = grown
-        grown_mask = np.zeros((new_cap, self._tcap), dtype=bool)
-        grown_mask[: self._pcap] = self.mask
-        self.mask = grown_mask
+        grown_rc = np.full((new_cap, self._kcap), _SENT, dtype=np.int32)
+        grown_rc[: self._pcap] = self._row_cols
+        self._row_cols = grown_rc
+        grown_n = np.zeros(new_cap, dtype=np.int32)
+        grown_n[: self._pcap] = self._row_n
+        self._row_n = grown_n
         self._pcap = new_cap
 
     def _upsert_pod_locked(self, pod: Pod) -> Tuple[int, bool]:
@@ -215,7 +369,8 @@ class SelectorIndex:
         not have moved the mask row (labels+namespace unchanged)."""
         assert_held(self._lock, "SelectorIndex._upsert_pod_locked")
         row = self._pod_rows.get(pod.key)
-        if row is None:
+        fresh = row is None
+        if fresh:
             if self._free_rows:
                 row = self._free_rows.pop()
             else:
@@ -223,10 +378,20 @@ class SelectorIndex:
                 while row >= self._pcap:
                     self._grow_pods_locked()
             self._pod_rows[pod.key] = row
-        prev = self._row_pods.get(row)
-        if prev is not None and prev is not pod:
-            self._row_prev = (row, prev, self.mask[row, : self._tcap].copy())
-        self._row_pods[row] = pod
+        if self.pod_resolver is None:
+            prev = self._row_pods.get(row)
+            if prev is not None and prev is not pod:
+                self._row_prev = (row, prev, self.row_cols(row))
+            self._row_pods[row] = pod
+            prev_labels = prev.labels if prev is not None else None
+            prev_ns = prev.namespace if prev is not None else None
+        else:
+            # no-retention mode: remember only (key, ns, shared-labels-ref)
+            prev_labels = None if fresh else self._row_labels.get(row)
+            prev_ns = None if fresh else self._row_ns_names.get(row)
+            self._row_keys[row] = pod.key
+            self._row_ns_names[row] = pod.namespace
+            self._row_labels[row] = pod.labels
         self._pod_valid[row] = True
 
         # Selector matching reads only (pod.labels, pod.namespace) — the
@@ -234,11 +399,13 @@ class SelectorIndex:
         # upsert_namespace, which recomputes affected rows itself. So a
         # pod update that changes neither (the dominant churn shape:
         # requests/status-only updates) cannot flip this mask row, and
-        # the O(T) column sweep is skipped entirely.
+        # the O(T) column sweep is skipped entirely. With an arena-backed
+        # store the labels compare is usually an identity hit (shared
+        # interned dicts).
         if (
-            prev is not None
-            and prev.labels == pod.labels
-            and prev.namespace == pod.namespace
+            prev_labels is not None
+            and (prev_labels is pod.labels or prev_labels == pod.labels)
+            and prev_ns == pod.namespace
         ):
             return row, False
 
@@ -269,7 +436,7 @@ class SelectorIndex:
         with self._lock:
             row, recompute = self._upsert_pod_locked(pod)
             if recompute:
-                self._recompute_row_locked(row)
+                self._recompute_row_locked(row, pod=pod)
             return row
 
     def upsert_pods_batch(self, pods: Sequence[Pod]) -> List[int]:
@@ -283,14 +450,14 @@ class SelectorIndex:
         input order."""
         with self._lock:
             rows: List[int] = []
-            pending: List[int] = []
+            pending: List[Tuple[int, Pod]] = []
             for pod in pods:
                 row, recompute = self._upsert_pod_locked(pod)
                 rows.append(row)
                 if recompute:
-                    pending.append(row)
-            for row in pending:
-                self._recompute_row_locked(row)
+                    pending.append((row, pod))
+            for row, pod in pending:
+                self._recompute_row_locked(row, pod=pod)
             return rows
 
     def remove_pod(self, pod_key: str) -> None:
@@ -299,10 +466,14 @@ class SelectorIndex:
             if row is None:
                 return
             self._row_pods.pop(row, None)
+            self._row_keys.pop(row, None)
+            self._row_ns_names.pop(row, None)
+            self._row_labels.pop(row, None)
             if self._row_prev is not None and self._row_prev[0] == row:
                 self._row_prev = None
             self._pod_valid[row] = False
-            self.mask[row, :] = False
+            self._row_cols[row, :] = _SENT
+            self._row_n[row] = 0
             self._free_rows.append(row)
 
     # ------------------------------------------------------------- throttles
@@ -346,9 +517,7 @@ class SelectorIndex:
         grown_valid = np.zeros(new_cap, dtype=bool)
         grown_valid[: self._tcap] = self._thr_valid
         self._thr_valid = grown_valid
-        grown_mask = np.zeros((self._pcap, new_cap), dtype=bool)
-        grown_mask[:, : self._tcap] = self.mask
-        self.mask = grown_mask
+        # sparse rows carry column IDS — tcap growth changes nothing there
         self._tcap = new_cap
         if self._native is not None:
             self._native.reserve(new_cap)
@@ -363,7 +532,7 @@ class SelectorIndex:
             self._col_keys.pop(col, None)
             self._thr_valid[col] = False
             self._row_prev = None  # compiled columns changed
-            self.mask[:, col] = False
+            self._set_col_sparse_locked(col, np.zeros(self._pcap, dtype=bool))
             self._free_cols.append(col)
             if self._native is not None:
                 self._native.clear_col(col)
@@ -388,7 +557,6 @@ class SelectorIndex:
             rows = np.nonzero(self._pod_valid & (self._pod_ns == ns_id))[0]
             self._pod_ns_exists[rows] = True
             for row in rows:
-                pod = self._row_pods[row]
                 seen: Set[str] = set()
                 for key, value in ns.labels.items():
                     self._pod_col_array_locked(self._ns_label, key)[row] = self._values.id_of(value)
@@ -419,7 +587,8 @@ class SelectorIndex:
             # gate ktnative.cpp ns_exists; _match_one_locked/_eval_general_locked ns None),
             # so the rows' recompute result is provably all-False — clear
             # vectorized instead of O(rows × T) selector evaluations
-            self.mask[rows, :] = False
+            self._row_cols[rows, :] = _SENT
+            self._row_n[rows] = 0
 
     # ------------------------------------------------------------- recompute
 
@@ -484,10 +653,22 @@ class SelectorIndex:
         except SelectorError:
             match = np.zeros(self._pcap, dtype=bool)
             for key, row in self._pod_rows.items():
-                match[row] = self._eval_general_locked(thr, self._row_pods[row])
+                pod = self._resolve_row_pod_locked(row)
+                if pod is not None:
+                    match[row] = self._eval_general_locked(thr, pod)
         if isinstance(thr, Throttle):
             match &= self._pod_ns == self._ns_ids.id_of(thr.namespace)
-        self.mask[:, col] = match
+        self._set_col_sparse_locked(col, match)
+
+    def _resolve_row_pod_locked(self, row: int) -> Optional[Pod]:
+        """The row's full Pod object: the retained one (legacy mode) or a
+        lazy materialization through the store resolver (rare paths —
+        general-tier evaluation, matched_pods)."""
+        pod = self._row_pods.get(row)
+        if pod is not None or self.pod_resolver is None:
+            return pod
+        key = self._row_keys.get(row)
+        return self.pod_resolver(key) if key is not None else None
 
     _NATIVE_OPS = {
         "In": NativeRowEngine.OP_IN,
@@ -545,27 +726,46 @@ class SelectorIndex:
     def _match_row_arbitrary_locked(self, pod: Pod) -> np.ndarray:
         """Evaluate a pod (not necessarily stored) against every compiled
         column → bool[tcap]. Native C++ tier when available."""
+        return self._match_parts_locked(pod.namespace, pod.labels, lambda: pod)
+
+    def _match_parts_locked(self, ns_name: str, labels: dict, pod_supplier) -> np.ndarray:
+        """Row evaluation from its matching INPUTS — (namespace, labels) —
+        so the no-retention index can recompute a stored row without
+        materializing its pod. ``pod_supplier`` produces the full object
+        only when a column needs the general tier (invalid selectors) or
+        the pure-Python fallback; it may return None (row reads
+        no-match)."""
         if self._native is not None:
-            ns = self._namespaces.get(pod.namespace)
+            ns = self._namespaces.get(ns_name)
             pod_labels = {
-                self._key_ids.id_of(k): self._values.id_of(v) for k, v in pod.labels.items()
+                self._key_ids.id_of(k): self._values.id_of(v) for k, v in labels.items()
             }
-            ns_labels = self._ns_label_ids.get(pod.namespace)
+            ns_labels = self._ns_label_ids.get(ns_name)
             if ns_labels is None:
                 ns_labels = {
                     self._key_ids.id_of(k): self._values.id_of(v)
                     for k, v in (ns.labels if ns else {}).items()
                 }
-                self._ns_label_ids[pod.namespace] = ns_labels
+                self._ns_label_ids[ns_name] = ns_labels
             match, general = self._native.match_row(
-                self._ns_ids.id_of(pod.namespace), ns is not None, pod_labels, ns_labels
+                self._ns_ids.id_of(ns_name), ns is not None, pod_labels, ns_labels
             )
             out = np.zeros(self._tcap, dtype=bool)
             out[: len(match)] = match.astype(bool)
-            for col in np.nonzero(general)[0]:
-                out[col] = self._eval_general_locked(self._col_thrs[int(col)], pod)
+            gen_cols = np.nonzero(general)[0]
+            if gen_cols.size:
+                pod = pod_supplier()
+                for col in gen_cols:
+                    out[col] = (
+                        self._eval_general_locked(self._col_thrs[int(col)], pod)
+                        if pod is not None
+                        else False
+                    )
             return out
         out = np.zeros(self._tcap, dtype=bool)
+        pod = pod_supplier()
+        if pod is None:
+            return out
         for key, col in self._thr_cols.items():
             out[col] = self._match_one_locked(self._col_thrs[col], pod)
         return out
@@ -595,8 +795,22 @@ class SelectorIndex:
             self._probe_cache.popitem(last=False)
         return row
 
-    def _recompute_row_locked(self, row: int) -> None:
-        self.mask[row, :] = self._match_row_arbitrary_locked(self._row_pods[row])
+    def _recompute_row_locked(self, row: int, pod: Optional[Pod] = None) -> None:
+        """Re-match one stored row. ``pod`` (the upsert paths always have
+        it in hand) short-circuits any materialization; without it the
+        matching inputs come from the row meta and the full object is
+        resolved lazily only if a general-tier column demands it."""
+        if pod is not None:
+            match = self._match_row_arbitrary_locked(pod)
+        elif self.pod_resolver is None:
+            match = self._match_row_arbitrary_locked(self._row_pods[row])
+        else:
+            match = self._match_parts_locked(
+                self._row_ns_names.get(row, ""),
+                self._row_labels.get(row, {}),
+                lambda: self._resolve_row_pod_locked(row),
+            )
+        self._set_row_sparse_locked(row, np.flatnonzero(match).astype(np.int32))
 
     def _match_one_locked(self, thr: AnyThrottle, pod: Pod) -> bool:
         """Single-pair oracle used by row recompute AND external callers
@@ -653,7 +867,7 @@ class SelectorIndex:
             row = self._pod_rows.get(pod_key)
             if row is None:
                 return []
-            cols = np.nonzero(self.mask[row, : self._tcap])[0]
+            cols = self._row_cols[row, : self._row_n[row]]
             ck = self._col_keys
             return [ck[c] for c in cols.tolist() if c in ck]
 
@@ -669,14 +883,25 @@ class SelectorIndex:
             if not self._col_thrs:
                 return []
             row = self._pod_rows.get(pod.key)
-            if row is not None and self._row_pods.get(row) is pod:
-                cols = np.nonzero(self.mask[row, : self._tcap])[0]
+            if row is not None and (
+                self._row_pods.get(row) is pod
+                # no-retention identity: the arena canonicalizes labels to
+                # shared dicts, so the current row version is recognizable
+                # by (labels identity, namespace) without keeping the object
+                or (
+                    self.pod_resolver is not None
+                    and self._row_labels.get(row) is pod.labels
+                    and self._row_ns_names.get(row) == pod.namespace
+                )
+            ):
+                cols = self._row_cols[row, : self._row_n[row]]
             else:
                 prev = self._row_prev
                 if prev is not None and prev[0] == row and prev[1] is pod:
                     # the old side of the MODIFIED event the index just
                     # processed: its row was saved before the overwrite
-                    cols = np.nonzero(prev[2] & self._thr_valid[: prev[2].shape[0]])[0]
+                    pc = prev[2]
+                    cols = pc[self._thr_valid[pc]]
                 else:
                     cols = np.nonzero(self.match_row_cached_locked(pod) & self._thr_valid)[0]
             ck = self._col_keys
@@ -688,23 +913,44 @@ class SelectorIndex:
             col = self._thr_cols.get(throttle_key)
             if col is None:
                 return []
-            rows = np.nonzero(self.mask[: self._pcap, col])[0]
+            rows = self._rows_of_col_locked(col)
+            if self.pod_resolver is not None:
+                rk = self._row_keys
+                return [rk[int(r)] for r in rows if int(r) in rk]
             row_to_key = {row: key for key, row in self._pod_rows.items()}
             return [row_to_key[r] for r in rows if r in row_to_key]
 
     def matched_pods(self, throttle_key: str) -> List[Pod]:
-        """The indexed Pod objects matching a throttle (latest store state)."""
+        """The indexed Pod objects matching a throttle (latest store
+        state). In no-retention mode the objects are materialized through
+        the resolver OUTSIDE the index lock (lock order: the resolver
+        takes the store lock, which must never nest inside this one)."""
+        keys: Optional[List[str]] = None
         with self._lock:
             col = self._thr_cols.get(throttle_key)
             if col is None:
                 return []
-            rows = np.nonzero(self.mask[: self._pcap, col])[0]
-            return [self._row_pods[int(r)] for r in rows if int(r) in self._row_pods]
+            rows = self._rows_of_col_locked(col)
+            if self.pod_resolver is None:
+                return [self._row_pods[int(r)] for r in rows if int(r) in self._row_pods]
+            rk = self._row_keys
+            keys = [rk[int(r)] for r in rows if int(r) in rk]
+        out = []
+        for key in keys:
+            pod = self.pod_resolver(key)
+            if pod is not None:
+                out.append(pod)
+        return out
 
     def indexed_pod(self, pod_key: str) -> Optional[Pod]:
         with self._lock:
             row = self._pod_rows.get(pod_key)
-            return self._row_pods.get(row) if row is not None else None
+            if row is None:
+                return None
+            pod = self._row_pods.get(row)
+            if pod is not None or self.pod_resolver is None:
+                return pod
+        return self.pod_resolver(pod_key)
 
     def mask_cell(self, pod_key: str, throttle_key: str) -> bool:
         """Does the indexed pod currently match the throttle?"""
@@ -713,7 +959,7 @@ class SelectorIndex:
             col = self._thr_cols.get(throttle_key)
             if row is None or col is None:
                 return False
-            return bool(self.mask[row, col])
+            return self.row_has_col(row, col)
 
     def pod_row(self, pod_key: str) -> Optional[int]:
         with self._lock:

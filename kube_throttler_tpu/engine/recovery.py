@@ -150,6 +150,16 @@ class RecoveryManager:
             elif kind == "Pod":
                 store.create_pod(obj)
             self.report.snapshot_objects += 1
+        # v2 columnar pod block (pods absent from "objects"); v1 snapshots
+        # (pre-bump fixtures and mixed-version restarts) recover through
+        # the objects walk above — both paths land in the same store state
+        block = payload.get("podColumns")
+        if block:
+            from .columnar import pods_from_columns
+
+            for pod in pods_from_columns(block):
+                store.create_pod(pod)
+                self.report.snapshot_objects += 1
         store.advance_resource_version_to(int(payload.get("rv", 0)))
 
     def recover_store(self, store: Store) -> StoreJournal:

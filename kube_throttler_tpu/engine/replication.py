@@ -550,6 +550,16 @@ class StandbyReplicator:
             obj = object_from_dict(d)
             want.setdefault(kind, set()).add(key_of(kind, obj))
             ops.append(("upsert", kind, obj))
+        # snapshot v2 ships pods as a columnar block instead of manifest
+        # dicts; materialize through the shared reader so the standby's
+        # apply path (events → its own journal) stays unchanged
+        block = payload.get("podColumns")
+        if block:
+            from .columnar import pods_from_columns
+
+            for pod in pods_from_columns(block):
+                want.setdefault("Pod", set()).add(key_of("Pod", pod))
+                ops.append(("upsert", "Pod", pod))
         # a RESTARTED standby recovers its previous replicated state first;
         # anything it holds that the leader's snapshot no longer carries
         # was deleted while we were down — drop it BEFORE the upserts, or

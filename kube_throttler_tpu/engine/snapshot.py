@@ -59,7 +59,14 @@ from .store import Store
 logger = logging.getLogger(__name__)
 
 SNAPSHOT_FORMAT = "kube-throttler-snapshot"
-SNAPSHOT_VERSION = 1
+# v1: every object (pods included) as manifest dicts in "objects".
+# v2 (PR 11): pods move to a COLUMNAR block ("podColumns" — local string
+# table + interned shape tables + per-pod id rows, engine/columnar.py) —
+# ~30 bytes/pod instead of ~1 KB and no per-pod materialization on the
+# write path. Readers accept both; writers emit v2 (with pods staying in
+# "objects" only when the store runs the frozen-dict reference mode).
+SNAPSHOT_VERSION = 2
+SUPPORTED_SNAPSHOT_VERSIONS = (1, 2)
 
 _NAME_RE = re.compile(r"^snapshot-(\d{12})\.ktsnap$")
 
@@ -101,7 +108,7 @@ def parse_snapshot_bytes(blob: bytes, origin: str = "<bytes>") -> dict:
         raise SnapshotError(f"bad snapshot header in {origin}: {e}") from e
     if header.get("format") != SNAPSHOT_FORMAT:
         raise SnapshotError(f"{origin}: not a {SNAPSHOT_FORMAT} file")
-    if header.get("version") != SNAPSHOT_VERSION:
+    if header.get("version") not in SUPPORTED_SNAPSHOT_VERSIONS:
         raise SnapshotError(
             f"{origin}: unsupported snapshot version {header.get('version')!r}"
         )
@@ -223,8 +230,15 @@ class SnapshotManager:
                 objs.append(object_to_dict(thr))
             for thr in self.store.list_cluster_throttles():
                 objs.append(object_to_dict(thr))
-            for pod in self.store.list_pods():
-                objs.append(object_to_dict(pod))
+            arena = self.store.pod_arena
+            pod_columns = None
+            if arena is not None:
+                # v2 columnar pod block: exported straight from the arena
+                # (no per-pod materialization on the snapshot path)
+                pod_columns = arena.export_columns(list(arena.keys()))
+            else:
+                for pod in self.store.list_pods():
+                    objs.append(object_to_dict(pod))
             epoch = 0
             if self.fencing is not None:
                 epoch = self.fencing.current()
@@ -247,6 +261,7 @@ class SnapshotManager:
                     "takenAt": now.isoformat(),
                     "rv": self.store.latest_resource_version,
                     "objects": objs,
+                    **({"podColumns": pod_columns} if pod_columns is not None else {}),
                     "reservations": {
                         kind: cache.snapshot_state(now)
                         for kind, cache in self.reservations.items()

@@ -19,6 +19,7 @@ Store contents are immutable-by-convention: mutators replace whole objects
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 from enum import Enum
@@ -27,8 +28,61 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 from ..api.pod import Namespace, Pod
 from ..api.types import ClusterThrottle, Throttle
 from ..utils.lockorder import assert_held, make_rlock
+from .columnar import ColumnarEventFrame, PodArena
 
 KObject = Union[Pod, Namespace, Throttle, ClusterThrottle]
+
+
+class _ColumnarPodMap:
+    """Dict-shaped facade over a :class:`PodArena`: the store's mutation
+    code keeps its exact ``self._objects["Pod"]`` surface (contains /
+    get / setitem / pop / values), but writes absorb into columns and
+    reads materialize full objects lazily — the arena IS the store for
+    pods. Only touched under the store lock, like the dicts it
+    replaces."""
+
+    __slots__ = ("arena",)
+
+    def __init__(self, arena: PodArena) -> None:
+        self.arena = arena
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.arena
+
+    def __len__(self) -> int:
+        return len(self.arena)
+
+    def __setitem__(self, key: str, pod: Pod) -> None:
+        self.arena.absorb(key, pod)
+
+    def get(self, key: str, default=None):
+        pod = self.arena.materialize_key(key)
+        return pod if pod is not None else default
+
+    def pop(self, key: str, default=None):
+        pod = self.arena.materialize_key(key)
+        if pod is None:
+            return default
+        self.arena.free(key)
+        return pod
+
+    def keys(self):
+        return self.arena.keys()
+
+    def values(self):
+        # generator (not a view): both consumers — handler replay and
+        # _list's list() — iterate once; a 1M-pod store must not
+        # materialize a second full object list just to iterate
+        for key in list(self.arena.keys()):
+            pod = self.arena.materialize_key(key)
+            if pod is not None:
+                yield pod
+
+
+def columnar_default() -> bool:
+    """Columnar pods are the default; ``KT_STORE_COLUMNAR=0`` keeps the
+    frozen-dict reference path alive (the equivalence-sweep oracle)."""
+    return os.environ.get("KT_STORE_COLUMNAR", "1") != "0"
 
 
 class ConflictError(Exception):
@@ -96,10 +150,17 @@ class Store:
     # while keeping the per-drain amortization (~chunk× fewer acquires).
     STATUS_WRITE_CHUNK = 64
 
-    def __init__(self) -> None:
+    def __init__(self, columnar: Optional[bool] = None) -> None:
         self._lock = make_rlock("store")
         self._rv = 0
+        # pods live in the columnar arena (interned struct-of-arrays,
+        # lazily materialized at the API edge) unless the frozen-dict
+        # reference mode is forced — see engine/columnar.py
+        self.columnar = columnar_default() if columnar is None else bool(columnar)
+        self.pod_arena: Optional[PodArena] = PodArena() if self.columnar else None
         self._objects: Dict[str, Dict[str, KObject]] = {k: {} for k in self.KINDS}
+        if self.pod_arena is not None:
+            self._objects["Pod"] = _ColumnarPodMap(self.pod_arena)
         self._versions: Dict[str, Dict[str, int]] = {k: {} for k in self.KINDS}
         self._handlers: Dict[str, List[Handler]] = {k: [] for k in self.KINDS}
         # batch-aware subscribers (journal, device mirror, informers, batch
@@ -181,8 +242,18 @@ class Store:
         assert_held(self._lock, "Store._dispatch_batch_locked")
         if not events:
             return
+        frame = None
         for listener in list(self._batch_listeners):
-            listener.on_batch(events)
+            on_frame = getattr(listener, "on_frame", None)
+            if on_frame is not None:
+                # columnar batch payload (engine/columnar.py): built once
+                # per batch, only when some listener asked for it — flat
+                # verb/kind/key/rv/slot columns instead of object events
+                if frame is None:
+                    frame = ColumnarEventFrame(events, _key_of, self.pod_arena)
+                on_frame(frame, events)
+            else:
+                listener.on_batch(events)
         self._in_batch_dispatch = True
         try:
             for event in events:
@@ -299,6 +370,18 @@ class Store:
             event = self._delete_locked(kind, key)
             self._dispatch_locked(event)
         return event.obj
+
+    def materialize_pod(self, pod_key: str) -> Optional[Pod]:
+        """Resolver handed to the selector indexes (SelectorIndex.pod_resolver)
+        so they stop retaining per-pod objects: rare consumers (general-tier
+        selector evaluation, matched_pods) materialize on demand. Takes
+        ONLY the arena's leaf lock — callers hold index/devicestate locks,
+        and nesting the store lock inside those would invert the
+        store→index order."""
+        if self.pod_arena is not None:
+            return self.pod_arena.materialize_key(pod_key)
+        with self._lock:
+            return self._objects["Pod"].get(pod_key)
 
     def _get(self, kind: str, key: str) -> KObject:
         with self._lock:

@@ -119,6 +119,13 @@ METRIC_NAMES = frozenset(
         "kube_throttler_shard_scatter_duration_seconds",
         "kube_throttler_shard_route_misses_total",
         "kube_throttler_shard_two_phase_aborts_total",
+        # columnar arena store (register_store_metrics / engine/columnar.py):
+        # slot population/recycling, intern-pool growth, and how often the
+        # lazy edge materializes full API objects
+        "kube_throttler_store_arena_slots_live",
+        "kube_throttler_store_arena_slots_recycled_total",
+        "kube_throttler_store_intern_pool_size",
+        "kube_throttler_store_materializations_total",
     }
 )
 
@@ -775,6 +782,49 @@ def register_ingest_metrics(registry: Registry, pipeline) -> None:
         "events ingested through the micro-batch pipeline",
         [],
     )
+
+
+def register_store_metrics(registry: Registry, store) -> None:
+    """Columnar arena observability (engine/columnar.py), sampled from the
+    arena's counters at scrape time. Slots-live tracks the pod population;
+    recycled_total moving means delete churn is reusing slots (no arena
+    growth); intern-pool size growing without population growth means
+    label/value cardinality is climbing; materializations_total is the
+    lazy-edge hydration rate (the whole point of the arena is that this
+    stays proportional to API/serialization traffic, not event churn).
+    No-op for a frozen-dict reference store (no arena)."""
+    arena = getattr(store, "pod_arena", None)
+    if arena is None:
+        return
+    live_g = registry.gauge_vec(
+        "kube_throttler_store_arena_slots_live",
+        "pods resident in the columnar arena (slots occupied)",
+        [],
+    )
+    recycled_c = registry.counter_vec(
+        "kube_throttler_store_arena_slots_recycled_total",
+        "arena slots freed by pod deletion and returned to the free list",
+        [],
+    )
+    intern_g = registry.gauge_vec(
+        "kube_throttler_store_intern_pool_size",
+        "distinct strings in the shared intern pool (names, namespaces, "
+        "uids, label keys+values)",
+        [],
+    )
+    mat_c = registry.counter_vec(
+        "kube_throttler_store_materializations_total",
+        "full API objects built at the lazy serialization/API edge",
+        [],
+    )
+
+    def flush() -> None:
+        live_g.set_key((), float(len(arena)))
+        recycled_c.set_key((), float(arena.recycled_total))
+        intern_g.set_key((), float(len(arena.pool)))
+        mat_c.set_key((), float(arena.materializations_total))
+
+    registry.register_pre_expose(flush)
 
 
 def register_watch_metrics(registry: Registry) -> None:

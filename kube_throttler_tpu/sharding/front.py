@@ -117,11 +117,20 @@ class AdmissionFront:
         self._txn_seq = 0
         self.route_misses = 0  # events destined for a down shard
         self.two_phase_aborts = 0  # single-writer per call path; approximate
-        # routing index: one SelectorIndex per kind, front-side only
+        # routing index: one SelectorIndex per kind, front-side only. With
+        # the columnar merged store the indexes share its intern pool and
+        # retain NO pod objects (resolved through the arena below) — this
+        # is what kills the front-side copy of the pod population, so
+        # full-scale RSS no longer multiplies with shard count
+        _arena = getattr(self.store, "pod_arena", None)
+        _interner = _arena.pool if _arena is not None else None
         self.index: Dict[str, SelectorIndex] = {
-            "Throttle": SelectorIndex("throttle"),
-            "ClusterThrottle": SelectorIndex("clusterthrottle"),
+            "Throttle": SelectorIndex("throttle", interner=_interner),
+            "ClusterThrottle": SelectorIndex("clusterthrottle", interner=_interner),
         }
+        if _arena is not None:
+            for idx in self.index.values():
+                idx.pod_resolver = self.store.materialize_pod
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, self.n_shards), thread_name_prefix="front-scatter"
         )

@@ -36,6 +36,19 @@ The treatment is the standard long-lived-heap posture (cf. Instagram's
 ``KT_GC_FREEZE=0`` disables the whole posture (the only reason to do so
 is debugging with ``gc.get_objects``, which cannot see the permanent
 generation).
+
+Re-measured on the PR 11 columnar arena heap: the store no longer holds
+a per-pod object graph (pods live in interned struct-of-arrays columns,
+materialized lazily at the API edge and freed by refcounting), so a
+full-scale 100k-pod serving heap drops from ~1.4M tracked objects to
+~150-300k — a full collection over it is tens of ms, not 500-750 ms.
+The posture is therefore CONDITIONAL now: ``freeze_startup_heap``
+measures the post-collect tracked-object count and only freezes +
+defers gen-2 when it exceeds ``KT_GC_FREEZE_MIN_OBJECTS`` (default
+200k — see the floor's comment for the churn measurement that set it).
+Below the floor the default generational GC is measurably cheaper than
+carrying a permanent generation, and ``gc.get_objects`` keeps working
+for debugging.
 """
 
 from __future__ import annotations
@@ -52,21 +65,51 @@ logger = logging.getLogger("kube_throttler_tpu")
 # run explicitly from the hygiene thread); gen0/gen1 defaults are kept
 _DEFERRED_GEN2_THRESHOLD = 1_000_000
 
+# tracked-object floor below which the freeze posture is skipped.
+# Re-measured on the columnar arena heap (bench --mega, 100k×10k rung):
+# the PER-POD object population is gone, but a serving stack still
+# carries ~300-400k tracked objects (throttle/status dataclasses,
+# kernel caches, runtime) and an unfrozen gen-2 pass over them pauses
+# ~300+ ms — churn throughput collapsed ~8× when the floor left that
+# heap unfrozen. So the posture RETIRES only for genuinely small heaps
+# (CLIs, tests, sub-10k-pod daemons land well under 200k); every real
+# serving heap still freezes.
+_DEFAULT_MIN_OBJECTS = 200_000
+
 
 def enabled() -> bool:
     return os.environ.get("KT_GC_FREEZE", "1") != "0"
 
 
+def freeze_min_objects() -> int:
+    try:
+        return int(os.environ.get("KT_GC_FREEZE_MIN_OBJECTS", _DEFAULT_MIN_OBJECTS))
+    except ValueError:
+        return _DEFAULT_MIN_OBJECTS
+
+
 def freeze_startup_heap() -> int:
-    """Collect-then-freeze the current heap and defer automatic gen-2
-    collections. Call once, after the daemon's stores/mirrors/caches are
+    """Collect, then freeze + defer gen-2 ONLY if the surviving tracked
+    heap is large enough for full-collection pauses to matter (see
+    module docstring — the columnar store keeps most serving heaps under
+    the floor). Call once, after the daemon's stores/mirrors/caches are
     built but before it takes traffic (the collection itself is the last
-    uncontrolled full-heap pause). Returns the frozen-object count, or -1
-    when disabled via KT_GC_FREEZE=0."""
+    uncontrolled full-heap pause). Returns the frozen-object count, 0
+    when the heap stayed below the floor (no freeze), or -1 when
+    disabled via KT_GC_FREEZE=0."""
     if not enabled():
         return -1
     t0 = time.perf_counter()
     gc.collect()
+    tracked = len(gc.get_objects())
+    floor = freeze_min_objects()
+    if tracked < floor:
+        logger.info(
+            "gc hygiene: %d tracked objects < %d floor — keeping default "
+            "generational GC (no freeze; collected in %.0fms)",
+            tracked, floor, (time.perf_counter() - t0) * 1e3,
+        )
+        return 0
     gc.freeze()
     g0, g1, _ = gc.get_threshold()
     gc.set_threshold(g0, g1, _DEFERRED_GEN2_THRESHOLD)

@@ -101,8 +101,13 @@ class TestSnapshotFile:
         assert payload["seq"] == 1 and payload["reason"] == "test"
         assert payload["rv"] == store.latest_resource_version
         kinds = [d["kind"] for d in payload["objects"]]
-        # namespaces first (replay creation-order dependency)
-        assert kinds[0] == "Namespace" and kinds.count("Pod") == 3
+        # namespaces first (replay creation-order dependency); pods live in
+        # the v2 columnar block, not the objects list
+        assert kinds[0] == "Namespace" and kinds.count("Pod") == 0
+        assert len(payload["podColumns"]["name"]) == 3
+        # every pod of one test shape interns to ONE request/label shape
+        assert len(payload["podColumns"]["requestShapes"]) == 1
+        assert len(payload["podColumns"]["labelShapes"]) == 1
         res = payload["reservations"]["throttle"]["default/t1"]["default/r1"]
         assert 0 < res["ttlRemainingSeconds"] <= 60.0
         off, sha = payload["journal"]["offset"], payload["journal"]["sha256"]

@@ -519,7 +519,7 @@ class TestGcHygiene:
         assert not gchygiene.enabled()
         assert gchygiene.freeze_startup_heap() == -1
 
-    def test_freeze_and_backstop_thread(self):
+    def test_freeze_and_backstop_thread(self, monkeypatch):
         import gc
 
         from kube_throttler_tpu.utils.gchygiene import (
@@ -527,6 +527,9 @@ class TestGcHygiene:
             freeze_startup_heap,
         )
 
+        # floor 1: any heap qualifies, so the freeze branch is exercised
+        # deterministically regardless of the test process's heap size
+        monkeypatch.setenv("KT_GC_FREEZE_MIN_OBJECTS", "1")
         thresholds = gc.get_threshold()
         try:
             frozen = freeze_startup_heap()
@@ -541,6 +544,26 @@ class TestGcHygiene:
             # don't leak the posture into the rest of the test process
             gc.set_threshold(*thresholds)
             gc.unfreeze()
+
+    def test_small_heap_skips_freeze(self, monkeypatch):
+        # the columnar-arena retune: below the tracked-object floor the
+        # posture is a no-op — default generational GC stays in charge
+        import gc
+
+        from kube_throttler_tpu.utils.gchygiene import freeze_startup_heap
+
+        monkeypatch.setenv("KT_GC_FREEZE_MIN_OBJECTS", str(1 << 40))
+        thresholds = gc.get_threshold()
+        frozen_before = gc.get_freeze_count()
+        assert freeze_startup_heap() == 0
+        assert gc.get_threshold() == thresholds  # gen2 NOT deferred
+        assert gc.get_freeze_count() == frozen_before
+
+    def test_malformed_floor_env_falls_back(self, monkeypatch):
+        from kube_throttler_tpu.utils.gchygiene import freeze_min_objects
+
+        monkeypatch.setenv("KT_GC_FREEZE_MIN_OBJECTS", "half-a-million")
+        assert freeze_min_objects() == 200_000
 
 
 class TestGatherChunkEnvGuard:

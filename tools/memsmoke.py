@@ -1,0 +1,110 @@
+"""Memory-regression smoke for the columnar arena store (`make ci`).
+
+Builds the REAL serving stack (store → device mirror → informers →
+controllers, workers parked) at 50k pods × 1k throttles and gates two
+per-pod marginals against committed bounds:
+
+- **heap objects per pod** — the columnar arena's whole point: a stored
+  pod must cost ~zero retained Python objects (measured 0.003/pod; the
+  frozen-dict model cost ~10/pod). The bound is deliberately loose (0.5)
+  so only a real regression — some layer quietly retaining per-pod
+  objects again — trips it, not allocator noise.
+- **RSS per pod** — arrays + interned strings + key maps (measured
+  ~2.5 KB/pod at 50k; bound 6 KB). A blown bound means a dense per-pod
+  structure crept back in (the dense [P,T] mask alone would be ~20 KB/pod
+  at this shape).
+
+Exit 0 on pass, 1 with a diff-style report on breach. Runs in ~15 s on
+one core; wired into hack/ci.sh after lint.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import resource
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+PODS = 50_000
+THROTTLES = 1_000
+GROUPS = 250
+
+# committed bounds (see module docstring for the measured baselines)
+MAX_HEAP_OBJECTS_PER_POD = 0.5
+MAX_RSS_BYTES_PER_POD = 6_144
+
+
+def _rss_kb() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("KT_LOCK_ASSERT", "0")
+    import random
+    from dataclasses import replace as _replace
+
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.engine.store import Store
+    from tools.harness import build_plugin, make_throttle
+
+    rng = random.Random(0)
+    store = Store()
+    if store.pod_arena is None:
+        print("memsmoke: store is in frozen-dict reference mode; skipping")
+        return 0
+    plugin = build_plugin(store)
+    store.create_namespace(Namespace("default"))
+    for i in range(THROTTLES):
+        store.create_throttle(_replace(make_throttle(i % 500), name=f"t{i}"))
+
+    gc.collect()
+    objs0, rss0 = len(gc.get_objects()), _rss_kb()
+    t0 = time.perf_counter()
+    for i in range(PODS):
+        pod = make_pod(
+            f"p{i}",
+            labels={"grp": f"g{rng.randrange(GROUPS)}"},
+            requests={"cpu": f"{rng.randrange(1, 8) * 100}m"},
+        )
+        pod = _replace(pod, spec=_replace(pod.spec, node_name="node-1"))
+        pod.status.phase = "Running"
+        store.create_pod(pod)
+    build_s = time.perf_counter() - t0
+    gc.collect()
+    objs_per_pod = (len(gc.get_objects()) - objs0) / PODS
+    rss_per_pod = (_rss_kb() - rss0) * 1024 / PODS
+
+    stats = store.pod_arena.stats()
+    print(
+        f"memsmoke: {PODS} pods x {THROTTLES} throttles in {build_s:.1f}s — "
+        f"{objs_per_pod:.3f} heap objects/pod (bound {MAX_HEAP_OBJECTS_PER_POD}), "
+        f"{rss_per_pod:.0f} B RSS/pod (bound {MAX_RSS_BYTES_PER_POD}); "
+        f"arena: {stats['slots_live']} slots, {stats['intern_pool_size']} interned, "
+        f"{stats['request_shapes']} request shapes"
+    )
+    ok = True
+    if objs_per_pod > MAX_HEAP_OBJECTS_PER_POD:
+        print(
+            f"memsmoke: FAIL heap objects/pod {objs_per_pod:.3f} > "
+            f"{MAX_HEAP_OBJECTS_PER_POD} — a layer is retaining per-pod "
+            "objects again (index/informer/devicestate retention?)"
+        )
+        ok = False
+    if rss_per_pod > MAX_RSS_BYTES_PER_POD:
+        print(
+            f"memsmoke: FAIL RSS/pod {rss_per_pod:.0f} B > {MAX_RSS_BYTES_PER_POD} B "
+            "— a dense per-pod structure crept back in"
+        )
+        ok = False
+    plugin.stop()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
