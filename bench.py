@@ -1701,6 +1701,17 @@ def run_shard_sweep() -> None:
         P, T = (10_000, 1_000)
     shard_counts = [1, 2, 4]
     pr5_baseline = 3593.0
+    # the PR 9 acceptance gate, detected at bench start: the ≥3× aggregate
+    # target is ENFORCED (non-zero exit) only on a host with ≥5 cores (4
+    # workers + the front each need one); an undersubscribed host records
+    # the fact explicitly and keeps the gate advisory — the sweep then
+    # measures protocol overhead, not parallel speedup
+    required_cores = max(shard_counts) + 1
+    gate_enforced = host_cores >= required_cores
+    log(
+        f"shard sweep: host_cores={host_cores} required={required_cores} → "
+        f"3x gate {'ENFORCED' if gate_enforced else 'ADVISORY (undersubscribed)'}"
+    )
     out = {
         "metric": (
             "aggregate full-scale sustained ingest / served decisions / "
@@ -1769,7 +1780,24 @@ def run_shard_sweep() -> None:
     if best4:
         out["aggregate_x_pr5"] = round(best4 / pr5_baseline, 2)
         out["meets_3x"] = bool(best4 >= 3 * pr5_baseline)
-    out["undersubscribed"] = host_cores < max(shard_counts) + 1
+    out["undersubscribed"] = host_cores < required_cores
+    out["gate_3x"] = {
+        "required_cores": required_cores,
+        "host_cores": host_cores,
+        "enforced": gate_enforced,
+        "meets_3x": out.get("meets_3x"),
+        "advisory": (
+            None
+            if gate_enforced
+            else (
+                f"host exposes {host_cores} core(s) < {required_cores}: "
+                f"{max(shard_counts)} workers + the front timeshare, so the "
+                "sweep measures sharding-protocol overhead, not parallel "
+                "speedup — rerun on a ≥5-core host to enforce the ≥3× "
+                f"aggregate target vs PR 5's {pr5_baseline:,.0f} ev/s"
+            )
+        ),
+    }
     stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     path = f"BENCH_PR9_{platform.upper()}_{stamp}.json"
     with open(path, "w") as f:
@@ -1777,6 +1805,12 @@ def run_shard_sweep() -> None:
         f.write("\n")
     log(f"shard sweep written to {path}")
     emit(out)
+    if gate_enforced and not out.get("meets_3x"):
+        log(
+            f"shard sweep FAILED the enforced 3x gate: aggregate "
+            f"{best4 or 0:,.0f} ev/s < {3 * pr5_baseline:,.0f}"
+        )
+        raise SystemExit(1)
 
 
 def run_gang_bench() -> None:

@@ -18,4 +18,17 @@ env JAX_PLATFORMS=cpu python -m pytest \
 echo "== memory: 50k-pod columnar-arena build vs committed per-pod bounds =="
 env JAX_PLATFORMS=cpu python tools/memsmoke.py
 
+echo "== hunt: planted-bug find -> confirm -> shrink -> promote + coverage artifact =="
+# small-budget adversarial-hunt smoke: the planted mock.status.delay
+# regression must be found, shrunk to <=2 DSL ops, and promoted. Promotion
+# goes to a scratch dir (the committed corpus entry is maintained in-tree;
+# CI only proves the lifecycle still works) and the coverage report is the
+# archivable artifact.
+HUNT_DIR="${KT_CI_ARTIFACTS:-/tmp/kt-ci}/hunt"
+rm -rf "$HUNT_DIR" && mkdir -p "$HUNT_DIR"
+env JAX_PLATFORMS=cpu python -m kube_throttler_tpu.scenarios.hunt smoke \
+    --workdir "$HUNT_DIR" --report "$HUNT_DIR/hunt-coverage.json" \
+    --promote-dir "$HUNT_DIR/promoted"
+echo "hunt coverage artifact: $HUNT_DIR/hunt-coverage.json"
+
 echo "ci gate: OK"

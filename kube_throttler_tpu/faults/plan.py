@@ -260,6 +260,34 @@ class FaultRule:
         if self.schedule is not None:
             self._schedule_set = frozenset(int(i) for i in self.schedule)
 
+    def canonical(self) -> Dict[str, object]:
+        """Minimal, stable, JSON-able form: default-valued fields dropped,
+        ``schedule``/``at_times`` sorted, ``window`` a 2-list. Two rules
+        with equal canonical forms decide identically for every
+        ``(seed, rule_idx, site, hit, clock)`` tuple. ``error`` factories
+        are represented by their qualified name only (callables don't
+        serialize; the factory's identity is what distinguishes rules)."""
+        out: Dict[str, object] = {"site": self.site}
+        if self.mode != "error":
+            out["mode"] = self.mode
+        if self.error is not None:
+            out["error"] = getattr(self.error, "__qualname__", repr(self.error))
+        if self.probability < 1.0:
+            out["probability"] = float(self.probability)
+        if self.times is not None:
+            out["times"] = int(self.times)
+        if self.after:
+            out["after"] = int(self.after)
+        if self.schedule is not None:
+            out["schedule"] = sorted(int(i) for i in self.schedule)
+        if self.delay:
+            out["delay"] = float(self.delay)
+        if self.window is not None:
+            out["window"] = [float(self.window[0]), float(self.window[1])]
+        if self.at_times is not None:
+            out["at_times"] = sorted(float(t) for t in self.at_times)
+        return out
+
 
 def _decision(seed: int, rule_idx: int, site: str, hit: int) -> float:
     """Uniform [0,1) that depends ONLY on (seed, rule, site, hit) — sha256,
@@ -412,6 +440,15 @@ class FaultPlan:
         raise fault.make_error()
 
     # -- introspection ------------------------------------------------------
+
+    def canonical_rules(self) -> List[Dict[str, object]]:
+        """The effective rule set in priority order, each rule in its
+        stable canonical form (:meth:`FaultRule.canonical`). Order is
+        PRESERVED — first-match-wins makes priority part of the plan's
+        semantics — so equality of canonical forms means behavioral
+        equality, and the scenario hunt dedupes mutants by the sha of this
+        list (trace headers commit it; scenarios/trace.py)."""
+        return [r.canonical() for r in self._rules]
 
     def hits(self, site: str) -> int:
         with self._lock:

@@ -21,11 +21,19 @@ one of ``ok`` / ``degraded`` / ``down``:
 The aggregate verdict is the worst component state. Probes run at request
 time on the serving thread — they must be cheap reads of existing state,
 never RPCs.
+
+``snapshot()`` also RECORDS state transitions: each time a component's
+probed state differs from its last probed state, ``(component, old, new)``
+is appended to a bounded transition log. The log is the scenario hunt's
+coverage signal (scenarios/hunt/coverage.py) — a fault schedule that
+drives a component through a transition nobody has seen before is, by
+definition, new behavior worth keeping — and a cheap debugging timeline
+("when did the reflector first degrade?") for everyone else.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple, Union
+from typing import Callable, Dict, List, Tuple, Union
 
 from .utils.lockorder import guard_attrs, make_lock
 
@@ -41,11 +49,21 @@ STATES = tuple(_SEVERITY)
 class Health:
     """Registry of component probes + aggregate snapshot."""
 
-    GUARDED_BY = {"_probes": "self._lock"}
+    GUARDED_BY = {
+        "_probes": "self._lock",
+        "_last_states": "self._lock",
+        "_transitions": "self._lock",
+    }
+
+    # bounded transition log: old entries are dropped FIFO so a flapping
+    # component cannot grow the process unboundedly
+    MAX_TRANSITIONS = 1000
 
     def __init__(self) -> None:
         self._lock = make_lock("health")
         self._probes: Dict[str, Probe] = {}
+        self._last_states: Dict[str, str] = {}
+        self._transitions: List[Tuple[str, str, str]] = []
 
     def register(self, component: str, probe: Probe) -> None:
         """Register (or replace) a component probe."""
@@ -80,7 +98,32 @@ class Health:
             components[name] = {"state": state, **(detail or {})}
             if _SEVERITY[state] > _SEVERITY[worst]:
                 worst = state
+        with self._lock:
+            for name, comp in components.items():
+                prev = self._last_states.get(name)
+                cur = comp["state"]
+                if prev is not None and prev != cur:
+                    self._transitions.append((name, prev, cur))
+                self._last_states[name] = cur
+            if len(self._transitions) > self.MAX_TRANSITIONS:
+                del self._transitions[: -self.MAX_TRANSITIONS]
         return {"state": worst, "components": components}
+
+    def transitions(self) -> List[Tuple[str, str, str]]:
+        """Observed ``(component, old_state, new_state)`` transitions, in
+        observation order. Transitions are only recorded at ``snapshot()``
+        time — a consumer that wants a fine-grained timeline samples
+        snapshots at its own cadence (the scenario engine samples on the
+        replayer's tick)."""
+        with self._lock:
+            return list(self._transitions)
+
+    def reset_transitions(self) -> None:
+        """Drop the transition log and the last-seen states (a new
+        measurement epoch: the next snapshot seeds fresh baselines)."""
+        with self._lock:
+            self._transitions.clear()
+            self._last_states.clear()
 
 
 __all__ = ["Health", "STATES"]

@@ -119,6 +119,15 @@ METRIC_NAMES = frozenset(
         "kube_throttler_shard_scatter_duration_seconds",
         "kube_throttler_shard_route_misses_total",
         "kube_throttler_shard_two_phase_aborts_total",
+        # adversarial scenario hunt (register_hunt_metrics /
+        # scenarios/hunt/loop.py): search-loop progress a nightly soak
+        # dashboard watches — mutants evaluated, coverage-map size, corpus
+        # population, gate-failing mutants found, and shrink work
+        "kube_throttler_hunt_iterations_total",
+        "kube_throttler_hunt_coverage_size",
+        "kube_throttler_hunt_corpus_size",
+        "kube_throttler_hunt_findings_total",
+        "kube_throttler_hunt_shrink_steps_total",
         # columnar arena store (register_store_metrics / engine/columnar.py):
         # slot population/recycling, intern-pool growth, and how often the
         # lazy edge materializes full API objects
@@ -304,6 +313,32 @@ class Registry:
             h = HistogramVec(name, help_text, label_names, buckets)
             self._histograms[name] = h
             return h
+
+    def family_totals(self) -> Dict[str, Tuple[int, float]]:
+        """``family name → (series count, value sum)`` across gauges,
+        counters, and histograms (histograms contribute their observation
+        counts). Flushes deferred recorders first so scrape-time families
+        are current. This is the scenario hunt's metric-coverage signal:
+        comparing two snapshots tells you which families a run *touched*
+        without parsing exposition text."""
+        self.flush()
+        out: Dict[str, Tuple[int, float]] = {}
+        with self._lock:
+            gauges = list(self._gauges.values())
+            counters = list(self._counters.values())
+            histograms = list(self._histograms.values())
+        for fam in gauges + counters:
+            values = fam.collect()
+            if values:
+                out[fam.name] = (len(values), float(sum(values.values())))
+        for h in histograms:
+            series = h.collect()
+            if series:
+                out[h.name] = (
+                    len(series),
+                    float(sum(count for _, _, count in series.values())),
+                )
+        return out
 
     def exposition(self) -> str:
         """Prometheus text format (flushes deferred recorders first)."""
@@ -712,6 +747,41 @@ def register_scenario_metrics(registry: Registry) -> Dict[str, object]:
             "kube_throttler_scenario_recovery_seconds",
             "worst post-restart time to the next landed status publication",
             ["scenario"],
+        ),
+    }
+
+
+def register_hunt_metrics(registry: Registry) -> Dict[str, object]:
+    """Adversarial-hunt progress families (scenarios/hunt/loop.py): the
+    nightly soak's dashboard surface. Iterations/findings/shrink-steps are
+    counters (monotone across a soak process); coverage and corpus size
+    are gauges sampled by the loop after every iteration."""
+    return {
+        "iterations": registry.counter_vec(
+            "kube_throttler_hunt_iterations_total",
+            "mutants generated and evaluated by the hunt loop",
+            [],
+        ),
+        "coverage": registry.gauge_vec(
+            "kube_throttler_hunt_coverage_size",
+            "distinct coverage keys observed (fault sites × hit buckets, "
+            "metric families touched, health transitions, gate outcomes)",
+            [],
+        ),
+        "corpus": registry.gauge_vec(
+            "kube_throttler_hunt_corpus_size",
+            "programs retained in the novelty-weighted hunt corpus",
+            [],
+        ),
+        "findings": registry.counter_vec(
+            "kube_throttler_hunt_findings_total",
+            "gate-failing mutants discovered (pre-shrink)",
+            [],
+        ),
+        "shrink_steps": registry.counter_vec(
+            "kube_throttler_hunt_shrink_steps_total",
+            "accepted shrink transformations across all findings",
+            [],
         ),
     }
 

@@ -3,8 +3,10 @@
 Usage:
     python -m kube_throttler_tpu.scenarios list
     python -m kube_throttler_tpu.scenarios run --name hotkey_throttle [--seed 0]
+    python -m kube_throttler_tpu.scenarios run --file program.json [--seed 0]
     python -m kube_throttler_tpu.scenarios matrix [--seeds 0,1,2] [--names a,b]
     python -m kube_throttler_tpu.scenarios regression --name smoke [--seed 0]
+    python -m kube_throttler_tpu.scenarios regressions [--workdir WD]
     python -m kube_throttler_tpu.scenarios trace --name smoke --seed 0
 
 ``make scenario-test`` runs ``matrix`` over the full corpus × 3 seeds and
@@ -12,6 +14,12 @@ exits non-zero if any SLO gate fails. ``regression`` runs one scenario
 clean AND with the injected flip-stall regression, prints the per-gate
 diff report, and exits non-zero unless the regression demonstrably fails
 a gate the clean run passed (the gate-actually-gates acceptance check).
+``run --file`` replays an arbitrary DSL program from JSON
+(dsl.scenario_from_dict) — the hunt's fresh-interpreter evaluation hook.
+``regressions`` replays every hunt-promoted repro committed under
+``scenarios/corpus/regressions/`` and enforces each entry's pinned
+verdict (``expect: fail:<gate>`` must still fail exactly that gate;
+``expect: pass`` must go green) — the permanent tier gate.
 """
 
 from __future__ import annotations
@@ -70,10 +78,17 @@ def main(argv=None) -> int:
     sub.add_parser("list", help="list the corpus")
 
     run = sub.add_parser("run", help="one scenario run")
-    run.add_argument("--name", required=True)
+    run.add_argument("--name", default="")
+    run.add_argument("--file", default="", help="DSL program JSON (hunt mutants)")
     run.add_argument("--seed", type=int, default=0)
     run.add_argument("--workdir", default="")
     run.add_argument("--regression", default=None, choices=[None, "flip_stall"])
+
+    regs = sub.add_parser(
+        "regressions",
+        help="replay the committed hunt-promoted repros, enforce pinned verdicts",
+    )
+    regs.add_argument("--workdir", default="")
 
     tr = sub.add_parser("trace", help="emit a committed trace (stdout)")
     tr.add_argument("--name", required=True)
@@ -118,11 +133,76 @@ def main(argv=None) -> int:
 
     if args.command == "run":
         wd = workdir_of(args)
-        report = run_scenario(
-            get_scenario(args.name), args.seed, wd, regression=args.regression
-        )
+        if args.file:
+            from .dsl import scenario_from_dict
+
+            with open(args.file) as f:
+                scn = scenario_from_dict(json.load(f))
+        elif args.name:
+            scn = get_scenario(args.name)
+        else:
+            print("run: one of --name / --file is required", file=sys.stderr)
+            return 2
+        report = run_scenario(scn, args.seed, wd, regression=args.regression)
         print(json.dumps(report, indent=2, default=str))
         return 0 if report["all_pass"] else 1
+
+    if args.command == "regressions":
+        from .corpus import load_regressions
+        from .dsl import scenario_to_dict
+
+        entries = load_regressions()
+        if not entries:
+            print("regression corpus is empty — nothing to gate")
+            return 0
+        wd_root = workdir_of(args)
+        bad = 0
+        for entry in entries:
+            wd = os.path.join(wd_root, entry["name"])
+            os.makedirs(wd, exist_ok=True)
+            program_path = os.path.join(wd, "program.json")
+            with open(program_path, "w") as f:
+                json.dump(scenario_to_dict(entry["scenario"]), f)
+            cmd = [
+                sys.executable, "-m", "kube_throttler_tpu.scenarios", "run",
+                "--file", program_path, "--seed", str(entry["seed"]),
+                "--workdir", wd,
+            ]
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=1200, env=env
+            )
+            report_path = os.path.join(
+                wd, f"report-{entry['scenario'].name}-s{entry['seed']}.json"
+            )
+            if not os.path.exists(report_path):
+                bad += 1
+                print(
+                    f"FAIL {entry['name']}: no report (rc={proc.returncode})\n"
+                    f"{proc.stdout[-1500:]}"
+                )
+                continue
+            with open(report_path) as f:
+                report = json.load(f)
+            failed = sorted(
+                g for g, v in report["gates"].items() if not v["pass"]
+            )
+            if entry["expect"] == "pass":
+                ok = report["all_pass"]
+                want = "all gates green"
+            else:
+                gate = entry["expect"].split(":", 1)[1]
+                ok = gate in failed
+                want = f"gate {gate} still failing"
+            bad += 0 if ok else 1
+            print(
+                f"{'PASS' if ok else 'FAIL'} {entry['name']:<28} "
+                f"expect={entry['expect']} got failed={failed or 'none'} "
+                f"({want})"
+            )
+        print(f"\n{len(entries) - bad}/{len(entries)} regression repros verdict-stable")
+        return 1 if bad else 0
 
     if args.command == "regression":
         from .slo import diff_reports

@@ -15,11 +15,27 @@ samples) and restarting the apiserver (recovery gate).
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Dict, List
 
-from .dsl import Arrival, FaultSpec, Scenario, SloGates, Topology
+from .dsl import Arrival, FaultSpec, Scenario, SloGates, Topology, scenario_from_dict
 
-__all__ = ["SCENARIOS", "corpus", "get_scenario"]
+__all__ = [
+    "REGRESSIONS_DIR",
+    "SCENARIOS",
+    "corpus",
+    "get_scenario",
+    "load_regressions",
+]
+
+# hunt-promoted minimal repros (scenarios/hunt/): each JSON file is one
+# shrunk, gate-failing program plus its pinned verdict — a PERMANENT tier
+# gate replayed by `python -m kube_throttler_tpu.scenarios regressions`
+# (wired into `make scenario-test`). Plain data directory, not a package.
+REGRESSIONS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "corpus", "regressions"
+)
 
 
 def _scenarios() -> List[Scenario]:
@@ -160,6 +176,48 @@ def _scenarios() -> List[Scenario]:
             leader_kill=True,
         ),
     ]
+
+
+def load_regressions() -> List[Dict]:
+    """The committed regression corpus, parsed and validated. Each entry:
+
+    - ``scenario`` — the shrunk minimal repro (a full DSL program);
+    - ``seed`` — the trace seed it was found and shrunk under;
+    - ``expect`` — the pinned verdict: ``"fail:<gate>"`` while the
+      underlying bug (or the injected fault class the gate must catch) is
+      live — the replay must STILL fail exactly that gate, proving the
+      gate still gates this trace; or ``"pass"`` once a real bug is fixed
+      — the repro becomes an ordinary always-green regression test.
+      Maintainers flip fail→pass in the committed file when they land the
+      fix (the lifecycle is documented in docs/scenarios.md);
+    - ``provenance`` — how the hunt found it (parent sha, hunt seed,
+      iteration, shrink steps, original trace sha).
+
+    A malformed file raises: a promoted repro that silently fails to load
+    is a regression gate that silently stopped gating."""
+    entries: List[Dict] = []
+    if not os.path.isdir(REGRESSIONS_DIR):
+        return entries
+    for fn in sorted(os.listdir(REGRESSIONS_DIR)):
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(REGRESSIONS_DIR, fn)
+        with open(path) as f:
+            raw = json.load(f)
+        expect = raw.get("expect", "pass")
+        if expect != "pass" and not expect.startswith("fail:"):
+            raise ValueError(f"{path}: bad expect {expect!r}")
+        entries.append(
+            {
+                "path": path,
+                "name": os.path.splitext(fn)[0],
+                "scenario": scenario_from_dict(raw["scenario"]),
+                "seed": int(raw.get("seed", 0)),
+                "expect": expect,
+                "provenance": raw.get("provenance", {}),
+            }
+        )
+    return entries
 
 
 def corpus(include_smoke: bool = False) -> List[Scenario]:

@@ -23,7 +23,7 @@ The composition is committed to a replayable trace file
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Dict, Optional, Tuple
 
 __all__ = [
@@ -33,6 +33,9 @@ __all__ = [
     "SloGates",
     "Scenario",
     "arrival_rate",
+    "compile_fault_rules",
+    "scenario_to_dict",
+    "scenario_from_dict",
 ]
 
 
@@ -138,7 +141,10 @@ class Scenario:
     create burst, later deleted, over background churn). ``leader_kill``
     appends the process-level kill-the-leader episode (tools/harness.py +
     tools/hatest.py, the PR 6 ha.* machinery) after the in-process
-    replay."""
+    replay. ``durable`` attaches the PR 4 durability stack (journal +
+    size-triggered snapshots + compaction) to the serving store for the
+    run — the long-horizon hunt tier's journal-compaction/snapshot-cycle
+    pressure (scenarios/hunt/longhorizon.py)."""
 
     name: str
     description: str
@@ -154,6 +160,63 @@ class Scenario:
     )
     herd_size: int = 0
     leader_kill: bool = False
+    durable: bool = False
 
     def mix_weights(self) -> Dict[str, float]:
         return dict(self.mix)
+
+
+def compile_fault_rules(plan, scn: "Scenario") -> None:
+    """Compile the scenario's fault schedule onto ``plan`` (one seeded
+    FaultPlan shared by the mockserver, the transport, and the engine's
+    scenario.* action sites). ONE implementation — the engine installs
+    rules with it, the trace header commits the plan's canonical form
+    through it (scenarios/trace.py), and the hunt dedupes mutants by that
+    form — so the committed header can never drift from what actually
+    runs."""
+    for fs in scn.faults:
+        plan.rule(
+            fs.site,
+            mode=fs.mode,
+            probability=fs.probability,
+            times=fs.times,
+            delay=fs.delay,
+            at_times=[fs.t] if fs.t is not None else None,
+            window=fs.window,
+        )
+    if scn.leader_kill:
+        plan.rule("scenario.leader.kill", mode="kill", times=1)
+
+
+def scenario_to_dict(scn: "Scenario") -> Dict:
+    """JSON-able program form (the hunt's corpus/promotion interchange and
+    the ``run --file`` input). Pure dataclass data — round-trips through
+    :func:`scenario_from_dict` losslessly."""
+    return asdict(scn)
+
+
+def scenario_from_dict(d: Dict) -> "Scenario":
+    """Inverse of :func:`scenario_to_dict` (tuples rebuilt from JSON
+    lists). Unknown keys are rejected — a promoted repro written by a
+    newer DSL must fail loudly, not silently drop a program axis."""
+    d = dict(d)
+    arrival = Arrival(**d.pop("arrival", {}))
+    topology = Topology(**d.pop("topology", {}))
+    slo = SloGates(**d.pop("slo", {}))
+    faults = []
+    for f in d.pop("faults", ()) or ():
+        f = dict(f)
+        if f.get("window") is not None:
+            f["window"] = (float(f["window"][0]), float(f["window"][1]))
+        faults.append(FaultSpec(**f))
+    mix = tuple((str(k), float(w)) for k, w in d.pop("mix", ()) or ())
+    kwargs = dict(
+        d,
+        arrival=arrival,
+        topology=topology,
+        slo=slo,
+        faults=tuple(faults),
+    )
+    if mix:
+        kwargs["mix"] = mix
+    return Scenario(**kwargs)

@@ -36,7 +36,7 @@ import random
 import time
 from typing import Dict, List, Optional
 
-from .dsl import Scenario
+from .dsl import Scenario, compile_fault_rules
 from .measure import (
     count_watch_of,
     flip_band_mc,
@@ -135,19 +135,8 @@ def _seed_remote_store(store, scn: Scenario, topology: Dict) -> None:
         )
 
 
-def _install_fault_rules(plan, scn: Scenario) -> None:
-    for fs in scn.faults:
-        plan.rule(
-            fs.site,
-            mode=fs.mode,
-            probability=fs.probability,
-            times=fs.times,
-            delay=fs.delay,
-            at_times=[fs.t] if fs.t is not None else None,
-            window=fs.window,
-        )
-    if scn.leader_kill:
-        plan.rule("scenario.leader.kill", mode="kill", times=1)
+# fault-schedule compilation is shared with the trace header's canonical
+# plan commit: dsl.compile_fault_rules (one implementation, no drift)
 
 
 def _oracle_store(remote):
@@ -284,6 +273,7 @@ class _Replayer:
 
     def _scenario_sites(self) -> None:
         e = self.e
+        e.sample_health()
         fault = e.plan.check("scenario.apiserver.restart")
         if fault is not None:
             if fault.mode == "expire_continues":
@@ -414,7 +404,7 @@ class _Engine:
             f.write(blob)
 
         self.plan = FaultPlan(seed=self.seed)
-        _install_fault_rules(self.plan, self.scn)
+        compile_fault_rules(self.plan, self.scn)
         if self.regression:
             # the deliberately-broken SLO: route the regression site into a
             # per-status-PUT stall — flip publication pays it wholesale
@@ -430,6 +420,32 @@ class _Engine:
         server.start()
 
         self.local = Store()
+        self.journal = self.snapshotter = None
+        if self.scn.durable:
+            # the long-horizon durability hook: journal + size-triggered
+            # snapshots + compaction cycles run UNDER the replayed storm
+            # (journal attach must precede every other store handler so
+            # nothing double-dispatches). Trigger cadence scales with the
+            # trace so a multi-virtual-day run cuts several snapshots and
+            # at least one compaction.
+            from ..engine.journal import attach as journal_attach
+            from ..engine.snapshot import SnapshotManager
+
+            data_dir = os.path.join(
+                self.workdir, f"data-{self.scn.name}-s{self.seed}"
+            )
+            os.makedirs(data_dir, exist_ok=True)
+            every = max(len(self.ops) // 4, 500)
+            self.journal = journal_attach(
+                self.local,
+                os.path.join(data_dir, "journal.log"),
+                compact_after=every * 3,
+                faults=self.plan,
+            )
+            self.snapshotter = SnapshotManager(
+                data_dir, self.local, faults=self.plan
+            )
+            self.snapshotter.bind_journal(self.journal, every_lines=every)
         self.metrics_registry = self.registry if self.registry is not None else Registry()
         self.session = RemoteSession(
             RestConfig(server=server.url),
@@ -499,11 +515,68 @@ class _Engine:
             if fault is not None:
                 self.plan.rule("mock.status.delay", mode="delay", delay=fault.delay)
 
+        # fingerprint anchors (the hunt's coverage signal): reflectors join
+        # the plugin's /readyz component registry, the transition log and
+        # the metric-family baseline reset AFTER convergence so everything
+        # recorded from here on is run behavior, not startup noise
+        self.session.register_health(self.plugin.health)
+        if self.journal is not None:
+            self.plugin.health.register("journal", self.journal.health_state)
+        if self.snapshotter is not None:
+            self.snapshotter.device_manager = self.plugin.device_manager
+            self.plugin.health.register("snapshot", self.snapshotter.health_state)
+        self._health_sample_every_s = 0.05
+        self._last_health_sample = 0.0
+        self.plugin.health.reset_transitions()
+        self.sample_health(force=True)
+        self._metric_baseline = self.metrics_registry.family_totals()
+
+    def sample_health(self, force: bool = False) -> None:
+        """Probe every /readyz component at most every 50 ms (replayer
+        tick + quiesce loop) so Health's transition log approximates a
+        continuous timeline of the run."""
+        now = time.perf_counter()
+        if not force and now - self._last_health_sample < self._health_sample_every_s:
+            return
+        self._last_health_sample = now
+        try:
+            self.plugin.health.snapshot()
+        except Exception:
+            logger.debug("health sample failed", exc_info=True)
+
+    def fingerprint(self) -> Dict:
+        """The structured, machine-readable run fingerprint: fired fault
+        sites with hit counts, health-component state transitions, and
+        metric-family deltas vs the post-convergence baseline. This is the
+        hunt's coverage signal (scenarios/hunt/coverage.py) and the raw
+        material for diff_reports — consumers read THIS, not report
+        prose."""
+        end = self.metrics_registry.family_totals()
+        base = getattr(self, "_metric_baseline", {})
+        families: Dict[str, Dict] = {}
+        for name, (series, total) in sorted(end.items()):
+            before = base.get(name)
+            if before is None or before != (series, total):
+                families[name] = {
+                    "series": series,
+                    "delta": round(total - (before[1] if before else 0.0), 6),
+                }
+        return {
+            "fault_sites": {
+                site: len(firings) for site, firings in self.plan.snapshot().items()
+            },
+            "health_transitions": [
+                list(t) for t in self.plugin.health.transitions()
+            ],
+            "metric_families": families,
+        }
+
     # -- quiesce + oracles --------------------------------------------------
 
     def quiesce(self, timeout_s: float = 60.0) -> bool:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            self.sample_health()
             if self.session.ingest is not None:
                 self.session.ingest.flush(timeout=5.0)
             target_rv = self.remote.latest_resource_version
@@ -613,6 +686,7 @@ class _Engine:
             lambda: self.plugin.stop(),
             lambda: self.session.stop(),
             lambda: self.server.stop(),
+            lambda: self.journal.close() if self.journal is not None else None,
         ):
             try:
                 step()
@@ -740,16 +814,19 @@ def run_scenario(
             measurements["failover_window_s"] = ha.get("window_s")
 
         gates = evaluate_gates(scn, measurements)
+        eng.sample_health(force=True)  # final probe before the fingerprint
         report = {
             "scenario": scn.name,
             "seed": seed,
             "regression": regression,
             "trace_path": eng.trace_path,
             "trace_sha256": eng.trace_sha,
+            "fault_plan_sha256": eng.header.get("fault_plan_sha256"),
             "all_pass": all(g["pass"] for g in gates.values()),
             "gates": gates,
             "measurements": measurements,
             "fault_history": eng.plan.snapshot(),
+            "fingerprint": eng.fingerprint(),
         }
         _record_metrics(eng.metrics_registry, scn, report)
         path = os.path.join(workdir, f"report-{scn.name}-s{seed}.json")

@@ -200,4 +200,51 @@ def diff_reports(clean: Dict, regressed: Dict) -> str:
         f"all_pass={regressed['all_pass']}; gates flipped by the "
         f"regression: {flipped or 'NONE'}"
     )
+    lines.extend(_diff_fingerprints(clean, regressed))
     return "\n".join(lines)
+
+
+def _diff_fingerprints(clean: Dict, regressed: Dict) -> List[str]:
+    """Behavioral diff from the structured run fingerprints (engine
+    ``report["fingerprint"]``): fault sites fired in only one run (or at
+    different hit counts), health transitions unique to either side, and
+    metric families only one run touched — the "what actually changed"
+    companion to the per-gate value diff."""
+    cf = clean.get("fingerprint")
+    rf = regressed.get("fingerprint")
+    if not cf or not rf:
+        return []
+    lines: List[str] = ["  fingerprint diff:"]
+    c_sites, r_sites = cf.get("fault_sites", {}), rf.get("fault_sites", {})
+    site_diffs = [
+        f"{s}({c_sites.get(s, 0)}→{r_sites.get(s, 0)})"
+        for s in sorted(set(c_sites) | set(r_sites))
+        if c_sites.get(s, 0) != r_sites.get(s, 0)
+    ]
+    lines.append(f"    fault sites:  {', '.join(site_diffs) or '(identical)'}")
+
+    def _tset(fp):
+        return {tuple(t) for t in fp.get("health_transitions", [])}
+
+    only_c = _tset(cf) - _tset(rf)
+    only_r = _tset(rf) - _tset(cf)
+    if only_c or only_r:
+        for tag, ts in (("clean-only", only_c), ("regressed-only", only_r)):
+            if ts:
+                rendered = ", ".join(
+                    f"{c}:{old}->{new}" for c, old, new in sorted(ts)
+                )
+                lines.append(f"    transitions {tag}: {rendered}")
+    else:
+        lines.append("    transitions:  (identical)")
+    c_fams = set(cf.get("metric_families", {}))
+    r_fams = set(rf.get("metric_families", {}))
+    fam_bits = []
+    if r_fams - c_fams:
+        fam_bits.append(f"regressed-only: {', '.join(sorted(r_fams - c_fams))}")
+    if c_fams - r_fams:
+        fam_bits.append(f"clean-only: {', '.join(sorted(c_fams - r_fams))}")
+    lines.append(
+        f"    metric families: {'; '.join(fam_bits) or '(same set touched)'}"
+    )
+    return lines

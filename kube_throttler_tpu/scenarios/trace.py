@@ -36,17 +36,37 @@ import random
 from dataclasses import asdict
 from typing import Dict, List, Tuple
 
-from .dsl import Scenario, arrival_rate
+from .dsl import Scenario, arrival_rate, compile_fault_rules
 
-TRACE_VERSION = 1
+# v2: the header additionally commits the CANONICAL compiled fault plan
+# (faults/plan.py FaultRule.canonical, priority order preserved) and its
+# sha256 — the hunt's dedupe key for mutants whose schedules differ only
+# in surface form, and the reviewer's answer to "what does this trace
+# actually arm?" without re-deriving the compile step.
+TRACE_VERSION = 2
 
 __all__ = [
     "TRACE_VERSION",
     "build_topology",
     "build_trace",
+    "canonical_fault_plan",
     "serialize_trace",
     "trace_sha256",
 ]
+
+
+def canonical_fault_plan(scn: Scenario) -> Tuple[List[Dict], str]:
+    """→ (canonical rule list, sha256 of its stable JSON form): the
+    scenario's fault schedule compiled exactly as the engine compiles it
+    (dsl.compile_fault_rules), then canonicalized. Equal shas ⇒ the two
+    scenarios arm byte-for-byte the same effective plan."""
+    from ..faults.plan import FaultPlan
+
+    plan = FaultPlan(seed=0)
+    compile_fault_rules(plan, scn)
+    rules = plan.canonical_rules()
+    blob = json.dumps(rules, sort_keys=True, separators=(",", ":")).encode()
+    return rules, hashlib.sha256(blob).hexdigest()
 
 
 def build_topology(scn: Scenario, seed: int) -> Dict:
@@ -270,6 +290,7 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
     drain_extras(scn.duration_s)
 
     ops.sort(key=lambda o: (o["t_us"], o["seq"]))
+    plan_rules, plan_sha = canonical_fault_plan(scn)
     header = {
         "version": TRACE_VERSION,
         "scenario": scn.name,
@@ -279,11 +300,14 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
         "pattern": scn.pattern,
         "herd_size": scn.herd_size,
         "leader_kill": scn.leader_kill,
+        "durable": scn.durable,
         "arrival": asdict(scn.arrival),
         "topology": asdict(scn.topology),
         "topology_sha256": _topology_sha(topology),
         "mix": list(list(m) for m in scn.mix),
         "faults": [asdict(f) for f in scn.faults],
+        "fault_plan": plan_rules,
+        "fault_plan_sha256": plan_sha,
         "slo": asdict(scn.slo),
         "ops": len(ops),
     }
