@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress crash-test ha-test scenario-test shard-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress crash-test ha-test reshard-test scenario-test shard-scenario reshard-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -19,13 +19,20 @@ crash-test:      ## SIGKILL crash-point matrix: every crash.* site x 3 seeds
 ha-test:         ## kill-the-leader failover matrix: every ha.* site x 3 seeds + split-brain fencing
 	$(PY) tools/hatest.py matrix
 
-scenario-test:   ## trace-driven scenario corpus x 3 seeds, every SLO gate enforced (+ the sharded bad-day variant + hunt-promoted regression repros)
+reshard-test:    ## kill-mid-handoff abort matrix: every reshard.* abort path x 3 seeds, zero orphan reservations
+	env JAX_PLATFORMS=cpu $(PY) tools/reshardtest.py matrix
+
+scenario-test:   ## trace-driven scenario corpus x 3 seeds, every SLO gate enforced (+ the sharded bad-day variant + the live-resharding chaos scenario + hunt-promoted regression repros)
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios matrix
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.sharded --shards 4 --seed 0
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.resharding --seed 0
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios regressions
 
 shard-scenario:  ## sharded composed bad-day alone: 4 workers, kill-a-shard episode, knee-lift + zero-wrong-verdict gates
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.sharded --shards 4 --seed 0
+
+reshard-scenario: ## live resharding alone: scale 2->4->3 under storm load with one kill-mid-handoff episode
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.resharding --seed 0
 
 scenario-regression: ## prove the gates gate: clean vs injected-regression diff report
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios regression --name smoke
@@ -41,6 +48,11 @@ scenario-hunt-smoke: ## CI acceptance: planted-bug find -> confirm -> shrink -> 
 scenario-hunt-long: ## long-horizon tier: multi-virtual-day soaks, durability cycles, 1M-pod arena rung
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.hunt long \
 		--budget-s 3600 --iterations 20 --report hunt-long-report.json
+
+scenario-hunt-nightly: ## nightly cadence (hack/ci.sh comments): the long tier at the FULL 1M-pod arena rung with durable journal/snapshot cycles, then budget-remainder mutation
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.hunt long \
+		--budget-s 7200 --iterations 30 --mega-pods 1000000 \
+		--report hunt-nightly-report.json
 
 lint:            ## 8-checker static analyzer (locks, purity, registries, blocking, threads, excsafety, protocol) + syntax sanity
 	$(PY) -m compileall -q kube_throttler_tpu tools bench.py __graft_entry__.py

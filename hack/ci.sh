@@ -4,6 +4,20 @@
 # fast smoke tier that proves the analyzer and the runtime lock
 # assassin themselves work. The full tier-1 suite stays `make test`;
 # this script is the cheap always-on gate (<~1 min).
+#
+# Nightly cadence (NOT part of this gate — the budgeted smoke below is
+# the CI hunt tier; these run on the nightly schedule, in this order):
+#   make scenario-hunt           budgeted coverage-guided search (~15 min)
+#   make scenario-hunt-nightly   long-horizon tier at the FULL 1M-pod
+#                                arena rung with durable journal/snapshot
+#                                cycles + budget-remainder mutation (~2 h;
+#                                memory: ~4 GB RSS — nightly-soak hosts
+#                                only, never this gate)
+#   make reshard-test            kill-mid-handoff abort matrix (zero
+#                                orphan reservations across every
+#                                reshard.* abort path x 3 seeds)
+# Findings shrink + promote into scenarios/corpus/regressions/ and the
+# next `make scenario-test` replays them as permanent tier gates.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -223,6 +223,25 @@ class GangLedger:
         vlog(3, "gang %s rolled back (%s)", group_key, reason)
         return True
 
+    def drop_groups(self, group_keys) -> int:
+        """Forget group records WITHOUT releasing member reservations —
+        the live-resharding retire/abort path: the moved throttle keys'
+        cache entries are dropped (or kept, on the surviving owner) by the
+        same handoff step, so a rollback-style release here would
+        double-free capacity the other shard now accounts. Returns the
+        number of records removed."""
+        dropped = 0
+        with self._lock:
+            for gk in list(group_keys):
+                record = self._groups.pop(gk, None)
+                if record is None:
+                    continue
+                dropped += 1
+                for pod_key in record.members:
+                    if self._member_index.get(pod_key) == gk:
+                        del self._member_index[pod_key]
+        return dropped
+
     # -- member lifecycle ---------------------------------------------------
 
     def on_pod_event(self, event) -> None:

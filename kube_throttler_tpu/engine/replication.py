@@ -51,6 +51,7 @@ the partial, and re-fetches from its last verified offset — the harness
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -789,6 +790,148 @@ class StandbyReplicator:
 # --------------------------------------------------------------------------
 # the facade the server/CLI/metrics read
 # --------------------------------------------------------------------------
+
+
+# --------------------------------------------------------------------------
+# resharding: the chunk protocol re-pointed at an in-memory handoff slice,
+# plus range-scoped fencing (the FencingEpoch discipline per keyspace range)
+# --------------------------------------------------------------------------
+
+
+class SliceChunkSource:
+    """The StandbyReplicator journal-chunk contract re-pointed at an
+    in-memory handoff slice: serve ``[offset, len(blob))`` windows with
+    prefix-sha256 continuity, so a live-resharding source shard streams
+    its keyspace slice over the framed-pickle IPC with exactly the
+    torn-stream detection the replication wire already has. A chunk whose
+    claimed prefix hash mismatches raises :class:`ReplicationDiverged` —
+    the coordinator's abort-back-to-source trigger."""
+
+    def __init__(self, blob: bytes, max_chunk: int = 1 << 20):
+        self.blob = blob
+        self.max_chunk = int(max_chunk)
+        self.chunks_served = 0
+
+    def chunk(self, offset: int, sha_hex: str = "") -> Dict[str, Any]:
+        offset = int(offset)
+        if offset > len(self.blob):
+            raise ReplicationDiverged(
+                f"offset {offset} beyond slice length {len(self.blob)}"
+            )
+        if sha_hex:
+            want = hashlib.sha256(self.blob[:offset]).hexdigest()
+            if sha_hex != want:
+                raise ReplicationDiverged(
+                    f"slice prefix hash mismatch at offset {offset}"
+                )
+        data = self.blob[offset : offset + self.max_chunk]
+        end = offset + len(data)
+        self.chunks_served += 1
+        return {
+            "data": data,
+            "endOffset": end,
+            "endSha": hashlib.sha256(self.blob[:end]).hexdigest(),
+            "position": len(self.blob),
+        }
+
+
+class SliceChunkSink:
+    """Destination-side assembler for a :class:`SliceChunkSource` stream:
+    verifies every chunk's offset continuity and end-prefix hash before
+    appending; a torn or reordered chunk raises
+    :class:`ReplicationDiverged` and the partial buffer is discarded by
+    the caller (never applied). ``done`` flips when the verified buffer
+    reaches the source's position."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self.position: Optional[int] = None
+
+    def offset(self) -> int:
+        return len(self._buf)
+
+    def sha_hex(self) -> str:
+        return hashlib.sha256(bytes(self._buf)).hexdigest()
+
+    @property
+    def done(self) -> bool:
+        return self.position is not None and len(self._buf) >= self.position
+
+    def feed(self, chunk: Dict[str, Any]) -> int:
+        data = chunk.get("data") or b""
+        end = int(chunk.get("endOffset", 0))
+        if end != len(self._buf) + len(data):
+            raise ReplicationDiverged(
+                f"chunk end {end} does not extend verified prefix "
+                f"{len(self._buf)}+{len(data)}"
+            )
+        candidate = bytes(self._buf) + bytes(data)
+        if hashlib.sha256(candidate).hexdigest() != chunk.get("endSha"):
+            raise ReplicationDiverged("slice chunk hash mismatch (torn stream)")
+        self._buf = bytearray(candidate)
+        self.position = int(chunk.get("position", end))
+        return len(data)
+
+    def payload(self) -> bytes:
+        if not self.done:
+            raise ReplicationDiverged("slice stream incomplete")
+        return bytes(self._buf)
+
+
+@guard_attrs
+class RangeFence:
+    """Range-scoped fencing: the :class:`FencingEpoch` discipline applied
+    per keyspace range during a live reshard. Once a handoff's ranges are
+    fenced at an epoch, the source's write path refuses every
+    authoritative (throttle-keyspace) write whose route hash lands in a
+    fenced range — a racing event routed before the cutover cannot mutate
+    state the destination now owns. Fences are lifted by the cutover's
+    retire (the slice left with the range) or by an abort/TTL-reap
+    (authority returns to the source)."""
+
+    GUARDED_BY = {
+        "_fences": "self._lock",
+        "writes_refused": "self._lock",
+    }
+
+    def __init__(self) -> None:
+        self._lock = make_lock("reshard.rangefence")
+        # handoff id -> (epoch, ((lo, hi), ...))
+        self._fences: Dict[str, Tuple[int, Tuple[Tuple[int, int], ...]]] = {}
+        self.writes_refused = 0
+
+    def fence(self, handoff: str, ranges, epoch: int) -> None:
+        with self._lock:
+            self._fences[handoff] = (
+                int(epoch),
+                tuple((int(lo), int(hi)) for lo, hi in ranges),
+            )
+
+    def lift(self, handoff: str) -> bool:
+        with self._lock:
+            return self._fences.pop(handoff, None) is not None
+
+    def fenced_handoffs(self) -> List[str]:
+        with self._lock:
+            return sorted(self._fences)
+
+    def covers(self, h: int) -> bool:
+        """True when ``h`` lies in any fenced range — the write refusal
+        predicate the source's event path consults."""
+        with self._lock:
+            for _epoch, ranges in self._fences.values():
+                for lo, hi in ranges:
+                    if lo <= h < hi:
+                        return True
+            return False
+
+    def refuse(self, n: int = 1) -> None:
+        with self._lock:
+            self.writes_refused += n
+
+    def refused(self) -> int:
+        with self._lock:
+            return self.writes_refused
 
 
 class HaCoordinator:

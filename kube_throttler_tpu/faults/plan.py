@@ -115,6 +115,26 @@ shard.ipc.send              front→shard event-frame send raises: the shard
 shard.worker.kill           SIGKILL the shard worker at the next routed
                             event batch (the kill-a-shard chaos smoke;
                             sharding/worker.py handle_events)
+reshard.handoff.torn        the live-resharding slice stream tears: mode
+                            "torn" corrupts a chunk byte (the sink's
+                            prefix-hash check MUST refuse it), any other
+                            mode tears the stream outright — either way
+                            the range aborts back to the source
+                            (sharding/worker.py reshard_chunk)
+reshard.dest.crash          the handoff DESTINATION dies mid-warm-up: mode
+                            "kill" SIGKILLs the worker at the next import
+                            chunk, "error" fails the import RPC — the
+                            coordinator aborts and retries after the
+                            supervisor restart (worker reshard_import)
+reshard.fence.race          the fence step loses a race (a concurrent
+                            epoch superseded the handoff): the source
+                            unfences and the range aborts back to it
+                            (sharding/reshard.py, post-fence check)
+reshard.front.crash         the coordinator dies between prepare and
+                            cutover: mode "kill" SIGKILLs the front, any
+                            other mode abandons the handoff WITHOUT
+                            cleanup — both sides' two-phase reapers must
+                            TTL the orphan (zero orphan reservations)
 ==========================  ==================================================
 
 Virtual-time rules (the scenario engine's vocabulary): a rule may carry
@@ -192,6 +212,10 @@ KNOWN_SITES = frozenset(
         "scenario.regression.flip_stall",
         "shard.ipc.send",
         "shard.worker.kill",
+        "reshard.handoff.torn",
+        "reshard.dest.crash",
+        "reshard.fence.race",
+        "reshard.front.crash",
     }
 )
 

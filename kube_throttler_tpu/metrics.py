@@ -119,6 +119,15 @@ METRIC_NAMES = frozenset(
         "kube_throttler_shard_scatter_duration_seconds",
         "kube_throttler_shard_route_misses_total",
         "kube_throttler_shard_two_phase_aborts_total",
+        # live elastic resharding (register_reshard_metrics /
+        # sharding/reshard.py): ranges in flight, handoff volume, the
+        # cutover-latency histogram the flip-SLO runbook reads, and the
+        # abort counter the kill-mid-handoff matrix drives
+        "kube_throttler_reshard_ranges_moving",
+        "kube_throttler_reshard_handoff_bytes_total",
+        "kube_throttler_reshard_handoff_events_total",
+        "kube_throttler_reshard_cutover_duration_seconds",
+        "kube_throttler_reshard_aborted_total",
         # adversarial scenario hunt (register_hunt_metrics /
         # scenarios/hunt/loop.py): search-loop progress a nightly soak
         # dashboard watches — mutants evaluated, coverage-map size, corpus
@@ -830,6 +839,63 @@ def register_shard_metrics(registry: Registry, front) -> Dict[str, object]:
 
     registry.register_pre_expose(flush)
     return {"scatter": scatter_h, "aborts": aborts_c, "misses": misses_c}
+
+
+def register_reshard_metrics(registry: Registry, front) -> Dict[str, object]:
+    """Live-resharding observability (sharding/reshard.py drives the
+    counters/histogram; the gauge samples the front's transition state at
+    scrape time). Ranges-moving > 0 for longer than a handoff SLO means a
+    stuck transition — the dual-ring router keeps serving correctly, but
+    the fleet is not at its target shape."""
+    moving_g = registry.gauge_vec(
+        "kube_throttler_reshard_ranges_moving",
+        "keyspace ranges currently in flight (mirroring or pending) in a "
+        "live reshard; 0 when no transition is active",
+        [],
+    )
+    bytes_c = registry.counter_vec(
+        "kube_throttler_reshard_handoff_bytes_total",
+        "verified slice bytes streamed source→destination across all "
+        "handoffs (the StandbyReplicator chunk contract over IPC)",
+        [],
+    )
+    events_c = registry.counter_vec(
+        "kube_throttler_reshard_handoff_events_total",
+        "objects (throttles + pods) and ledger entries transferred in "
+        "handoff slices",
+        [],
+    )
+    cutover_h = registry.histogram_vec(
+        "kube_throttler_reshard_cutover_duration_seconds",
+        "per-range fence→activate cutover window (the interval a moving "
+        "range's flips ride the re-publication path instead of the live "
+        "stream)",
+        [],
+    )
+    aborts_c = registry.counter_vec(
+        "kube_throttler_reshard_aborted_total",
+        "handoffs aborted back to the source (torn stream, destination "
+        "crash, fence race, or TTL reap)",
+        [],
+    )
+
+    def flush() -> None:
+        state = front.reshard_state()
+        if state is None:
+            moving_g.set({}, 0.0)
+        else:
+            moving_g.set(
+                {}, float(state["pending"]) + float(state["mirroring"])
+            )
+
+    registry.register_pre_expose(flush)
+    return {
+        "moving": moving_g,
+        "bytes": bytes_c,
+        "events": events_c,
+        "cutover": cutover_h,
+        "aborts": aborts_c,
+    }
 
 
 def register_ingest_metrics(registry: Registry, pipeline) -> None:

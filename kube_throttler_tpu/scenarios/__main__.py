@@ -143,7 +143,18 @@ def main(argv=None) -> int:
         else:
             print("run: one of --name / --file is required", file=sys.stderr)
             return 2
-        report = run_scenario(scn, args.seed, wd, regression=args.regression)
+        from .hunt.mutate import needs_shard_tier
+
+        if needs_shard_tier(scn):
+            # shard.*/reshard.* sites only exist in the multiprocess
+            # stack: route the program through the sharded replayer (one
+            # live rescale included when reshard.* is armed) so hunt
+            # mutants arming those sites actually fire them end to end
+            from .sharded import run_sharded_program
+
+            report = run_sharded_program(scn, args.seed, wd)
+        else:
+            report = run_scenario(scn, args.seed, wd, regression=args.regression)
         print(json.dumps(report, indent=2, default=str))
         return 0 if report["all_pass"] else 1
 

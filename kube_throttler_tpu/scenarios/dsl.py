@@ -86,13 +86,27 @@ class Topology:
     the seed). ``hot_frac`` > 0 routes that fraction of all pods into one
     ``hot`` label group matched by a single throttle — the hot-key shape
     where one throttle's matched-column set dominates the (N,K) device
-    encoding. ``nodes`` spreads pods for the rolling-drain waves."""
+    encoding. ``nodes`` spreads pods for the rolling-drain waves.
+
+    Gang / heterogeneity axes (PR 7's admission paths, searchable by the
+    hunt mutators): ``gang_size`` > 0 stamps the initial population with
+    PodGroup annotations — each label group's pods join gangs of that
+    size — so replay traffic exercises the gang ledger's member
+    bookkeeping; ``accel_classes`` > 0 spreads pods over that many
+    ``accel-class`` annotations, and ``class_threshold_frac`` > 0 gives
+    the flip-band throttles per-class ``accelClassThresholds`` entries
+    (class c's threshold scaled down by up to that fraction), so the
+    class-resolved admission inequality diverges from the base one. All
+    three default OFF — committed traces stay byte-identical."""
 
     pods: int = 5000
     throttles: int = 300
     groups: int = 150
     hot_frac: float = 0.0
     nodes: int = 8
+    gang_size: int = 0
+    accel_classes: int = 0
+    class_threshold_frac: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -58,15 +58,55 @@ _SPOT_CHECK_SAMPLE = 200
 __all__ = ["run_scenario"]
 
 
-def _materialize_pod(name: str, grp: str, node: str, cpu_m: int):
+def _materialize_pod(name: str, grp: str, node: str, cpu_m: int,
+                     acl=None, gang=None, gsz: int = 0):
     from dataclasses import replace as _replace
 
     from ..api.pod import make_pod
 
-    pod = make_pod(name, labels={"grp": grp}, requests={"cpu": f"{cpu_m}m"})
+    pod = make_pod(
+        name, labels={"grp": grp}, requests={"cpu": f"{cpu_m}m"},
+        accel_class=acl, group=gang, group_size=gsz or None,
+    )
     pod = _replace(pod, spec=_replace(pod.spec, node_name=node))
     pod.status.phase = "Running"
     return pod
+
+
+def _pod_fields(spec_or_op: Dict) -> Dict:
+    """The gang/accel annotation fields a topology spec or trace op may
+    carry (absent on every axis-off trace — committed corpus unchanged)."""
+    out = {}
+    if "acl" in spec_or_op:
+        out["acl"] = spec_or_op["acl"]
+    if "gang" in spec_or_op:
+        out["gang"] = spec_or_op["gang"]
+        out["gsz"] = int(spec_or_op.get("gsz", 0))
+    return out
+
+
+def _accel_entries(topo, base_mc: int):
+    """Per-class ``accelClassThresholds`` for a flip-band throttle: class
+    c's cpu threshold scaled down by up to ``class_threshold_frac`` — the
+    class-resolved admission inequality then genuinely diverges from the
+    base one (PR 7's heterogeneity path, searchable by the hunt)."""
+    frac = getattr(topo, "class_threshold_frac", 0.0)
+    n = getattr(topo, "accel_classes", 0)
+    if frac <= 0.0 or n <= 0:
+        return ()
+    from ..api.types import AccelClassThreshold, ResourceAmount
+
+    return tuple(
+        AccelClassThreshold(
+            accel_class=f"ac{c}",
+            threshold=ResourceAmount.of(
+                requests={
+                    "cpu": f"{max(int(base_mc * (1.0 - frac * (c + 1) / n)), 100)}m"
+                }
+            ),
+        )
+        for c in range(n)
+    )
 
 
 def _band_throttle(name: str, grp: str, sum_mc: int):
@@ -110,12 +150,18 @@ def _seed_remote_store(store, scn: Scenario, topology: Dict) -> None:
     for spec in topology["pods"]:
         sums[spec["grp"]] = sums.get(spec["grp"], 0) + spec["cpu_m"]
     _BAND_OFFSET_MC = 300
+    from dataclasses import replace as _dreplace
+
     for i in range(topo.throttles):
         grp = f"g{i % max(topo.groups, 1)}"
         if i % 24 == 1 and sums.get(grp):
-            store.create_throttle(
-                _band_throttle(f"t{i}", grp, sums[grp] + _BAND_OFFSET_MC)
-            )
+            thr = _band_throttle(f"t{i}", grp, sums[grp] + _BAND_OFFSET_MC)
+            accel = _accel_entries(topo, sums[grp] + _BAND_OFFSET_MC)
+            if accel:
+                thr = _dreplace(
+                    thr, spec=_dreplace(thr.spec, accel_class_thresholds=accel)
+                )
+            store.create_throttle(thr)
         else:
             store.create_throttle(served_throttle(i, topo.groups, flip_band_mc=band))
     if topology["n_hot"] > 0:
@@ -131,7 +177,10 @@ def _seed_remote_store(store, scn: Scenario, topology: Dict) -> None:
         )
     for spec in topology["pods"]:
         store.create_pod(
-            _materialize_pod(spec["name"], spec["grp"], spec["node"], spec["cpu_m"])
+            _materialize_pod(
+                spec["name"], spec["grp"], spec["node"], spec["cpu_m"],
+                **_pod_fields(spec),
+            )
         )
 
 
@@ -248,13 +297,15 @@ class _Replayer:
                 if verb == "update_pod":
                     remote.update_pod(
                         _materialize_pod(
-                            op["name"], grp, op["node"], op["cpu_m"]
+                            op["name"], grp, op["node"], op["cpu_m"],
+                            **_pod_fields(op),
                         )
                     )
                 elif verb == "create_pod":
                     remote.create_pod(
                         _materialize_pod(
-                            op["name"], grp, op["node"], op["cpu_m"]
+                            op["name"], grp, op["node"], op["cpu_m"],
+                            **_pod_fields(op),
                         )
                     )
                 elif verb == "delete_pod":

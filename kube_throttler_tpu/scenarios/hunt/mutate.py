@@ -34,7 +34,9 @@ __all__ = [
     "BOUNDS",
     "MUTABLE_FAULT_SITES",
     "MUTATORS",
+    "SHARD_TIER_PREFIXES",
     "mutate",
+    "needs_shard_tier",
     "normalize",
     "program_sha",
     "program_size",
@@ -43,7 +45,11 @@ __all__ = [
 # site → the modes a mutator may arm there. Every key MUST be a member of
 # faults.plan.KNOWN_SITES (pinned by tests/test_hunt.py): an unregistered
 # site silently never fires, which would make the mutant a wasted
-# evaluation. shard.worker.kill only fires in the sharded replay tier and
+# evaluation. shard.worker.kill and the reshard.* family only fire in the
+# sharded replay tier — a program arming any of them routes through
+# scenarios.sharded.run_sharded_program (scenarios/__main__.py), which
+# replays the trace against the real multiprocess stack and drives one
+# live rescale so the sites are actually reachable end to end.
 # scenario.leader.kill is armed via the leader_kill flag, not a FaultSpec.
 MUTABLE_FAULT_SITES: Dict[str, Tuple[str, ...]] = {
     "mock.list": ("error", "gone", "delay"),
@@ -61,7 +67,19 @@ MUTABLE_FAULT_SITES: Dict[str, Tuple[str, ...]] = {
     "scenario.apiserver.restart": ("restart", "expire_continues"),
     "scenario.churn.stall": ("delay",),
     "shard.worker.kill": ("kill",),
+    "reshard.handoff.torn": ("torn", "error"),
+    "reshard.dest.crash": ("kill", "error"),
+    "reshard.fence.race": ("error",),
+    "reshard.front.crash": ("error",),
 }
+
+# the sharded-tier families: a program arming any of these is evaluated
+# through the multiprocess replayer, not the single-process engine
+SHARD_TIER_PREFIXES = ("shard.", "reshard.")
+
+
+def needs_shard_tier(scn: Scenario) -> bool:
+    return any(f.site.startswith(SHARD_TIER_PREFIXES) for f in scn.faults)
 
 # the hunt tier's validity envelope: wide enough to reach interesting
 # regimes (the 1-core composed-stack knee, hot-key dominance, relist
@@ -74,6 +92,9 @@ BOUNDS = {
     "rate_hz": (100.0, 900.0),
     "duration_s": (1.2, 15.0),
     "max_faults": 6,
+    "gang_size": (0, 48),
+    "accel_classes": (0, 6),
+    "class_threshold_frac": (0.0, 0.8),
 }
 
 
@@ -136,6 +157,12 @@ def normalize(scn: Scenario) -> Scenario:
         groups=_clamp(min(topo.groups, max(topo.pods // 8, 8)), *BOUNDS["groups"]),
         nodes=_clamp(topo.nodes, *BOUNDS["nodes"]),
         hot_frac=_clamp(topo.hot_frac, 0.0, 0.5),
+        gang_size=_clamp(int(topo.gang_size), *BOUNDS["gang_size"]),
+        accel_classes=_clamp(int(topo.accel_classes), *BOUNDS["accel_classes"]),
+        class_threshold_frac=round(
+            _clamp(float(topo.class_threshold_frac),
+                   *BOUNDS["class_threshold_frac"]), 3
+        ),
     )
     arrival = replace(
         scn.arrival, rate_hz=_clamp(scn.arrival.rate_hz, *BOUNDS["rate_hz"])
@@ -243,6 +270,33 @@ def _mut_topology_hot(scn: Scenario, rng: random.Random):
 def _mut_topology_nodes(scn: Scenario, rng: random.Random):
     return replace(
         scn, topology=replace(scn.topology, nodes=rng.choice([2, 4, 8, 12, 16]))
+    )
+
+
+def _mut_topology_gang(scn: Scenario, rng: random.Random):
+    """Gang axis (PR 7): toggle/resize the PodGroup cohorts the initial
+    population is stamped with — group-size choices cross the per-group
+    pod counts, so mutants cover never-completable and exactly-fitting
+    gangs alike."""
+    choices = [0, 2, 4, 8, 16, 32]
+    if scn.topology.gang_size in choices:
+        choices.remove(scn.topology.gang_size)
+    return replace(
+        scn, topology=replace(scn.topology, gang_size=rng.choice(choices))
+    )
+
+
+def _mut_topology_accel(scn: Scenario, rng: random.Random):
+    """Heterogeneity axis (PR 7): the accel-class mix and the per-class
+    threshold skew — class-resolved admission diverges from the base
+    inequality once both are on."""
+    n = rng.choice([0, 2, 3, 4, 6])
+    frac = 0.0 if n == 0 else rng.choice([0.2, 0.4, 0.6, 0.8])
+    return replace(
+        scn,
+        topology=replace(
+            scn.topology, accel_classes=n, class_threshold_frac=frac
+        ),
     )
 
 
@@ -358,6 +412,8 @@ MUTATORS: List[Tuple[str, Callable[[Scenario, random.Random], Optional[Scenario]
     ("topology_scale", _mut_topology_scale),
     ("topology_hot", _mut_topology_hot),
     ("topology_nodes", _mut_topology_nodes),
+    ("topology_gang", _mut_topology_gang),
+    ("topology_accel", _mut_topology_accel),
     ("pattern", _mut_pattern),
     ("mix", _mut_mix),
     ("leader_kill", _mut_leader_kill),

@@ -77,17 +77,28 @@ def build_topology(scn: Scenario, seed: int) -> Dict:
     rng = random.Random(f"{scn.name}/{seed}/topo")
     topo = scn.topology
     n_hot = int(topo.pods * topo.hot_frac)
+    n_classes = getattr(topo, "accel_classes", 0)
+    gang_size = getattr(topo, "gang_size", 0)
+    gang_counters: Dict[str, int] = {}
     pods: List[Dict] = []
     for i in range(topo.pods):
         grp = "hot" if i < n_hot else f"g{rng.randrange(topo.groups)}"
-        pods.append(
-            {
-                "name": f"p{i}",
-                "grp": grp,
-                "cpu_m": rng.randrange(1, 8) * 100,
-                "node": f"n{i % max(topo.nodes, 1)}",
-            }
-        )
+        spec = {
+            "name": f"p{i}",
+            "grp": grp,
+            "cpu_m": rng.randrange(1, 8) * 100,
+            "node": f"n{i % max(topo.nodes, 1)}",
+        }
+        # gang/heterogeneity axes (PR 7 admission paths): keys appear ONLY
+        # when the axis is on, so axis-off topologies — every committed
+        # trace — keep their exact bytes and shas
+        if n_classes > 0:
+            spec["acl"] = f"ac{i % n_classes}"
+        if gang_size > 0:
+            c = gang_counters.get(grp, 0)
+            gang_counters[grp] = c + 1
+            spec["gang"] = f"gg-{grp}-{c // gang_size}"
+        pods.append(spec)
     return {"pods": pods, "n_hot": n_hot}
 
 
@@ -106,6 +117,19 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
     cur_cpu = {p["name"]: p["cpu_m"] for p in topology["pods"]}
     grp_of = {p["name"]: p["grp"] for p in topology["pods"]}
     node_of = {p["name"]: p["node"] for p in topology["pods"]}
+    acl_of = {p["name"]: p["acl"] for p in topology["pods"] if "acl" in p}
+    gang_of = {p["name"]: p["gang"] for p in topology["pods"] if "gang" in p}
+    n_classes = getattr(topo, "accel_classes", 0)
+    gang_size = getattr(topo, "gang_size", 0)
+
+    def annot_fields(name: str) -> Dict:
+        out: Dict = {}
+        if name in acl_of:
+            out["acl"] = acl_of[name]
+        if name in gang_of:
+            out["gang"] = gang_of[name]
+            out["gsz"] = gang_size
+        return out
     alive = [p["name"] for p in topology["pods"]]
     alive_set = set(alive)
     weights = scn.mix_weights()
@@ -141,7 +165,7 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
         emit(
             t, "update_pod",
             name=name, grp=grp_of[name], node=node_of[name],
-            cpu_m=new_cpu, prev_m=prev,
+            cpu_m=new_cpu, prev_m=prev, **annot_fields(name),
         )
 
     def emit_create(t: float, name: str, grp: str, node: str) -> None:
@@ -149,9 +173,14 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
         cur_cpu[name] = cpu
         grp_of[name] = grp
         node_of[name] = node
+        if n_classes > 0 and name not in acl_of:
+            acl_of[name] = f"ac{rng.randrange(n_classes)}"
         alive.append(name)
         alive_set.add(name)
-        emit(t, "create_pod", name=name, grp=grp, node=node, cpu_m=cpu, prev_m=0)
+        emit(
+            t, "create_pod", name=name, grp=grp, node=node, cpu_m=cpu,
+            prev_m=0, **annot_fields(name),
+        )
 
     def emit_delete(t: float, name: str) -> None:
         alive_set.discard(name)

@@ -59,7 +59,13 @@ from ..plugin.framework import Status, StatusCode
 from ..utils.lockorder import guard_attrs, make_lock
 from ..utils.tracing import PhaseTracer, vlog
 from .ipc import ShardUnavailable
-from .ring import HashRing, route_key_for
+from .ring import (
+    HashRing,
+    RangeMove,
+    TransitionRouting,
+    route_key_for,
+    stable_hash64,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -81,6 +87,9 @@ class AdmissionFront:
     GUARDED_BY = {
         "_owner": "self._route_lock",
         "_pod_routes": "self._route_lock",
+        "_route_hash": "self._route_lock",
+        "_mirror": "self._route_lock",
+        "_transition": "self._route_lock",
         "_gang_routes": "self._txn_lock",
         "_txn_seq": "self._txn_lock",
         "route_misses": "self._route_lock",
@@ -110,6 +119,14 @@ class AdmissionFront:
         self._txn_lock = make_lock("shard.front.txn")
         # (kind, key) -> owning shard id
         self._owner: Dict[Tuple[str, str], int] = {}
+        # (kind, key) -> ring position of its route key (reshard range
+        # membership without re-fingerprinting the object)
+        self._route_hash: Dict[Tuple[str, str], int] = {}
+        # live resharding: (kind, key) -> (mirror shard, range index)
+        # while the covering range is warming; the dual-ring router for
+        # keys first seen mid-transition
+        self._mirror: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        self._transition: Optional[TransitionRouting] = None
         # pod key -> frozenset of shard ids the pod was last routed to
         self._pod_routes: Dict[str, FrozenSet[int]] = {}
         # gang group key -> shard ids holding a prepared reserve
@@ -157,6 +174,12 @@ class AdmissionFront:
         self._m_scatter = m["scatter"]
         self._m_aborts = m["aborts"]
         self._m_misses = m["misses"]
+        from ..metrics import register_reshard_metrics
+
+        # kube_throttler_reshard_* families: the gauge samples
+        # reshard_state() at scrape; the coordinator drives the counters
+        # and the cutover histogram through this dict
+        self.reshard_metrics = register_reshard_metrics(self.metrics_registry, self)
         self.health = Health()
         self.health.register("shards", self._shards_health)
         # the Router: batch listener + per-event handlers on the store
@@ -265,9 +288,13 @@ class AdmissionFront:
         if event.type is EventType.DELETED:
             with self._route_lock:
                 owner = self._owner.pop((kind, key), None)
+                self._route_hash.pop((kind, key), None)
+                mirror = self._mirror.pop((kind, key), None)
             idx.remove_throttle(key)
             if owner is not None:
                 buffers.setdefault(owner, []).append(("delete", kind, store_key))
+            if mirror is not None:
+                buffers.setdefault(mirror[0], []).append(("delete", kind, store_key))
             return
         spec_changed = (
             event.type is EventType.ADDED
@@ -279,39 +306,54 @@ class AdmissionFront:
             # or a local write; the owner computes statuses, don't route
             idx.refresh_throttle_object(thr)
             return
-        owner = self.ring.shard_of(route_key_for(kind, thr))
+        h = stable_hash64(route_key_for(kind, thr))
         with self._route_lock:
+            tr = self._transition
+            if tr is None:
+                owner, move = self.ring.owner_of_hash(h), None
+            else:
+                owner, move = tr.owner_of_hash(h), tr.mirror_of_hash(h)
             prev = self._owner.get((kind, key))
             self._owner[(kind, key)] = owner
+            self._route_hash[(kind, key)] = h
+            if move is not None:
+                self._mirror[(kind, key)] = (move.dst, move.index)
+            else:
+                self._mirror.pop((kind, key), None)
         idx.upsert_throttle(thr)
         if prev is not None and prev != owner:
             # selector edit moved the key: migrate object + matching pods
             buffers.setdefault(prev, []).append(("delete", kind, store_key))
-        buffers.setdefault(owner, []).append(("upsert", kind, thr))
-        # the (new) owner must hold every pod this throttle matches; send
-        # the ones not already routed there (set-difference via the route
-        # map keeps this O(matched), no full-store scan)
+        targets = [owner] if move is None else [owner, move.dst]
+        for sid in targets:
+            buffers.setdefault(sid, []).append(("upsert", kind, thr))
+        # the (new) owner — and a warming mirror — must hold every pod this
+        # throttle matches; send the ones not already routed there (set-
+        # difference via the route map keeps this O(matched), no full scan)
         matched = idx.matched_pod_keys(key)
         if matched:
-            pods_needed = []
+            pods_needed: Dict[str, List[int]] = {}
             with self._route_lock:
                 for pkey in matched:
                     routes = self._pod_routes.get(pkey, frozenset())
-                    if owner not in routes:
-                        self._pod_routes[pkey] = routes | {owner}
-                        pods_needed.append(pkey)
-            for pkey in pods_needed:
+                    missing = [sid for sid in targets if sid not in routes]
+                    if missing:
+                        self._pod_routes[pkey] = routes | set(missing)
+                        pods_needed[pkey] = missing
+            for pkey, sids in pods_needed.items():
                 ns, _, pname = pkey.partition("/")
                 try:
                     pod = self.store.get_pod(ns, pname)
                 except NotFoundError:
                     continue
-                buffers.setdefault(owner, []).append(("upsert", "Pod", pod))
+                for sid in sids:
+                    buffers.setdefault(sid, []).append(("upsert", "Pod", pod))
 
     def _pod_target_shards(self, pod: Pod) -> Set[int]:
         """Shards owning at least one throttle (of either kind) whose
-        selector matches the pod — the scatter set for events, checks,
-        and reserves alike (one rule, no drift)."""
+        selector matches the pod — the AUTHORITATIVE scatter set for
+        verdicts. During a live reshard a warming mirror is deliberately
+        absent here: its verdicts are advisory until the range cuts over."""
         targets: Set[int] = set()
         with self._route_lock:
             for kind in _KINDS:
@@ -320,6 +362,22 @@ class AdmissionFront:
                     if owner is not None:
                         targets.add(owner)
         return targets
+
+    def _pod_mirror_shards(self, pod: Pod) -> Set[int]:
+        """Warming destinations holding a mirrored copy of a matching
+        throttle — the double-route extension for events and the reserve
+        fan-out (a reservation made only on the source during warm-up
+        would be missing from the destination at cutover)."""
+        mirrors: Set[int] = set()
+        with self._route_lock:
+            if not self._mirror:
+                return mirrors
+            for kind in _KINDS:
+                for key in self.index[kind].affected_throttle_keys_for(pod):
+                    m = self._mirror.get((kind, key))
+                    if m is not None:
+                        mirrors.add(m[0])
+        return mirrors
 
     def _route_pod(self, event: Event, buffers) -> None:
         pod: Pod = event.obj
@@ -334,7 +392,9 @@ class AdmissionFront:
             for sid in routes:
                 buffers.setdefault(sid, []).append(("delete", "Pod", pod.key))
             return
-        new_set = frozenset(self._pod_target_shards(pod))
+        new_set = frozenset(
+            self._pod_target_shards(pod) | self._pod_mirror_shards(pod)
+        )
         with self._route_lock:
             old_set = self._pod_routes.get(pod.key, frozenset())
             if new_set:
@@ -501,6 +561,18 @@ class AdmissionFront:
         with self.tracer.trace("prefilter_batch"):
             alive = [s for s in range(self.n_shards) if self._alive(s) is not None]
             results = self._scatter(alive, "pre_filter_batch", None, timeout=120.0)
+            # during a live reshard the AND-merge must consult only each
+            # pod's AUTHORITATIVE owners: a warming mirror's verdict is
+            # advisory (it may lag the source), and a dead mirror must not
+            # fail-safe pods whose owners are healthy
+            owner_filter: Optional[Dict[str, Set[int]]] = None
+            with self._route_lock:
+                transition_active = self._transition is not None
+            if transition_active:
+                owner_filter = {
+                    pod.key: self._pod_target_shards(pod)
+                    for pod in self.store.list_pods()
+                }
             schedulable: Dict[str, bool] = {}
             errors: Set[str] = set()
             for sid in sorted(results):
@@ -508,6 +580,11 @@ class AdmissionFront:
                 if isinstance(r, Exception):
                     continue  # its routed pods are handled as down below
                 for key, ok in r["schedulable"].items():
+                    if (
+                        owner_filter is not None
+                        and sid not in owner_filter.get(key, frozenset())
+                    ):
+                        continue
                     schedulable[key] = schedulable.get(key, True) and bool(ok)
                 errors.update(r["errors"])
             # pods routed to a shard that answered nothing are dark: fail
@@ -518,11 +595,16 @@ class AdmissionFront:
                 if sid not in results or isinstance(results.get(sid), Exception)
             }
             if dead:
-                with self._route_lock:
-                    routes = dict(self._pod_routes)
-                for pkey, sids in routes.items():
-                    if sids & dead:
-                        schedulable[pkey] = False
+                if owner_filter is not None:
+                    for pkey, sids in owner_filter.items():
+                        if sids & dead:
+                            schedulable[pkey] = False
+                else:
+                    with self._route_lock:
+                        routes = dict(self._pod_routes)
+                    for pkey, sids in routes.items():
+                        if sids & dead:
+                            schedulable[pkey] = False
             known_ns = {ns.name for ns in self.store.list_namespaces()}
             for pod in self.store.list_pods():
                 if pod.key not in schedulable and pod.key not in errors:
@@ -545,7 +627,12 @@ class AdmissionFront:
         abort) from the front. Any prepare failure aborts the prepared
         subset — no cross-shard transaction, no partial reserve."""
         with self.tracer.trace("reserve"):
-            targets = sorted(self._pod_target_shards(pod))
+            # mirrors ride the two-phase fan-out: a reserve prepared only
+            # on the source during a handoff would be missing from the
+            # destination at cutover (a lost reservation, not an orphan)
+            targets = sorted(
+                self._pod_target_shards(pod) | self._pod_mirror_shards(pod)
+            )
             if not targets:
                 return Status(StatusCode.SUCCESS)
             txn = self._next_txn()
@@ -569,7 +656,9 @@ class AdmissionFront:
 
     def unreserve(self, pod: Pod, node: str = "") -> None:
         with self.tracer.trace("unreserve"):
-            targets = sorted(self._pod_target_shards(pod))
+            targets = sorted(
+                self._pod_target_shards(pod) | self._pod_mirror_shards(pod)
+            )
             results = self._scatter(targets, "unreserve", pod)
             for sid, r in results.items():
                 if isinstance(r, Exception):
@@ -585,11 +674,30 @@ class AdmissionFront:
         targets: Set[int] = set()
         for pod in pods:
             targets |= self._pod_target_shards(pod)
+            targets |= self._pod_mirror_shards(pod)
         targets.add(self.gang_owner(group_key))
+        mirror = self._gang_mirror(group_key)
+        if mirror is not None:
+            targets.add(mirror)
         return sorted(targets)
 
     def gang_owner(self, group_key: str) -> int:
-        return self.ring.shard_of(route_key_for("Gang", group_key))
+        h = stable_hash64(route_key_for("Gang", group_key))
+        with self._route_lock:
+            tr = self._transition
+        if tr is not None:
+            return tr.owner_of_hash(h)
+        return self.ring.owner_of_hash(h)
+
+    def _gang_mirror(self, group_key: str) -> Optional[int]:
+        h = stable_hash64(route_key_for("Gang", group_key))
+        with self._route_lock:
+            tr = self._transition
+        if tr is not None:
+            move = tr.mirror_of_hash(h)
+            if move is not None:
+                return move.dst
+        return None
 
     def pre_filter_gang(self, group_key: str, pods: Sequence[Pod]) -> Status:
         """Group feasibility scatter-gather. Feasibility partitions by
@@ -678,6 +786,95 @@ class AdmissionFront:
                     if self._alive(sid) is not None
                 ]
             self._scatter(list(targets), "gang_rollback", {"group": group_key})
+
+    # ------------------------------------------------------ live resharding
+    # (driven by sharding/reshard.ReshardCoordinator; every mutation of
+    # the routing maps happens under the route lock, so a cutover is
+    # atomic with respect to the Router and the scatter target builders)
+
+    def begin_reshard(self, transition: TransitionRouting) -> None:
+        """Install the dual-ring transition router. From here until
+        ``finish_reshard``/``cancel_reshard``, new keys route through it
+        (old-ring owner until the covering range cuts over)."""
+        with self._route_lock:
+            self._transition = transition
+
+    def begin_range(self, move: RangeMove) -> int:
+        """Turn double-routing ON for one moving range: every owned key
+        whose route hash the range covers gains a mirror entry, and keys
+        first seen from now on mirror via the transition router. Returns
+        the number of keys mirrored."""
+        n = 0
+        with self._route_lock:
+            if self._transition is not None:
+                self._transition.set_state(move.index, TransitionRouting.MIRRORING)
+            for (kind, key), h in self._route_hash.items():
+                if move.covers(h):
+                    self._mirror[(kind, key)] = (move.dst, move.index)
+                    n += 1
+        return n
+
+    def cutover_range(self, move: RangeMove) -> int:
+        """The atomic per-range cutover: re-point every mirrored key's
+        owner at the destination and drop its mirror entry, all under one
+        route-lock hold — no event, check, or reserve can observe a
+        half-cut range. Returns keys re-pointed."""
+        n = 0
+        with self._route_lock:
+            if self._transition is not None:
+                self._transition.set_state(move.index, TransitionRouting.CUT)
+            for (kind, key), (dst, ridx) in list(self._mirror.items()):
+                if ridx == move.index:
+                    self._owner[(kind, key)] = dst
+                    del self._mirror[(kind, key)]
+                    n += 1
+        return n
+
+    def abort_range(self, move: RangeMove) -> int:
+        """Abort-back-to-source: drop the range's mirror entries (owners
+        were never re-pointed) and return the range to ``pending`` so a
+        later attempt can re-stream it."""
+        n = 0
+        with self._route_lock:
+            if self._transition is not None:
+                self._transition.set_state(move.index, TransitionRouting.PENDING)
+            for (kind, key), (_dst, ridx) in list(self._mirror.items()):
+                if ridx == move.index:
+                    del self._mirror[(kind, key)]
+                    n += 1
+        return n
+
+    def finish_reshard(self, new_ring: HashRing, n_shards: int) -> None:
+        """Adopt the target ring as THE ring (every range cut over) and
+        drop the transition router."""
+        with self._route_lock:
+            self.ring = new_ring
+            self._transition = None
+            self._mirror.clear()
+        self.n_shards = int(n_shards)
+
+    def cancel_reshard(self) -> None:
+        """Abandon a reshard whose every range was aborted: the old ring
+        stays authoritative (owners were never re-pointed)."""
+        with self._route_lock:
+            self._transition = None
+            self._mirror.clear()
+
+    def reshard_state(self) -> Optional[Dict[str, object]]:
+        with self._route_lock:
+            tr = self._transition
+            mirrored = len(self._mirror)
+        if tr is None:
+            return None
+        states = list(tr.state.values())
+        return {
+            "moves": len(states),
+            "pending": states.count(TransitionRouting.PENDING),
+            "mirroring": states.count(TransitionRouting.MIRRORING),
+            "cut": states.count(TransitionRouting.CUT),
+            "mirrored_keys": mirrored,
+            "target_shards": tr.new_ring.n_shards,
+        }
 
     # ------------------------------------------------------- resync / drain
 
@@ -787,6 +984,7 @@ class AdmissionFront:
             "routed_pods": routed_pods,
             "owned_throttles": owned,
             "two_phase_aborts": aborts,
+            "reshard": self.reshard_state(),
         }
 
     # ------------------------------------------------------------- lifecycle

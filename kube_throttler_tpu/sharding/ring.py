@@ -27,11 +27,21 @@ from __future__ import annotations
 import bisect
 import hashlib
 import json
-from typing import List, Tuple, Union
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Union
 
 from ..api.types import ClusterThrottle, Throttle
 
-__all__ = ["stable_hash64", "selector_fingerprint", "route_key_for", "HashRing"]
+__all__ = [
+    "stable_hash64",
+    "selector_fingerprint",
+    "route_key_for",
+    "HashRing",
+    "RangeMove",
+    "ReshardPlan",
+    "plan_reshard",
+    "TransitionRouting",
+]
 
 
 def stable_hash64(key: str) -> int:
@@ -121,3 +131,141 @@ class HashRing:
         for k in keys:
             counts[self.shard_of(k)] += 1
         return counts
+
+    def owner_of_hash(self, h: int) -> int:
+        """Shard owning a raw 64-bit ring position (resharding plumbing:
+        the plan and the transition router reason in hash space, not key
+        space, so a range statement covers keys that do not exist yet)."""
+        if self.n_shards == 1:
+            return 0
+        i = bisect.bisect_right(self._hashes, int(h))
+        if i == len(self._hashes):
+            i = 0
+        return self._shards[i]
+
+    def boundaries(self) -> List[int]:
+        """The sorted vnode positions (plan_reshard merges old+new)."""
+        return list(self._hashes)
+
+
+# --------------------------------------------------------------------------
+# live resharding: retarget plans + the dual-ring transition router
+# --------------------------------------------------------------------------
+
+_HASH_SPACE = 1 << 64
+
+
+@dataclass(frozen=True)
+class RangeMove:
+    """One moving keyspace range: the half-open hash interval
+    ``[lo, hi)`` whose owner changes from ``src`` to ``dst`` when the
+    ring retargets. ``hi == 2**64`` closes the top of the circle (the
+    wrap segment is split at 0 so every move is a plain interval)."""
+
+    index: int  # position in the plan (the coordinator's range id)
+    lo: int
+    hi: int
+    src: int
+    dst: int
+
+    def covers(self, h: int) -> bool:
+        return self.lo <= h < self.hi
+
+
+@dataclass(frozen=True)
+class ReshardPlan:
+    """The minimal transfer set between two rings: only intervals whose
+    owner differs appear (a key outside every move never transfers), and
+    the plan is a pure function of the two ring parameter tuples — any
+    two processes that agree on (n_old, n_new, vnodes) agree on the plan
+    byte for byte (tests/test_reshard.py pins this)."""
+
+    old_shards: int
+    new_shards: int
+    moves: Tuple[RangeMove, ...]
+
+    def move_for_hash(self, h: int) -> Optional[RangeMove]:
+        lows = [m.lo for m in self.moves]
+        i = bisect.bisect_right(lows, h) - 1
+        if i >= 0 and self.moves[i].covers(h):
+            return self.moves[i]
+        return None
+
+    def moves_from(self, src: int) -> List[RangeMove]:
+        return [m for m in self.moves if m.src == src]
+
+
+def plan_reshard(old: HashRing, new: HashRing) -> ReshardPlan:
+    """Compute the split/merge plan between two rings. Walk the merged
+    boundary set: between consecutive boundaries ownership is constant
+    under BOTH rings, so each elementary interval is wholly moving or
+    wholly staying; adjacent moving intervals with the same (src, dst)
+    coalesce into one :class:`RangeMove`."""
+    cuts = sorted(set(old.boundaries()) | set(new.boundaries()) | {0, _HASH_SPACE})
+    raw: List[Tuple[int, int, int, int]] = []  # (lo, hi, src, dst)
+    for lo, hi in zip(cuts, cuts[1:]):
+        if lo >= hi:
+            continue
+        src = old.owner_of_hash(lo)
+        dst = new.owner_of_hash(lo)
+        if src == dst:
+            continue
+        if raw and raw[-1][1] == lo and raw[-1][2] == src and raw[-1][3] == dst:
+            raw[-1] = (raw[-1][0], hi, src, dst)
+        else:
+            raw.append((lo, hi, src, dst))
+    moves = tuple(
+        RangeMove(index=i, lo=lo, hi=hi, src=src, dst=dst)
+        for i, (lo, hi, src, dst) in enumerate(raw)
+    )
+    return ReshardPlan(
+        old_shards=old.n_shards, new_shards=new.n_shards, moves=moves
+    )
+
+
+class TransitionRouting:
+    """Dual-ring routing during a live reshard: every key has exactly ONE
+    authoritative owner at every instant (the zero-owner-never invariant
+    the retarget tests sweep) — the old ring's owner until the covering
+    range cuts over, the new ring's after. ``mirror_of`` names the
+    destination while its range is warming (streaming + double-routing),
+    so the front can mirror events without consulting the destination's
+    verdicts.
+
+    State transitions per range: ``pending`` → ``mirroring`` → ``cut``
+    (success) or back to ``pending`` (abort-back-to-source). Mutation
+    happens only under the front's route lock; readers race-free snapshot
+    via the plain dict (CPython dict reads are atomic; a torn read is at
+    worst one-event-late routing, repaired by the cutover's fence)."""
+
+    PENDING = "pending"
+    MIRRORING = "mirroring"
+    CUT = "cut"
+
+    def __init__(self, old_ring: HashRing, new_ring: HashRing,
+                 plan: Optional[ReshardPlan] = None):
+        self.old_ring = old_ring
+        self.new_ring = new_ring
+        self.plan = plan if plan is not None else plan_reshard(old_ring, new_ring)
+        self.state: Dict[int, str] = {m.index: self.PENDING for m in self.plan.moves}
+
+    def set_state(self, index: int, state: str) -> None:
+        self.state[index] = state
+
+    def owner_of_hash(self, h: int) -> int:
+        move = self.plan.move_for_hash(h)
+        if move is None:
+            return self.new_ring.owner_of_hash(h)  # == old owner by plan
+        return move.dst if self.state.get(move.index) == self.CUT else move.src
+
+    def mirror_of_hash(self, h: int) -> Optional[RangeMove]:
+        move = self.plan.move_for_hash(h)
+        if move is not None and self.state.get(move.index) == self.MIRRORING:
+            return move
+        return None
+
+    def owner_of(self, route_key: str) -> int:
+        return self.owner_of_hash(stable_hash64(route_key))
+
+    def complete(self) -> bool:
+        return all(s == self.CUT for s in self.state.values())

@@ -50,6 +50,7 @@ class ShardSupervisor:
         restart_backoff: float = 0.5,
         max_restarts: int = 10,
         worker_args: Optional[List[str]] = None,
+        per_shard_args: Optional[Dict[int, List[str]]] = None,
         env: Optional[dict] = None,
     ):
         self.front = front
@@ -62,16 +63,25 @@ class ShardSupervisor:
         self.restart_backoff = restart_backoff
         self.max_restarts = max_restarts
         self.worker_args = list(worker_args or [])
+        # one-shot per-shard args for each shard's FIRST incarnation only
+        # (chaos rules that must not re-arm on a monitor respawn)
+        self.per_shard_args: Dict[int, List[str]] = dict(per_shard_args or {})
         self.env = env
         self._proc_lock = make_lock("shard.supervisor.procs")
         self.procs: Dict[int, subprocess.Popen] = {}
         self.restarts: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # one rescale at a time: concurrent callers fail fast (two ring
+        # retargets would fight over the front's single transition
+        # router); callers wanting a scale PATH sequence steps themselves
+        self._rescale_busy = threading.Lock()
 
     # ------------------------------------------------------------- spawning
 
-    def _spawn(self, shard_id: int) -> subprocess.Popen:
+    def _spawn(
+        self, shard_id: int, extra_args: Optional[List[str]] = None
+    ) -> subprocess.Popen:
         parent_sock, child_sock = socket.socketpair()
         try:
             argv = [
@@ -88,6 +98,13 @@ class ShardSupervisor:
             if self.data_dir:
                 argv += ["--data-dir", os.path.join(self.data_dir, f"shard-{shard_id}")]
             argv += self.worker_args
+            # one-shot args (a chaos rule armed for THIS incarnation only:
+            # a monitor respawn after the armed kill must come up clean,
+            # not re-arm the same crash forever)
+            if extra_args is None:
+                extra_args = self.per_shard_args.pop(shard_id, None)
+            if extra_args:
+                argv += list(extra_args)
             env = dict(os.environ if self.env is None else self.env)
             env.setdefault("JAX_PLATFORMS", "cpu")
             proc = subprocess.Popen(
@@ -161,7 +178,9 @@ class ShardSupervisor:
                 logger.exception("shard monitor tick failed")
 
     def _monitor_tick(self) -> None:
-        for sid in range(self.n_shards):
+        with self._proc_lock:
+            sids = sorted(self.procs)
+        for sid in sids:
             with self._proc_lock:
                 proc = self.procs.get(sid)
             if proc is None or proc.poll() is None:
@@ -169,7 +188,7 @@ class ShardSupervisor:
             if self._stop.is_set():
                 return
             with self._proc_lock:
-                self.restarts[sid] += 1
+                self.restarts[sid] = self.restarts.get(sid, 0) + 1
                 budget_spent = self.restarts[sid] > self.max_restarts
                 attempt = self.restarts[sid]
             if budget_spent:
@@ -207,6 +226,95 @@ class ShardSupervisor:
                 self.front.resync_shard(sid)
             except Exception:  # noqa: BLE001 — retried on the next tick
                 logger.exception("shard %d restart failed", sid)
+
+    # ------------------------------------------------------ live resharding
+
+    def _wait_ready(self, sid: int, proc: subprocess.Popen,
+                    ready_timeout: float) -> None:
+        deadline = time.monotonic() + ready_timeout
+        while True:
+            try:
+                self.front.shards[sid].request("ping", None, timeout=5.0)
+                return
+            except Exception:  # noqa: BLE001 — keep waiting until deadline
+                if time.monotonic() > deadline or self._stop.is_set():
+                    raise RuntimeError(
+                        f"shard {sid} did not become ready in {ready_timeout}s"
+                    ) from None
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"shard {sid} exited rc={proc.returncode} during startup"
+                    ) from None
+                time.sleep(0.1)
+
+    def rescale(
+        self,
+        n_new: int,
+        ready_timeout: float = 120.0,
+        handoff_deadline_s: float = 180.0,
+        spawn_args: Optional[Dict[int, List[str]]] = None,
+    ) -> Dict:
+        """Live split/merge to ``n_new`` shards, NO restarts of existing
+        workers: spawn any missing destinations, run the fenced two-phase
+        handoff for every moving range (sharding/reshard.py), then retire
+        workers above the new count. ``spawn_args`` arms one-shot chaos
+        flags (e.g. ``--fault-site reshard.dest.crash:kill:2``) on a
+        specific NEW shard's first incarnation — its monitor respawn
+        comes up clean, which is exactly the kill-mid-handoff retry path
+        the resharding scenario drives."""
+        from .reshard import ReshardCoordinator
+        from .ring import HashRing
+
+        if not self._rescale_busy.acquire(blocking=False):
+            raise RuntimeError("a rescale is already in progress")
+        try:
+            return self._rescale_step(
+                n_new, ready_timeout, handoff_deadline_s, spawn_args,
+                ReshardCoordinator, HashRing,
+            )
+        finally:
+            self._rescale_busy.release()
+
+    def _rescale_step(
+        self, n_new, ready_timeout, handoff_deadline_s, spawn_args,
+        ReshardCoordinator, HashRing,
+    ) -> Dict:
+        n_old = self.n_shards
+        if n_new == n_old:
+            return {"from_shards": n_old, "to_shards": n_new, "moves": 0}
+        if n_new < 1:
+            raise ValueError("n_new must be >= 1")
+        new_ring = HashRing(n_new)
+        # the front spans the union while ranges are in flight (health,
+        # batch triage, and the scatter pool all index by shard id)
+        self.front.n_shards = max(n_old, n_new)
+        for sid in range(n_old, n_new):
+            extra = (spawn_args or {}).get(sid)
+            proc = self._spawn(sid, extra_args=extra)
+            with self._proc_lock:
+                self.restarts.setdefault(sid, 0)
+            self._wait_ready(sid, proc, ready_timeout)
+            # seed the empty destination with namespaces (it owns no keys
+            # yet, so this is broadcast-state only + a no-op prune)
+            self.front.resync_shard(sid)
+        coordinator = ReshardCoordinator(self.front)
+        report = coordinator.rescale(new_ring, deadline_s=handoff_deadline_s)
+        for sid in range(n_new, n_old):
+            handle = self.front.shards.pop(sid, None)
+            if handle is not None:
+                handle.close()
+            with self._proc_lock:
+                proc = self.procs.pop(sid, None)
+                self.restarts.pop(sid, None)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+        self.n_shards = n_new
+        return report
 
     # -------------------------------------------------------------- shutdown
 

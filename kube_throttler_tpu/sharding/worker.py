@@ -24,6 +24,23 @@ reservation stays); ``txn_abort`` unreserves. A prepared transaction
 whose front died before deciding is ABORTED by the reaper once it ages
 past ``prepare_ttl`` — a prepare-crash can never leave an orphan
 reservation (tests/test_sharding.py pins this).
+
+Live resharding, shard side (the ``reshard_*`` RPC family —
+sharding/reshard.py drives it): a SOURCE stages its moving keyspace
+slice with ``reshard_prepare`` (store objects + reservation ledger
+entries + gang records + published statuses, pickled once) and serves it
+in prefix-sha-verified chunks (``reshard_chunk``, the StandbyReplicator
+chunk contract over the framed-pickle IPC); ``reshard_fence`` makes the
+range-scoped fence refuse every later authoritative write for the moved
+ranges; ``reshard_retire`` drops the slice after cutover (fence lifted
+with it). A DESTINATION assembles chunks (``reshard_import``), applies
+the slice into its own engine stack (statuses suppressed — its verdicts
+are advisory while warming), and on ``reshard_activate`` re-enqueues
+every moved key through the two-lane PRIORITY path so every flip it
+computed during warm-up is re-published. ``reshard_abort`` rolls either
+side back to the pre-handoff state, and the txn reaper TTLs any handoff
+orphaned by a front crash between prepare and cutover — zero orphan
+reservations by the same clock that reaps two-phase reserves.
 """
 
 from __future__ import annotations
@@ -59,6 +76,10 @@ class ShardCore:
         "_pending_gangs": "self._txn_lock",
         "_gang_members": "self._txn_lock",
         "reaped_txns": "self._txn_lock",
+        "_handoffs_out": "self._txn_lock",
+        "_handoffs_in": "self._txn_lock",
+        "reshard_aborts": "self._txn_lock",
+        "reaped_handoffs": "self._txn_lock",
         "_push_buf": "self._push_lock",
     }
 
@@ -153,6 +174,16 @@ class ShardCore:
         # releases them without a ledger
         self._gang_members: Dict[str, List[object]] = {}
         self.reaped_txns = 0
+        # live resharding: staged outbound slices (this shard is a handoff
+        # SOURCE), assembling inbound slices (DESTINATION), and the
+        # range-scoped fence the event path consults post-cutover
+        from ..engine.replication import RangeFence
+
+        self._handoffs_out: Dict[str, dict] = {}  # handoff → staged slice
+        self._handoffs_in: Dict[str, dict] = {}  # handoff → assembling sink
+        self.range_fence = RangeFence()
+        self.reshard_aborts = 0
+        self.reaped_handoffs = 0
         # status push plumbing: handlers append under the push lock (they
         # run inside the store lock and must stay informer-cheap); the
         # pusher thread flushes batches to ``push``
@@ -181,9 +212,29 @@ class ShardCore:
             return
         if event.obj.status == event.old_obj.status:
             return  # spec echo routed by the front — not ours to re-publish
+        if self._import_pending_covers(event.kind, event.obj):
+            # warming destination: verdicts are ADVISORY until cutover —
+            # don't push statuses for not-yet-activated ranges (activation
+            # re-enqueues every moved key priority-first, so every flip
+            # computed during warm-up is re-published then)
+            return
         with self._push_cond:
             self._push_buf.append((event.kind, event.obj))
             self._push_cond.notify()
+
+    def _import_pending_covers(self, kind: str, obj) -> bool:
+        with self._txn_lock:
+            ranges = [
+                rng
+                for entry in self._handoffs_in.values()
+                for rng in entry["ranges"]
+            ]
+        if not ranges:
+            return False
+        from .ring import route_key_for, stable_hash64
+
+        h = stable_hash64(route_key_for(kind, obj))
+        return any(lo <= h < hi for lo, hi in ranges)
 
     def _push_loop(self) -> None:
         while not self._stop.is_set():
@@ -215,17 +266,52 @@ class ShardCore:
             fault = self.faults.check("shard.worker.kill")
             if fault is not None and fault.mode == "kill":
                 fault.kill()
+        fenced = self.range_fence.fenced_handoffs()
         batch: List[Tuple[str, str, object]] = []
         for op in ops:
             if op[0] == RESYNC_PRUNE:
                 if batch:
-                    self.pipeline.submit_many(batch)
+                    self._submit_batch(batch)
                     batch = []
                 self._prune(op[2])
                 continue
+            if fenced and self._fence_refuses(op):
+                continue
             batch.append(op)
         if batch:
-            self.pipeline.submit_many(batch)
+            self._submit_batch(batch)
+
+    def _submit_batch(self, batch: List[Tuple[str, str, object]]) -> None:
+        """Apply a routed batch — and, while a handoff slice is still
+        streaming IN, buffer a copy per unsealed handoff: a mirrored
+        event that lands mid-stream would otherwise be overwritten by the
+        (older) slice snapshot at seal time; the seal replays the buffer
+        after the snapshot so the race always resolves newest-last."""
+        with self._txn_lock:
+            for entry in self._handoffs_in.values():
+                if not entry.get("sealed"):
+                    entry["evbuf"].append(list(batch))
+        self.pipeline.submit_many(batch)
+
+    def _fence_refuses(self, op: Tuple[str, str, object]) -> bool:
+        """Post-cutover write refusal: an authoritative throttle-keyspace
+        write whose route hash lands in a fenced range is dropped and
+        counted — the destination owns that range now; a racing event the
+        front routed pre-cutover must not mutate the retiring slice (it
+        was mirrored to the destination, so nothing is lost). Pod events
+        pass: pods have no range identity and a non-matching pod is inert."""
+        verb, kind, payload = op
+        if kind not in ("Throttle", "ClusterThrottle"):
+            return False
+        if verb == "delete":
+            return False  # cleanup is always allowed (retire uses it)
+        from .ring import route_key_for, stable_hash64
+
+        h = stable_hash64(route_key_for(kind, payload))
+        if self.range_fence.covers(h):
+            self.range_fence.refuse()
+            return True
+        return False
 
     def _prune(self, want: Dict[str, Sequence[str]]) -> None:
         """Resync epilogue: everything this shard holds that the front's
@@ -374,7 +460,22 @@ class ShardCore:
         with self._txn_lock:
             reaped = self.reaped_txns
             pending = len(self._pending_txns) + len(self._pending_gangs)
+            pending_handoffs = len(self._handoffs_out) + len(self._handoffs_in)
+            reshard_aborts = self.reshard_aborts
+            reaped_handoffs = self.reaped_handoffs
+        reservations = sum(
+            len(ctr.cache.reserved_pod_keys(tk))
+            for ctr in (self.plugin.throttle_ctr, self.plugin.cluster_throttle_ctr)
+            for tk in ctr.cache.throttle_keys()
+        )
         return {
+            "pending_handoffs": pending_handoffs,
+            "reshard_aborts": reshard_aborts,
+            "reaped_handoffs": reaped_handoffs,
+            "fenced_writes_refused": self.range_fence.refused(),
+            "fenced_handoffs": self.range_fence.fenced_handoffs(),
+            "reservations": reservations,
+            "gang_groups": len(self.plugin.gang.snapshot_state()),
             "shard": self.shard_id,
             "ingest": ps,
             "workqueues": {
@@ -403,6 +504,363 @@ class ShardCore:
             },
             "applied": self.pipeline.stats()["events_applied"],
         }
+
+    # ------------------------------------------------- live resharding RPCs
+
+    @staticmethod
+    def _parse_ranges(raw) -> List[Tuple[int, int]]:
+        return [(int(lo), int(hi)) for lo, hi in raw]
+
+    @staticmethod
+    def _hash_in(ranges: Sequence[Tuple[int, int]], h: int) -> bool:
+        return any(lo <= h < hi for lo, hi in ranges)
+
+    def _rpc_reshard_prepare(self, payload):
+        """SOURCE: stage the moving slice as one pickled blob behind a
+        prefix-sha chunk source. The pipeline is flushed first so the
+        slice reflects every event routed before the front turned
+        double-routing on — the mirror stream covers everything after."""
+        import pickle
+
+        from ..engine.store import key_of
+        from .ipc import PICKLE_PROTO
+        from .ring import route_key_for, stable_hash64
+
+        handoff = payload["handoff"]
+        ranges = self._parse_ranges(payload["ranges"])
+        self.pipeline.flush(timeout=30.0)
+        moved: Dict[str, List[Tuple[str, str]]] = {}  # kind → [(store_key, t.key)]
+        objects: Dict[str, list] = {}
+        for kind, lister in (
+            ("Throttle", self.store.list_throttles),
+            ("ClusterThrottle", self.store.list_cluster_throttles),
+        ):
+            moved[kind] = []
+            objects[kind] = []
+            for thr in lister():
+                h = stable_hash64(route_key_for(kind, thr))
+                if self._hash_in(ranges, h):
+                    moved[kind].append((key_of(kind, thr), thr.key))
+                    objects[kind].append(thr)
+        moved_keys = {
+            "throttle": {tk for _, tk in moved["Throttle"]},
+            "clusterthrottle": {tk for _, tk in moved["ClusterThrottle"]},
+        }
+        reservations = {}
+        for rkind, ctr in (
+            ("throttle", self.plugin.throttle_ctr),
+            ("clusterthrottle", self.plugin.cluster_throttle_ctr),
+        ):
+            state = ctr.cache.snapshot_state()
+            reservations[rkind] = {
+                tk: entry for tk, entry in state.items() if tk in moved_keys[rkind]
+            }
+        gangs = {
+            gk: entry
+            for gk, entry in self.plugin.gang.snapshot_state().items()
+            if self._hash_in(ranges, stable_hash64(route_key_for("Gang", gk)))
+        }
+        # pods travel as the full population: every pod on this shard
+        # matches SOME local throttle; one matching both a moving and a
+        # staying throttle must exist on both sides, and a non-matching
+        # extra is inert for verdicts (aggregation is per throttle). The
+        # front's routing deletes prune the leftovers on later pod events.
+        blob = pickle.dumps(
+            {
+                "throttles": objects["Throttle"],
+                "clusterthrottles": objects["ClusterThrottle"],
+                "pods": self.store.list_pods(),
+                "reservations": reservations,
+                "gangs": gangs,
+            },
+            protocol=PICKLE_PROTO,
+        )
+        from ..engine.replication import SliceChunkSource
+
+        entry = {
+            "source": SliceChunkSource(blob),
+            "ranges": ranges,
+            "t0": time.monotonic(),
+            "moved": moved,
+            "gang_keys": sorted(gangs),
+        }
+        with self._txn_lock:
+            self._handoffs_out[handoff] = entry
+        return {
+            "bytes": len(blob),
+            "throttles": len(moved["Throttle"]) + len(moved["ClusterThrottle"]),
+            "pods": len(self.store.list_pods()),
+            "gangs": len(gangs),
+        }
+
+    def _rpc_reshard_chunk(self, payload):
+        """SOURCE: serve one verified slice chunk (the replication wire's
+        offset+hash continuity). ``reshard.handoff.torn`` mode ``torn``
+        flips a byte so the sink's hash check MUST catch it; mode
+        ``error`` tears the stream outright."""
+        with self._txn_lock:
+            entry = self._handoffs_out.get(payload["handoff"])
+        if entry is None:
+            raise RuntimeError(f"unknown handoff {payload['handoff']!r}")
+        chunk = entry["source"].chunk(payload.get("offset", 0), payload.get("sha", ""))
+        if self.faults is not None:
+            fault = self.faults.check("reshard.handoff.torn")
+            if fault is not None:
+                if fault.mode == "torn" and chunk["data"]:
+                    data = bytearray(chunk["data"])
+                    data[len(data) // 2] ^= 0xFF
+                    chunk = dict(chunk, data=bytes(data))
+                else:
+                    raise OSError(
+                        f"injected handoff stream tear (hit {fault.hit})"
+                    )
+        return chunk
+
+    def _rpc_reshard_import(self, payload):
+        """DESTINATION: assemble verified chunks; on the final one, apply
+        the slice into this shard's engine stack (objects through the
+        normal event path — index/planes follow via handler fan-out —
+        then reservation ledgers and gang records). Statuses for these
+        ranges stay suppressed until ``reshard_activate``."""
+        import pickle
+
+        from ..engine.replication import SliceChunkSink
+
+        handoff = payload["handoff"]
+        if self.faults is not None:
+            fault = self.faults.check("reshard.dest.crash")
+            if fault is not None:
+                if fault.mode == "kill":
+                    fault.kill()
+                raise fault.make_error()
+        with self._txn_lock:
+            entry = self._handoffs_in.get(handoff)
+            if entry is None:
+                entry = {
+                    "sink": SliceChunkSink(),
+                    "ranges": self._parse_ranges(payload["ranges"]),
+                    "t0": time.monotonic(),
+                    "applied": None,
+                    "sealed": False,
+                    "evbuf": [],
+                }
+                self._handoffs_in[handoff] = entry
+        entry["sink"].feed(payload["chunk"])
+        if not entry["sink"].done:
+            return {"done": False, "offset": entry["sink"].offset()}
+        slice_doc = pickle.loads(entry["sink"].payload())
+        # everything already routed to us must be applied before the
+        # snapshot lands (FIFO on the socket guarantees nothing newer is
+        # still queued behind this RPC only AFTER the pipeline drains)
+        self.pipeline.flush(timeout=30.0)
+        ops = [("upsert", "Throttle", t) for t in slice_doc["throttles"]]
+        ops += [("upsert", "ClusterThrottle", t) for t in slice_doc["clusterthrottles"]]
+        ops += [("upsert", "Pod", p) for p in slice_doc["pods"]]
+        for i in range(0, len(ops), 512):
+            self.store.apply_events(ops[i : i + 512])
+        # seal: replay every routed batch that raced the stream (they
+        # post-date the snapshot — newest content re-asserts itself),
+        # draining until no new batch sneaks in, then stop buffering
+        while True:
+            with self._txn_lock:
+                evbuf, entry["evbuf"] = entry["evbuf"], []
+                if not evbuf:
+                    entry["sealed"] = True
+                    break
+            for batch in evbuf:
+                replay = [op for op in batch if op[0] != RESYNC_PRUNE]
+                for i in range(0, len(replay), 512):
+                    self.store.apply_events(replay[i : i + 512])
+        restored = {}
+        for rkind, ctr in (
+            ("throttle", self.plugin.throttle_ctr),
+            ("clusterthrottle", self.plugin.cluster_throttle_ctr),
+        ):
+            state = slice_doc["reservations"].get(rkind) or {}
+            ctr.cache.restore_state(state)
+            for tk in state:
+                if self.plugin.device_manager is not None:
+                    self.plugin.device_manager.on_reservation_change(
+                        ctr.KIND, tk, ctr.cache
+                    )
+            restored[rkind] = sorted(state)
+        self.plugin.gang.restore_state(slice_doc["gangs"])
+        entry["applied"] = {
+            "throttle_keys": {
+                "Throttle": [t.key for t in slice_doc["throttles"]],
+                "ClusterThrottle": [t.key for t in slice_doc["clusterthrottles"]],
+            },
+            "reservations": restored,
+            "gang_keys": sorted(slice_doc["gangs"]),
+        }
+        return {
+            "done": True,
+            "objects": len(ops),
+            "gangs": len(slice_doc["gangs"]),
+        }
+
+    def _rpc_reshard_fence(self, payload):
+        """SOURCE: fence the moved ranges at the handoff's epoch — every
+        later authoritative write for them is refused (range-scoped
+        FencedEpoch semantics). The fence lifts on retire or abort, or by
+        the TTL reaper if the front dies before deciding."""
+        handoff = payload["handoff"]
+        self.range_fence.fence(
+            handoff, self._parse_ranges(payload["ranges"]),
+            int(payload.get("epoch", 0)),
+        )
+        with self._txn_lock:
+            entry = self._handoffs_out.get(handoff)
+            if entry is not None:
+                entry["fenced"] = True
+        return True
+
+    def _rpc_reshard_activate(self, payload):
+        """DESTINATION cutover: adopt the warmed slice as authoritative
+        and re-enqueue every moved key on BOTH controllers' priority
+        lanes — every flip computed during warm-up (suppressed as
+        advisory) re-publishes flips-first through the two-lane path, so
+        nothing the source never committed is lost."""
+        handoff = payload["handoff"]
+        with self._txn_lock:
+            entry = self._handoffs_in.pop(handoff, None)
+        if entry is None or entry["applied"] is None:
+            raise RuntimeError(f"handoff {handoff!r} not warmed on shard "
+                               f"{self.shard_id}")
+        requeued = 0
+        for kind, ctr in (
+            ("Throttle", self.plugin.throttle_ctr),
+            ("ClusterThrottle", self.plugin.cluster_throttle_ctr),
+        ):
+            keys = entry["applied"]["throttle_keys"][kind]
+            if keys:
+                ctr.workqueue.add_all_priority(keys)
+                requeued += len(keys)
+        return {"requeued": requeued}
+
+    def _rpc_reshard_retire(self, payload):
+        """SOURCE post-cutover: the slice left with the range — delete the
+        moved objects, release their reservations, forget their gang
+        records, lift the fence. The destination re-published everything;
+        keeping a fenced zombie copy would only feed the next resync."""
+        handoff = payload["handoff"]
+        with self._txn_lock:
+            entry = self._handoffs_out.pop(handoff, None)
+        if entry is None:
+            raise RuntimeError(f"unknown handoff {payload['handoff']!r}")
+        dropped = self._drop_slice(
+            entry["moved"],
+            {
+                "throttle": [tk for _, tk in entry["moved"]["Throttle"]],
+                "clusterthrottle": [tk for _, tk in entry["moved"]["ClusterThrottle"]],
+            },
+            entry["gang_keys"],
+        )
+        self.range_fence.lift(handoff)
+        return dropped
+
+    def _rpc_reshard_abort(self, payload):
+        """Either side, abort-back-to-source. SOURCE: lift the fence and
+        unstage — authority never left. DESTINATION: drop whatever the
+        torn handoff imported (objects, reservations, gang records) so no
+        orphan reservation and no stale verdict state survives the
+        abort."""
+        handoff = payload["handoff"]
+        with self._txn_lock:
+            out_entry = self._handoffs_out.pop(handoff, None)
+            in_entry = self._handoffs_in.pop(handoff, None)
+            if out_entry is not None or in_entry is not None:
+                self.reshard_aborts += 1
+        if out_entry is not None:
+            self.range_fence.lift(handoff)
+        if in_entry is not None and in_entry["applied"] is not None:
+            self._drop_imported(in_entry["applied"])
+        return {
+            "aborted_out": out_entry is not None,
+            "aborted_in": in_entry is not None,
+        }
+
+    def _rpc_reshard_audit(self, _payload):
+        """The zero-orphan witness: reservations held against throttle
+        keys this shard's store no longer carries (a handoff that dropped
+        the object but leaked its ledger entry), plus any pending handoff
+        or standing fence. All three must be zero/empty after every abort
+        path — the resharding scenario and the kill matrix gate on it."""
+        from ..engine.store import NotFoundError
+
+        orphans = []
+        for ctr, getter in (
+            (
+                self.plugin.throttle_ctr,
+                lambda k: self.store.get_throttle(*k.split("/", 1)),
+            ),
+            (
+                self.plugin.cluster_throttle_ctr,
+                lambda k: self.store.get_cluster_throttle(k.lstrip("/")),
+            ),
+        ):
+            for tk in ctr.cache.throttle_keys():
+                if not ctr.cache.reserved_pod_keys(tk):
+                    continue
+                try:
+                    getter(tk)
+                except NotFoundError:
+                    orphans.append(tk)
+        with self._txn_lock:
+            pending = len(self._handoffs_out) + len(self._handoffs_in)
+        return {
+            "orphan_reservations": sorted(orphans),
+            "pending_handoffs": pending,
+            "fenced_handoffs": self.range_fence.fenced_handoffs(),
+            "fenced_writes_refused": self.range_fence.refused(),
+        }
+
+    def _drop_slice(self, moved: Dict[str, list], res_keys: Dict[str, list],
+                    gang_keys) -> Dict[str, int]:
+        """Remove a slice's footprint from this shard: reservations first
+        (so the delete-driven aggregate recompute sees them gone), then
+        gang records, then the objects themselves."""
+        released = 0
+        for rkind, ctr in (
+            ("throttle", self.plugin.throttle_ctr),
+            ("clusterthrottle", self.plugin.cluster_throttle_ctr),
+        ):
+            for tk in res_keys.get(rkind, ()):
+                for pk in ctr.cache.reserved_pod_keys(tk):
+                    if ctr.cache.remove_pod_key(tk, pk):
+                        released += 1
+                if self.plugin.device_manager is not None:
+                    self.plugin.device_manager.on_reservation_change(
+                        ctr.KIND, tk, ctr.cache
+                    )
+        gangs_dropped = self.plugin.gang.drop_groups(gang_keys)
+        ops = []
+        for kind in ("Throttle", "ClusterThrottle"):
+            for store_key, _tk in moved.get(kind, ()):
+                ops.append(("delete", kind, store_key))
+        if ops:
+            self.store.apply_events(ops)
+        return {
+            "objects": len(ops),
+            "reservations": released,
+            "gangs": gangs_dropped,
+        }
+
+    def _drop_imported(self, applied: Dict) -> None:
+        from ..engine.store import NotFoundError, key_of
+
+        moved = {"Throttle": [], "ClusterThrottle": []}
+        for kind, getter in (
+            ("Throttle", lambda k: self.store.get_throttle(*k.split("/", 1))),
+            ("ClusterThrottle", lambda k: self.store.get_cluster_throttle(k.lstrip("/"))),
+        ):
+            for tk in applied["throttle_keys"][kind]:
+                try:
+                    obj = getter(tk)
+                except NotFoundError:
+                    continue
+                moved[kind].append((key_of(kind, obj), tk))
+        self._drop_slice(moved, applied["reservations"], applied["gang_keys"])
 
     # ---------------------------------------------------------------- reaper
 
@@ -434,7 +892,38 @@ class ShardCore:
             self.plugin.unreserve(pod)
         for group in stale_gangs:
             self._gang_release(group)
-        return len(stale_pods) + len(stale_gangs)
+        return len(stale_pods) + len(stale_gangs) + self.reap_stale_handoffs(now)
+
+    def reap_stale_handoffs(self, now: Optional[float] = None) -> int:
+        """The two-phase handoff reaper: a handoff orphaned past
+        ``prepare_ttl`` (front crashed between prepare and cutover) is
+        aborted on whichever side this shard played — the SOURCE lifts
+        its fence and unstages (authority never left, so the front's
+        still-source routing stays correct), the DESTINATION drops the
+        imported slice including every imported reservation. Zero orphan
+        reservations by the same clock that reaps two-phase reserves."""
+        now = time.monotonic() if now is None else now
+        stale_out, stale_in = [], []
+        with self._txn_lock:
+            for handoff, entry in list(self._handoffs_out.items()):
+                if now - entry["t0"] >= self.prepare_ttl:
+                    stale_out.append(handoff)
+                    del self._handoffs_out[handoff]
+            for handoff, entry in list(self._handoffs_in.items()):
+                if now - entry["t0"] >= self.prepare_ttl:
+                    stale_in.append((handoff, entry))
+                    del self._handoffs_in[handoff]
+            self.reaped_handoffs += len(stale_out) + len(stale_in)
+        for handoff in stale_out:
+            self.range_fence.lift(handoff)
+            logger.warning("shard %d: reaped orphaned outbound handoff %s",
+                           self.shard_id, handoff)
+        for handoff, entry in stale_in:
+            if entry["applied"] is not None:
+                self._drop_imported(entry["applied"])
+            logger.warning("shard %d: reaped orphaned inbound handoff %s",
+                           self.shard_id, handoff)
+        return len(stale_out) + len(stale_in)
 
     # ------------------------------------------------------------- lifecycle
 
