@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress crash-test ha-test reshard-test scenario-test shard-scenario reshard-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress race-test crash-test ha-test reshard-test scenario-test shard-scenario reshard-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -12,6 +12,12 @@ test:            ## unit + kernel + integration tiers (8-device virtual CPU mesh
 
 test-stress:     ## only the stress/concurrency tier
 	$(PY) -m pytest tests/test_stress.py -q
+
+race-test:       ## lockset race detector gate: planted races MUST fire (file:line asserts) + detector-armed concurrency smoke + runtime retrace budget; the full suite runs armed anyway (conftest KT_RACE_DETECT=1)
+	env JAX_PLATFORMS=cpu KT_RACE_DETECT=1 KT_LOCK_ASSERT=1 $(PY) -m pytest \
+		tests/test_racedetect.py tests/test_retrace.py \
+		tests/test_lockorder.py tests/test_concurrent_check.py \
+		-q -p no:cacheprovider
 
 crash-test:      ## SIGKILL crash-point matrix: every crash.* site x 3 seeds
 	$(PY) tools/crashtest.py matrix
@@ -54,7 +60,7 @@ scenario-hunt-nightly: ## nightly cadence (hack/ci.sh comments): the long tier a
 		--budget-s 7200 --iterations 30 --mega-pods 1000000 \
 		--report hunt-nightly-report.json
 
-lint:            ## 8-checker static analyzer (locks, purity, registries, blocking, threads, excsafety, protocol) + syntax sanity
+lint:            ## 12-checker static analyzer (locks, purity, registries, blocking, threads, excsafety, protocol, dtype, donation, retrace, envguard) + syntax sanity
 	$(PY) -m compileall -q kube_throttler_tpu tools bench.py __graft_entry__.py
 	$(PY) -m kube_throttler_tpu.analysis
 

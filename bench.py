@@ -125,7 +125,10 @@ def _watchdog_main(margin: float = 30.0) -> None:
 
 
 def start_watchdog() -> None:
-    budget = float(os.environ.get("KT_BENCH_DEADLINE_S", "1800"))
+    try:
+        budget = float(os.environ.get("KT_BENCH_DEADLINE_S", "1800"))
+    except ValueError:
+        budget = 1800.0  # malformed override must not kill the bench
     _DEADLINE[0] = time.monotonic() + budget
     t = threading.Thread(target=_watchdog_main, name="bench-watchdog", daemon=True)
     t.start()

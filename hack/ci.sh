@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # CI gate: `make ci`. Static analysis failures fail CI, not review —
-# the analyzer (8 checkers + the stale-waiver gate) runs first, then a
-# fast smoke tier that proves the analyzer and the runtime lock
-# assassin themselves work. The full tier-1 suite stays `make test`;
-# this script is the cheap always-on gate (<~1 min).
+# the analyzer (12 checkers + the stale-waiver gate) runs first, then a
+# fast smoke tier that proves the analyzer, the runtime lock assassin,
+# and the gen-3 lockset race detector themselves work (planted races
+# must fire). The full tier-1 suite stays `make test` (race-armed via
+# conftest); this script is the cheap always-on gate (<~2 min).
 #
 # Nightly cadence (NOT part of this gate — the budgeted smoke below is
 # the CI hunt tier; these run on the nightly schedule, in this order):
@@ -21,13 +22,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint: compileall + 8-checker static analysis + stale-waiver gate =="
+echo "== lint: compileall + 12-checker static analysis + stale-waiver gate =="
 make lint
 
 echo "== smoke: analyzer fixtures, lock assassin + hold budgets, journal =="
 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_lockorder.py tests/test_journal.py \
     -q -p no:cacheprovider
+
+echo "== race: lockset detector must-fire gate + armed concurrency smoke =="
+make race-test
 
 echo "== memory: 50k-pod columnar-arena build vs committed per-pod bounds =="
 env JAX_PLATFORMS=cpu python tools/memsmoke.py

@@ -1,7 +1,7 @@
 """Repo-native static analyzer: lock discipline, JAX trace purity,
-string-keyed registry consistency, and (second generation) blocking-
-under-lock, thread-lifecycle, exception-safety, and cross-process
-protocol checking.
+string-keyed registry consistency, (second generation) blocking-
+under-lock, thread-lifecycle, exception-safety, cross-process protocol
+checking, and (third generation) device-kernel contract checking.
 
 Run as ``python -m kube_throttler_tpu.analysis`` (or ``make lint``).
 Checkers:
@@ -16,10 +16,18 @@ Checkers:
 - ``excsafety`` — fd/lock/reservation leaks on exception paths (excsafety.py)
 - ``protocol``  — journal control lines, IPC frame types, fencing-epoch
   domination (protocol.py)
+- ``dtype``     — int64 milli-plane dtype discipline: narrowing casts,
+  narrow accumulators, default-dtype allocations (device.py)
+- ``donation``  — no reads after a ``donate_argnums`` dispatch (donation.py)
+- ``retrace``   — jit entries see only padded/static shapes (retrace.py)
+- ``envguard``  — numeric ``KT_*`` env parses need try/except guards
+  (envguard.py)
 
-The runtime counterparts — the instrumented-lock assassin and the
-per-lock hold-time budgets enabled by ``KT_LOCK_ASSERT=1`` — live in
-``kube_throttler_tpu.utils.lockorder``. See docs/STATIC_ANALYSIS.md.
+The runtime counterparts — the instrumented-lock assassin and hold-time
+budgets (``KT_LOCK_ASSERT=1``, ``utils/lockorder.py``), the Eraser-style
+lockset race detector (``KT_RACE_DETECT=1``, ``utils/racedetect.py``),
+and the per-entry XLA recompile budget (``KT_JIT_RETRACE_BUDGET``,
+``utils/retrace.py``). See docs/STATIC_ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -27,7 +35,20 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from . import blocking, excsafety, guarded, lockgraph, protocol, purity, registry, threads
+from . import (
+    blocking,
+    device,
+    donation,
+    envguard,
+    excsafety,
+    guarded,
+    lockgraph,
+    protocol,
+    purity,
+    registry,
+    retrace,
+    threads,
+)
 from .core import Finding, Module, apply_baseline, load_baseline, load_package
 
 PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -46,6 +67,10 @@ CHECKERS = (
     "threads",
     "excsafety",
     "protocol",
+    "dtype",
+    "donation",
+    "retrace",
+    "envguard",
 )
 
 
@@ -94,6 +119,14 @@ def run_checks(
         findings.extend(excsafety.check(modules))
     if "protocol" in checks:
         findings.extend(protocol.check(modules))
+    if "dtype" in checks:
+        findings.extend(device.check(modules))
+    if "donation" in checks:
+        findings.extend(donation.check(modules))
+    if "retrace" in checks:
+        findings.extend(retrace.check(modules))
+    if "envguard" in checks:
+        findings.extend(envguard.check(modules))
     findings.sort(key=lambda f: (f.relpath or f.path, f.line, f.checker, f.message))
     return findings
 

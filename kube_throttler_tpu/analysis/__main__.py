@@ -31,7 +31,8 @@ def main(argv=None) -> int:
         prog="python -m kube_throttler_tpu.analysis",
         description=(
             "lock discipline / JAX purity / registry / blocking / thread / "
-            "exception-safety / protocol static analyzer"
+            "exception-safety / protocol / dtype / donation / retrace / "
+            "envguard static analyzer"
         ),
     )
     ap.add_argument("--root", default=PACKAGE_ROOT, help="package root to analyze")

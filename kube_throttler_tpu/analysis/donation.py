@@ -1,0 +1,290 @@
+"""Checker 10: donation safety — no reads after a donated dispatch.
+
+ROADMAP item 2 keeps the ``st_*`` planes device-resident across ticks
+with **donated buffers** (``jax.jit(..., donate_argnums=...)``): XLA
+reuses the input buffer for the output, so the Python-side array the
+caller passed is *invalid* the moment the dispatch returns. Reading it
+afterwards is not an error JAX reliably raises on every backend — on
+TPU it can return garbage from the reused buffer. This checker pins the
+contract before those kernels land:
+
+- entries are functions whose ``jax.jit`` decoration carries
+  ``donate_argnums``/``donate_argnames`` (positions resolved against
+  the def's parameter list);
+- call sites are resolved interprocedurally the same way the lockgraph
+  resolver charges lock sets: bare-name calls via the package-wide
+  import-alias index, ``self.<attr>.<fn>``/``obj.<fn>`` method calls via
+  one level of attribute-type inference;
+- at each call site, every argument expression bound to a donated
+  parameter (a local name or a ``self.<attr>`` chain) is tracked through
+  the *rest of the calling function*: a read at a later line with no
+  intervening rebind of that name/attr is a finding. Rebinding — most
+  idiomatically ``x = entry(x)``, the donate-and-replace shape — clears
+  the obligation.
+
+Line-granular and flow-approximate by design (a rebind anywhere between
+the call line and the read line clears it, whichever branch it sits
+in); the differential soaks catch value-level misuse, this catches the
+structural use-after-donate the type checker never will.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Module, iter_classes, iter_methods, unparse
+from .purity import _FnIndex
+
+
+def _donated_params(fn: ast.FunctionDef, dec: ast.Call) -> Set[str]:
+    """Parameter names donated by a ``jax.jit``/``partial(jax.jit, ...)``
+    decoration carrying donate_argnums/donate_argnames."""
+    params = [a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)]
+    out: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        try:
+            val = ast.literal_eval(kw.value)
+        except ValueError:
+            continue
+        if isinstance(val, (int, str)):
+            val = (val,)
+        for v in val:
+            if isinstance(v, int):
+                if 0 <= v < len(params):
+                    out.add(params[v])
+            else:
+                out.add(str(v))
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    return [a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)]
+
+
+def _jit_donations(
+    modules: Sequence[Module],
+) -> Dict[Tuple[str, str], Tuple[Set[str], List[str]]]:
+    """(modname, fn name) -> (donated param names, full param list), for
+    every def whose decorator stack applies jax.jit with donation. Also
+    resolves the ``g = jax.jit(f, donate_argnums=...)`` wrapper-
+    assignment shape (the alias name becomes the entry, carrying the
+    wrapped def's parameter list)."""
+    out: Dict[Tuple[str, str], Tuple[Set[str], List[str]]] = {}
+    for m in modules:
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    if "jit" not in unparse(dec.func) and not any(
+                        "jit" in unparse(a) for a in dec.args
+                    ):
+                        continue
+                    donated = _donated_params(node, dec)
+                    if donated:
+                        out[(m.modname, node.name)] = (donated, _param_names(node))
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if "jit" not in unparse(call.func):
+                continue
+            if not call.args:
+                continue
+            inner = call.args[0]
+            if not (isinstance(inner, ast.Name) and inner.id in defs):
+                continue
+            donated_kw = [
+                kw for kw in call.keywords
+                if kw.arg in ("donate_argnums", "donate_argnames")
+            ]
+            if not donated_kw:
+                continue
+            wrapped = defs[inner.id]
+            donated = _donated_params(wrapped, call)
+            if donated:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[(m.modname, t.id)] = (donated, _param_names(wrapped))
+    return out
+
+
+def _arg_track_key(expr: ast.AST) -> Optional[str]:
+    """Trackable donated-argument expression: 'x' for a bare name,
+    'self.x' / 'obj.x' for a one-level attribute chain. Anything more
+    complex (a fresh call result, a subscript) has no caller-side alias
+    to misread, so it is not tracked."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return f"{expr.value.id}.{expr.attr}"
+    return None
+
+
+def _expr_keys(expr: ast.AST) -> Set[str]:
+    """Every trackable name/attr-chain read inside expr."""
+    out: Set[str] = set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and isinstance(sub.value, ast.Name):
+            if isinstance(sub.ctx, ast.Load):
+                out.add(f"{sub.value.id}.{sub.attr}")
+        elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            out.add(sub.id)
+    return out
+
+
+def _store_lines(fn: ast.AST) -> Dict[str, List[int]]:
+    """key -> lines where the name/attr-chain is (re)bound."""
+    out: Dict[str, List[int]] = {}
+
+    def note(target: ast.AST, line: int) -> None:
+        if isinstance(target, ast.Tuple):
+            for elt in target.elts:
+                note(elt, line)
+            return
+        key = _arg_track_key(target)
+        if key is not None:
+            out.setdefault(key, []).append(line)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                note(t, node.lineno)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            note(node.target, node.lineno)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            note(node.target, node.lineno)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    note(item.optional_vars, node.lineno)
+    return out
+
+
+class _AttrTypes:
+    """self-attribute → bare class name, per class (the lockgraph
+    resolver's one-level attribute-type inference, reused so method-call
+    sites on held sub-objects resolve the same way lock sets do)."""
+
+    def __init__(self, modules: Sequence[Module]):
+        from .lockgraph import _collect_class_info
+
+        self.by_qual: Dict[str, object] = {}
+        self.by_bare: Dict[str, List[object]] = {}
+        for m in modules:
+            for cls in iter_classes(m):
+                info = _collect_class_info(m, cls)
+                self.by_qual[info.qual] = info
+                self.by_bare.setdefault(cls.name, []).append(info)
+
+
+def check(modules: Sequence[Module]) -> List[Finding]:
+    donations = _jit_donations(modules)
+    if not donations:
+        return []
+    index = _FnIndex(modules)
+    attr_types = _AttrTypes(modules)
+    by_entry_name: Dict[str, List[Tuple[str, str]]] = {}
+    for (mod, fn) in donations:
+        by_entry_name.setdefault(fn, []).append((mod, fn))
+
+    findings: List[Finding] = []
+
+    def resolve_entry(
+        modname: str, call: ast.Call, cls_info
+    ) -> Optional[Tuple[str, str]]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            resolved = index.resolve(modname, f.id)
+            if resolved in donations:
+                return resolved
+            cands = by_entry_name.get(f.id, [])
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(f, ast.Attribute):
+            # module alias (check.entry) or one-level attr-typed object
+            cands = by_entry_name.get(f.attr, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def scan_function(module: Module, fn: ast.AST, where: str, cls_info) -> None:
+        stores = _store_lines(fn)
+        obligations: List[Tuple[str, int, Tuple[str, str], str]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            entry = resolve_entry(module.modname, node, cls_info)
+            if entry is None:
+                continue
+            donated, params = donations[entry]
+            bound: List[Tuple[str, ast.AST]] = []
+            for i, a in enumerate(node.args):
+                pname = params[i] if i < len(params) else None
+                if pname in donated:
+                    bound.append((pname, a))
+            for kw in node.keywords:
+                if kw.arg in donated:
+                    bound.append((kw.arg, kw.value))
+            for pname, a in bound:
+                key = _arg_track_key(a)
+                if key is not None:
+                    line = getattr(node, "end_lineno", node.lineno)
+                    obligations.append((key, line, entry, pname))
+        if not obligations:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                key = _arg_track_key(node)
+                if key is None:
+                    continue
+                for okey, oline, entry, pname in obligations:
+                    if key != okey or node.lineno <= oline:
+                        continue
+                    rebound = any(
+                        oline <= s <= node.lineno for s in stores.get(key, ())
+                    )
+                    if rebound:
+                        continue
+                    findings.append(
+                        Finding(
+                            checker="donation",
+                            path=module.path,
+                            relpath=module.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"read of '{key}' after it was donated (arg "
+                                f"'{pname}' of {entry[0]}.{entry[1]}) in "
+                                f"{where} — the buffer is reused by XLA; "
+                                "rebind to the returned array or drop the "
+                                "donation"
+                            ),
+                        )
+                    )
+                    break  # one finding per read site
+
+    for m in modules:
+        for node in m.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (m.modname, node.name)
+                if key in donations:
+                    continue  # the entry's own body uses the fresh tracer
+                scan_function(m, node, f"{m.modname}.{node.name}", None)
+        for cls in iter_classes(m):
+            info = attr_types.by_qual.get(f"{m.modname}.{cls.name}")
+            for method in iter_methods(cls):
+                scan_function(
+                    m, method, f"{m.modname}.{cls.name}.{method.name}", info
+                )
+    # one finding per (key, obligation) pair is already enforced per read
+    # site; collapse exact duplicates from nested walks
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.key(), f.line), f)
+    return sorted(uniq.values(), key=lambda f: (f.relpath, f.line))

@@ -404,6 +404,13 @@ class MockApiServer:
         with self._lock:
             return self._fencing_epoch
 
+    def stale_rejections(self) -> int:
+        """Locked read of the stale-epoch PUT counter (GUARDED_BY; the
+        harness asserts reading it bare raced the request threads —
+        lockset detector, gen-3)."""
+        with self._lock:
+            return self.stale_epoch_rejected
+
     def _serve_list(self, handler, kind: str, query=None) -> None:
         fault = self._fault("mock.list")
         if fault is not None:

@@ -1637,9 +1637,13 @@ class RemoteSession:
         # the committer is what controllers should use as their
         # status_writer: same duck type plus batch + coalescing + N
         # concurrent PUT workers (the raw writer stays for direct callers)
+        try:
+            put_workers = int(os.environ.get("KT_STATUS_PUT_WORKERS", "4"))
+        except ValueError:
+            put_workers = 4  # malformed override must not kill session setup
         self.status_committer = AsyncStatusCommitter(
             self.status_writer,
-            workers=int(os.environ.get("KT_STATUS_PUT_WORKERS", "4")),
+            workers=put_workers,
             metrics_registry=metrics_registry,
         )
         self.event_recorder = RemoteEventRecorder(self.client)

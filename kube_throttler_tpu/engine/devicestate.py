@@ -34,6 +34,7 @@ import numpy as np
 
 from ..utils.tracing import NoopTracer
 from ..utils.lockorder import make_lock, make_rlock
+from ..utils.retrace import on_tick as _retrace_on_tick
 from ..api.pod import Pod
 from ..api.types import ClusterThrottle, ResourceAmount, Throttle
 from ..quantity import to_milli
@@ -1697,8 +1698,9 @@ class DeviceStateManager:
                 cols = ks.index.row_cols(row)
         if cols is None:
             return None
-        ck = ks.index._col_keys
-        return [ck[c] for c in cols.tolist() if c in ck]
+        with ks.index._lock:  # noqa: SLF001 — _col_keys' declared guard
+            ck = ks.index._col_keys
+            return [ck[c] for c in cols.tolist() if c in ck]
 
     def _on_pod_run(self, events: List[Event]) -> None:
         """Batched mirror update for a consecutive run of Pod events.
@@ -1815,8 +1817,9 @@ class DeviceStateManager:
                 ks.apply_pending_batched(pending)
                 cols = ks.flip_candidate_cols()
                 if cols.size:
-                    ck = ks.index._col_keys  # noqa: SLF001 — hint read
-                    keys = [ck[c] for c in cols.tolist() if c in ck]
+                    with ks.index._lock:  # noqa: SLF001 — declared guard
+                        ck = ks.index._col_keys
+                        keys = [ck[c] for c in cols.tolist() if c in ck]
             if keys:
                 promoter(keys)
 
@@ -2080,7 +2083,8 @@ class DeviceStateManager:
                     "au_cnt": (ks.used_cnt + ks.res_cnt),
                     "au_req": (ks.used_req + ks.res_req),
                 }
-                col_key_maps[kind] = dict(ks.index._col_keys)  # noqa: SLF001
+                with ks.index._lock:  # noqa: SLF001 — declared guard
+                    col_key_maps[kind] = dict(ks.index._col_keys)
 
         # ---- outside the lock: the single fused dispatch + decode --------
         ok, (out_t, out_c) = gang_check_both(
@@ -2211,11 +2215,21 @@ class DeviceStateManager:
                 # so it must run under the agg lock too
                 with self.tracer.trace("agg_flips"):
                     keyset = set(keys)
-                    col_keys = ks.index._col_keys  # noqa: SLF001 — hint read
+                    flip_cols = ks.flip_candidate_cols().tolist()
+                    # col→key rows are GUARDED_BY the index lock and this
+                    # runs after the main lock is released (agg lock only):
+                    # an unlocked read here can decode a flip through a
+                    # col being deleted/reused concurrently and route the
+                    # priority status write to the WRONG throttle — found
+                    # by the lockset race detector (gen-3). Resolve just
+                    # the flip cols under the index lock: O(flips), never
+                    # O(tcap).
+                    with ks.index._lock:  # noqa: SLF001 — same-package access
+                        ck = ks.index._col_keys
+                        flip_keys = [ck.get(c) for c in flip_cols]
                     drained: set = set()
                     promote: set = set()
-                    for c in ks.flip_candidate_cols().tolist():
-                        key = col_keys.get(c)
+                    for key in flip_keys:
                         if key is None:
                             continue
                         (drained if key in keyset else promote).add(key)
@@ -2265,6 +2279,11 @@ class DeviceStateManager:
                         ref, int(cnt[i]), np.where(pres, req[i], 0), pres
                     )
                 out[key] = (amt, out[key][1])
+            # tick boundary for the runtime retrace budget: with
+            # KT_JIT_RETRACE_BUDGET armed, a drain that recompiled any
+            # registered jit entry after warmup fails HERE, naming the
+            # entry — not as a 100ms-class latency regression two PRs out
+            _retrace_on_tick()
             return out
 
     # -- queries ----------------------------------------------------------
@@ -2389,11 +2408,12 @@ class DeviceStateManager:
                     # set this halves every pre_filter's device round trips)
                     return {}
                 if cols.size <= self.indexed_check_max:
-                    ck = ks.index._col_keys
                     # tolist() converts the whole cols vector in C; the
                     # per-element int(c) form paid a numpy-scalar box per
                     # col (~240k dict.get+int calls per 6k decisions)
-                    col_keys = list(map(ck.get, cols.tolist()))
+                    with ks.index._lock:  # noqa: SLF001 — declared guard
+                        ck = ks.index._col_keys
+                        col_keys = list(map(ck.get, cols.tolist()))
                     if not self._resolve_single_check_route():
                         # HOST path — the default on every backend when
                         # the native tier loads: a single pod's check is a
@@ -2545,7 +2565,8 @@ class DeviceStateManager:
                     host_rows = [self._gather_check_rows(ks, cc) for cc in colss]
             else:
                 state = ks.device_state()
-            col_keys = dict(ks.index._col_keys)
+            with ks.index._lock:  # noqa: SLF001 — declared guard
+                col_keys = dict(ks.index._col_keys)
 
         if host_rows is not None:
             native_out = [

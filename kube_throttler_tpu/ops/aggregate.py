@@ -229,3 +229,10 @@ def throttled_flags(
     cnt_flag = thr_cnt_present & used_cnt_present & (used_cnt >= thr_cnt)
     req_flag = thr_req_present & used_req_present & (used_req >= thr_req)
     return cnt_flag, req_flag, thr_req_present
+
+
+# runtime retrace budget (KT_JIT_RETRACE_BUDGET): every jit entry here
+# reports its compile-cache size per tick — see utils/retrace.py
+from ..utils.retrace import register_all as _register_retrace
+
+_register_retrace(globals(), __name__)

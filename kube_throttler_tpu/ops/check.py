@@ -338,3 +338,10 @@ def check_pods_compact(state: ThrottleState, pods: PodBatch, mask: jnp.ndarray,
     (plugin.go:177-180).
     """
     return _compact(state, pods, mask, on_equal, step3_on_equal)
+
+
+# runtime retrace budget (KT_JIT_RETRACE_BUDGET): every jit entry here
+# reports its compile-cache size per tick — see utils/retrace.py
+from ..utils.retrace import register_all as _register_retrace
+
+_register_retrace(globals(), __name__)

@@ -306,3 +306,10 @@ def fast_check_pod_indexed(
         req_present=pod_req_present[None, :],
     )
     return _classify_fast(gathered, pods, idx_valid[None, :], on_equal, step3_on_equal)[0]
+
+
+# runtime retrace budget (KT_JIT_RETRACE_BUDGET): every jit entry here
+# reports its compile-cache size per tick — see utils/retrace.py
+from ..utils.retrace import register_all as _register_retrace
+
+_register_retrace(globals(), __name__)

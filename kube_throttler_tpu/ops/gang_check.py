@@ -168,3 +168,10 @@ def gang_check_both(kind_a: dict, kind_b: dict, gclass, gvalid, num_groups: int)
     out_a = _gang_classify(**kind_a, gclass=gclass, gvalid=gvalid, num_groups=num_groups)
     out_b = _gang_classify(**kind_b, gclass=gclass, gvalid=gvalid, num_groups=num_groups)
     return out_a[0] & out_b[0], (out_a, out_b)
+
+
+# runtime retrace budget (KT_JIT_RETRACE_BUDGET): every jit entry here
+# reports its compile-cache size per tick — see utils/retrace.py
+from ..utils.retrace import register_all as _register_retrace
+
+_register_retrace(globals(), __name__)

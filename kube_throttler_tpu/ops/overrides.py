@@ -276,3 +276,10 @@ def calculate_thresholds(sched: OverrideSchedule, now_ns: jnp.ndarray):
     thr_req = jnp.where(thr_req_present, thr_req, 0)
 
     return thr_cnt, thr_cnt_present, thr_req, thr_req_present
+
+
+# runtime retrace budget (KT_JIT_RETRACE_BUDGET): every jit entry here
+# reports its compile-cache size per tick — see utils/retrace.py
+from ..utils.retrace import register_all as _register_retrace
+
+_register_retrace(globals(), __name__)

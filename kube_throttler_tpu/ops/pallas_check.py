@@ -214,3 +214,10 @@ def pallas_check_pods(
     )
 
 
+
+
+# runtime retrace budget (KT_JIT_RETRACE_BUDGET): every jit entry here
+# reports its compile-cache size per tick — see utils/retrace.py
+from ..utils.retrace import register_all as _register_retrace
+
+_register_retrace(globals(), __name__)

@@ -36,6 +36,36 @@ from .. import resourcelist as rl
 
 AnyThrottle = Union[Throttle, ClusterThrottle]
 
+# The int64 planes. Every tensor named here carries exact int64 values —
+# milli-unit quantities or pod counts summed over up to 1M pods — and
+# must stay int64 end to end: an int32 accumulator overflows at ~2.1e6
+# milli-cores (2.1 cores over 1k pods), float32 loses integer exactness
+# past 2^24, and float64 past 2^53. The ``dtype`` static checker
+# (analysis/device.py) reads this literal set from the AST (the registry
+# idiom: keep it a literal) and flags any narrowing cast, narrow-dtype
+# accumulator, or default-dtype allocation touching these names anywhere
+# in ops/, parallel/, or the engine device/staging planes. The columnar
+# arena intentionally stores int32 *columns* (engine/columnar.py); the
+# encode boundary upcasts into these planes, which is exactly the cast
+# surface the checker pins.
+INT64_MILLI_PLANES = frozenset(
+    {
+        "thr_cnt",
+        "thr_req",
+        "used_cnt",
+        "used_req",
+        "res_cnt",
+        "res_req",
+        "req",  # PodBatch.req / the encoded pod-request rows
+        "pod_req",  # engine/devicestate.py staging plane
+        "row_req",  # the per-pod encoded [1,R] row
+        "au_cnt",  # already-used = used + reserved (gang snapshot)
+        "au_req",
+        "cls_cnt",  # per-accel-class effective thresholds
+        "cls_req",
+    }
+)
+
 
 class DimRegistry:
     """Stable resource-name → column-index mapping.

@@ -264,7 +264,7 @@ def run_resharding_storm(
         }
 
         aborts = sum(r.get("aborts", 0) for r in rescale_reports)
-        restarts = dict(supervisor.restarts)
+        restarts = supervisor.restart_counts()
         final_state, _detail = front._shards_health()
         report["rescales"] = rescale_reports
         report["gates"]["reshard"] = {

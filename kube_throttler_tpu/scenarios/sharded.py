@@ -142,9 +142,9 @@ def run_sharded_bad_day(
             delay = next_at - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            if i == kill_idx and supervisor.procs:
+            if i == kill_idx:
                 killed_sid = 0 if n_shards == 1 else 1
-                proc = supervisor.procs.get(killed_sid)
+                proc = supervisor.shard_proc(killed_sid)
                 if proc is not None and proc.poll() is None:
                     os.kill(proc.pid, signal.SIGKILL)
                     outage.append(time.perf_counter())
@@ -241,7 +241,7 @@ def run_sharded_bad_day(
         report["gates"]["recovery"] = {
             "pass": recovered,
             "bound_s": recovery_s,
-            "restarts": dict(supervisor.restarts),
+            "restarts": supervisor.restart_counts(),
             "killed_shard": killed_sid,
         }
 
@@ -483,7 +483,7 @@ def run_sharded_program(
             # reapers, not by anyone in-band — wait out the prepare TTL
             time.sleep(prepare_ttl_s + 2.0)
 
-        restarts_total = sum(supervisor.restarts.values())
+        restarts_total = sum(supervisor.restart_counts().values())
         report["measurements"] = {
             "events_per_sec": round(
                 pipe_stats["events_applied"] / max(t_fired, 1e-9), 1
@@ -500,7 +500,7 @@ def run_sharded_program(
         report["gates"]["recovery"] = {
             "pass": recovered,
             "bound_s": recovery_s,
-            "restarts": dict(supervisor.restarts),
+            "restarts": supervisor.restart_counts(),
         }
         if do_rescale:
             ok = "report" in rescale_result or crash_armed

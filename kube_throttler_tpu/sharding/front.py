@@ -216,7 +216,7 @@ class AdmissionFront:
             state = "ok"
             if handle is None or not handle.alive:
                 state, down = "down", down + 1
-            elif handle.dirty:
+            elif handle.is_dirty():
                 state = "degraded"
             detail[f"shard-{sid}"] = state
         if down == self.n_shards and self.n_shards > 0:

@@ -168,6 +168,13 @@ class ShardClient:
         with self._qcond:
             self.dirty = False
 
+    def is_dirty(self) -> bool:
+        """Locked read of the needs-resync flag — ``dirty`` is GUARDED_BY
+        the queue lock; the health probe reading it bare raced the sender
+        marking it (lockset detector, gen-3)."""
+        with self._qcond:
+            return self.dirty
+
     def pending_events(self) -> int:
         with self._qcond:
             return len(self._queue)
@@ -324,6 +331,9 @@ class LocalShard:
         self.core.handle_events(list(ops))
         self.events_sent += len(ops)
         self.frames_sent += 1
+
+    def is_dirty(self) -> bool:
+        return self.dirty  # synchronous single-thread handle: no lock
 
     def pending_events(self) -> int:
         return 0

@@ -247,6 +247,20 @@ class ShardSupervisor:
                     ) from None
                 time.sleep(0.1)
 
+    def restart_counts(self) -> Dict[int, int]:
+        """Copy of the per-shard restart counters under their lock — the
+        polling surface for tests/scenarios (``restarts`` is GUARDED_BY;
+        bare dict reads from the poll loops raced the monitor's bumps)."""
+        with self._proc_lock:
+            return dict(self.restarts)
+
+    def shard_proc(self, shard_id: int):
+        """The shard's live Popen (or None), read under the proc lock.
+        Callers may poll()/kill() the returned handle lock-free — only
+        the ``procs`` map itself is guarded."""
+        with self._proc_lock:
+            return self.procs.get(shard_id)
+
     def rescale(
         self,
         n_new: int,

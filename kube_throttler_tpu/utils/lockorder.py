@@ -49,11 +49,14 @@ __all__ = [
     "LockAssertionError",
     "LockHoldBudgetExceeded",
     "enabled",
+    "lock_assert_enabled",
+    "race_detect_enabled",
     "make_lock",
     "make_rlock",
     "make_condition",
     "assert_held",
     "held_by_me",
+    "held_names",
     "guard_attrs",
     "reset_graph",
     "set_hold_budget",
@@ -73,8 +76,22 @@ class LockHoldBudgetExceeded(LockAssertionError):
     """A lock was held longer than its configured hold-time budget."""
 
 
-def enabled() -> bool:
+def lock_assert_enabled() -> bool:
     return os.environ.get("KT_LOCK_ASSERT", "") == "1"
+
+
+def race_detect_enabled() -> bool:
+    # mirror of racedetect.enabled() read here directly: the lockset
+    # detector needs instrumented locks for thread-held identity, and
+    # importing racedetect from this module would cycle
+    return os.environ.get("KT_RACE_DETECT", "") == "1"
+
+
+def enabled() -> bool:
+    """Instrumentation master switch: the lock assassin
+    (``KT_LOCK_ASSERT=1``) or the Eraser lockset detector
+    (``KT_RACE_DETECT=1``) — race detection implies instrumented locks."""
+    return lock_assert_enabled() or race_detect_enabled()
 
 
 _tls = threading.local()
@@ -103,14 +120,22 @@ _hold_budgets: List[Tuple[str, float]] = []
 _budget_epoch = 0  # bumped on every change so per-lock caches invalidate
 
 
+_env_budget_cache: List[Optional[float]] = []  # [] = unread; [x] = cached
+
+
 def _env_default_budget() -> Optional[float]:
-    raw = os.environ.get("KT_LOCK_HOLD_BUDGET", "")
-    if not raw:
-        return None
-    try:
-        return float(raw)
-    except ValueError:
-        return None
+    # read once per process: this sits on EVERY instrumented release, and
+    # os.environ.get per release measurably taxed the armed soak tiers
+    if not _env_budget_cache:
+        raw = os.environ.get("KT_LOCK_HOLD_BUDGET", "")
+        val: Optional[float] = None
+        if raw:
+            try:
+                val = float(raw)
+            except ValueError:
+                val = None
+        _env_budget_cache.append(val)
+    return _env_budget_cache[0]
 
 
 def set_hold_budget(pattern: str, seconds: float) -> None:
@@ -147,6 +172,28 @@ def _held() -> List["_InstrumentedLock"]:
     if h is None:
         h = _tls.held = []
     return h
+
+
+def held_names() -> Tuple[str, ...]:
+    """Names of the instrumented locks the calling thread holds right
+    now — the lockset the race detector intersects per access."""
+    return tuple(lock.name for lock in _held())
+
+
+def held_frozenset():
+    """Frozenset form of :func:`held_names`, cached per thread and
+    invalidated on every acquire/release — the race detector's per-access
+    read. Identity is meaningful: two calls returning the SAME object
+    mean the lockset did not change in between (the detector skips the
+    intersection entirely then)."""
+    fs = getattr(_tls, "held_fs", None)
+    if fs is None:
+        fs = _tls.held_fs = frozenset(lock.name for lock in _held())
+    return fs
+
+
+def _invalidate_held_fs() -> None:
+    _tls.held_fs = None
 
 
 def _site(limit: int = 8) -> str:
@@ -242,6 +289,7 @@ class _InstrumentedLock:
             self._count = 1
             self._t0 = time.monotonic()
             _held().append(self)
+            _invalidate_held_fs()
         return ok
 
     def release(self) -> None:
@@ -258,6 +306,7 @@ class _InstrumentedLock:
             h = _held()
             if self in h:
                 h.remove(self)
+            _invalidate_held_fs()
             self._inner.release()
             # budget check AFTER the release: the raise must report the
             # over-hold, never extend it (or wedge the other threads)
@@ -295,6 +344,7 @@ class _InstrumentedLock:
         h = _held()
         if self in h:
             h.remove(self)
+        _invalidate_held_fs()
         self._inner.release()
         return saved
 
@@ -307,6 +357,7 @@ class _InstrumentedLock:
         # a fresh hold starts when the condition hands the lock back
         self._t0 = time.monotonic()
         _held().append(self)
+        _invalidate_held_fs()
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         state = f"held by {self._owner} x{self._count}" if self._owner else "unlocked"
@@ -384,9 +435,16 @@ def _guard_lock_names(spec) -> Tuple[str, ...]:
 
 def guard_attrs(cls):
     """Class decorator: enforce the class's ``GUARDED_BY`` table at
-    runtime (rebind-time). Inert unless ``KT_LOCK_ASSERT=1`` at class
-    decoration time. Arms after ``__init__`` returns, so construction
-    writes stay free."""
+    runtime. Inert unless instrumentation is on at class decoration
+    time. Arms after ``__init__`` returns, so construction writes stay
+    free. Two independent layers share the table:
+
+    - ``KT_LOCK_ASSERT=1`` — rebind-time ``__setattr__`` check (original
+      behavior: rebinding a guarded attribute without its lock raises);
+    - ``KT_RACE_DETECT=1`` — a data descriptor per guarded attribute
+      funnels reads AND writes into the Eraser lockset detector
+      (``utils/racedetect.py``), catching the in-place-mutation and
+      read-side races the rebind check cannot see."""
     if not enabled():
         return cls
     table = getattr(cls, "GUARDED_BY", None)
@@ -395,9 +453,10 @@ def guard_attrs(cls):
     guards = {attr: _guard_lock_names(spec) for attr, spec in table.items()}
     orig_setattr = cls.__setattr__
     orig_init = cls.__init__
+    check_rebind = lock_assert_enabled()
 
     def __setattr__(self, name, value):
-        if name in guards and self.__dict__.get("_kt_guard_armed", False):
+        if check_rebind and name in guards and self.__dict__.get("_kt_guard_armed", False):
             ok = False
             for lock_name in guards[name]:
                 lock = self.__dict__.get(lock_name)
@@ -420,4 +479,8 @@ def guard_attrs(cls):
 
     cls.__setattr__ = __setattr__
     cls.__init__ = __init__
+    if race_detect_enabled():
+        from . import racedetect
+
+        racedetect.install_descriptors(cls, guards.keys())
     return cls

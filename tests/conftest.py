@@ -13,6 +13,14 @@ import os
 # are created at import time. Opt out per-run with KT_LOCK_ASSERT=0.
 os.environ.setdefault("KT_LOCK_ASSERT", "1")
 
+# Eraser-style lockset race detector (utils/racedetect.py): also armed
+# suite-wide — every GUARDED_BY attribute access refines a per-(object,
+# attribute) candidate lockset, and pytest_sessionfinish below fails
+# the run on any unwaived report. Same import-time constraint as the
+# assassin (guard_attrs installs the tracking descriptors at class
+# decoration). Opt out per-run with KT_RACE_DETECT=0.
+os.environ.setdefault("KT_RACE_DETECT", "1")
+
 # force, not setdefault: the ambient environment points JAX_PLATFORMS at real
 # TPU hardware AND preloads jax via sitecustomize, so the env var alone is
 # too late — jax.config must be updated before the first backend init
@@ -42,6 +50,26 @@ assert jax.local_device_count() == 8, (
     f"tests assume an 8-device mesh; ambient XLA_FLAGS pinned "
     f"{jax.local_device_count()} — unset xla_force_host_platform_device_count"
 )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Race-detector gate: any unwaived lockset report fails the run —
+    the dynamic twin of the analyzer's exit-1-on-new-finding contract.
+    Planted-race fixtures isolate themselves via racedetect.capture(),
+    so anything left here came from real code under real tests."""
+    from kube_throttler_tpu.utils import racedetect
+
+    if not racedetect.enabled():
+        return
+    reps = racedetect.reports()
+    if reps:
+        print(
+            "\n=== racedetect: unwaived lockset race(s) — fix, or waive in "
+            "kube_throttler_tpu/analysis/race_allow.txt with a justification ==="
+        )
+        for r in reps:
+            print(r.render())
+        session.exitstatus = 1
 
 
 class ProcReader:

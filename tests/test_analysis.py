@@ -1172,3 +1172,393 @@ class TestRegistryCoverage:
         found = findings_for(root, ("registry",))
         assert len(found) == 1
         assert "kube_throttler_shard_upp" in found[0].message
+
+
+# --------------------------------------------------- gen-3: dtype (device.py)
+
+
+class TestDtype:
+    def _tree(self, body):
+        return {
+            "ops/schema.py": '''\
+            INT64_MILLI_PLANES = frozenset({"thr_req", "used_req", "req", "pod_req"})
+            ''',
+            "ops/mod.py": body,
+        }
+
+    def test_narrowing_astype_fires_with_line(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            self._tree(
+                '''\
+                import jax.numpy as jnp
+
+
+                def f(state):
+                    ok = state.thr_req + 1
+                    return state.used_req.astype(jnp.int32)
+                '''
+            ),
+        )
+        found = findings_for(root, ("dtype",))
+        assert len(found) == 1
+        f = found[0]
+        assert f.relpath == "ops/mod.py" and f.line == 6
+        assert "used_req" in f.message and "int32" in f.message
+
+    def test_comparison_mask_cast_is_legal(self, tmp_path):
+        # (req != 0).astype(int32) is a bool mask — the Compare subtree
+        # must not taint the cast (the pallas limb-split idiom)
+        root = write_tree(
+            tmp_path,
+            self._tree(
+                '''\
+                import jax.numpy as jnp
+
+
+                def f(pods):
+                    return (pods.req != 0).astype(jnp.int32)
+                '''
+            ),
+        )
+        assert findings_for(root, ("dtype",)) == []
+
+    def test_narrow_reduction_accumulator_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            self._tree(
+                '''\
+                import jax.numpy as jnp
+
+
+                def f(state, m):
+                    good = jnp.sum(m == 1, axis=1, dtype=jnp.int32)
+                    return jnp.sum(state.thr_req, axis=1, dtype=jnp.int32)
+                '''
+            ),
+        )
+        found = findings_for(root, ("dtype",))
+        assert [f.line for f in found] == [6]
+        assert "thr_req" in found[0].message and "accumulator" in found[0].message
+
+    def test_default_dtype_allocation_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            self._tree(
+                '''\
+                import numpy as np
+
+
+                class KS:
+                    def grow(self, t, r):
+                        self.pod_req = np.zeros((t, r))
+                        self.pod_present = np.zeros((t, r))
+                        self.other = np.zeros((t, r))
+                '''
+            ),
+        )
+        found = findings_for(root, ("dtype",))
+        assert [f.line for f in found] == [6]
+        assert "pod_req" in found[0].message
+
+    def test_out_of_scope_modules_ignored(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/schema.py": 'INT64_MILLI_PLANES = frozenset({"req"})\n',
+                "client/mod.py": '''\
+                import jax.numpy as jnp
+
+
+                def f(x):
+                    return x.req.astype(jnp.int32)
+                ''',
+            },
+        )
+        assert findings_for(root, ("dtype",)) == []
+
+
+# ------------------------------------------------ gen-3: donation (donation.py)
+
+
+_DONATED_ENTRY = '''\
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_planes(st, delta):
+    return st + delta
+'''
+
+
+class TestDonation:
+    def test_read_after_donate_fires_with_line(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": _DONATED_ENTRY,
+                "engine/mod.py": '''\
+                from ..ops.kern import update_planes
+
+
+                def tick(st, delta):
+                    out = update_planes(st, delta)
+                    stale = st.sum()
+                    return out, stale
+                ''',
+            },
+        )
+        found = findings_for(root, ("donation",))
+        assert len(found) == 1
+        f = found[0]
+        assert f.relpath == "engine/mod.py" and f.line == 6
+        assert "'st'" in f.message and "donated" in f.message
+
+    def test_rebind_clears_the_obligation(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": _DONATED_ENTRY,
+                "engine/mod.py": '''\
+                from ..ops.kern import update_planes
+
+
+                def tick(st, delta):
+                    st = update_planes(st, delta)
+                    return st.sum()
+                ''',
+            },
+        )
+        assert findings_for(root, ("donation",)) == []
+
+    def test_self_attr_read_after_donate_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": _DONATED_ENTRY,
+                "engine/mod.py": '''\
+                from ..ops.kern import update_planes
+
+
+                class Mgr:
+                    def tick(self, delta):
+                        out = update_planes(self.st, delta)
+                        return self.st.sum(), out
+
+                    def tick_ok(self, delta):
+                        self.st = update_planes(self.st, delta)
+                        return self.st.sum()
+                ''',
+            },
+        )
+        found = findings_for(root, ("donation",))
+        assert [f.line for f in found] == [7]
+        assert "self.st" in found[0].message
+
+    def test_donate_argnames_and_wrapper_assignment(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": '''\
+                import jax
+
+
+                def _raw(st, delta):
+                    return st + delta
+
+
+                update_planes = jax.jit(_raw, donate_argnums=(0,))
+                ''',
+                "engine/mod.py": '''\
+                from ..ops.kern import update_planes
+
+
+                def tick(st, delta):
+                    out = update_planes(st, delta)
+                    return st.shape, out
+                ''',
+            },
+        )
+        found = findings_for(root, ("donation",))
+        assert len(found) == 1 and found[0].line == 6
+
+
+# -------------------------------------------------- gen-3: retrace (retrace.py)
+
+
+_JIT_ENTRY = '''\
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def kernel(x, num_groups):
+    return x.sum() + num_groups
+'''
+
+
+class TestRetrace:
+    def test_unpadded_dynamic_shape_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": _JIT_ENTRY,
+                "engine/mod.py": '''\
+                import numpy as np
+
+                from ..ops.kern import kernel
+
+
+                def tick(pods):
+                    x = np.zeros((len(pods), 4), dtype=np.int64)
+                    return kernel(x, num_groups=4)
+                ''',
+            },
+        )
+        found = findings_for(root, ("retrace",))
+        assert len(found) == 1
+        f = found[0]
+        assert f.relpath == "engine/mod.py" and f.line == 8
+        assert "'x'" in f.message and "data-dependent" in f.message
+
+    def test_pow2_padding_is_sanctioned(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": _JIT_ENTRY,
+                "engine/mod.py": '''\
+                import numpy as np
+
+                from ..ops.kern import kernel
+
+
+                def _next_pow2(n):
+                    return 1 << (n - 1).bit_length()
+
+
+                def tick(pods):
+                    bp = _next_pow2(len(pods))
+                    x = np.zeros((bp, 4), dtype=np.int64)
+                    return kernel(x, num_groups=4)
+                ''',
+            },
+        )
+        assert findings_for(root, ("retrace",)) == []
+
+    def test_data_dependent_static_arg_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": _JIT_ENTRY,
+                "engine/mod.py": '''\
+                import numpy as np
+
+                from ..ops.kern import kernel
+
+
+                def tick(groups, x):
+                    return kernel(x, num_groups=len(groups))
+                ''',
+            },
+        )
+        found = findings_for(root, ("retrace",))
+        assert len(found) == 1
+        assert "static arg 'num_groups'" in found[0].message
+        assert found[0].line == 7
+
+    def test_capacity_named_shape_is_sanctioned(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/kern.py": _JIT_ENTRY,
+                "engine/mod.py": '''\
+                import numpy as np
+
+                from ..ops.kern import kernel
+
+
+                def tick(self, pods):
+                    x = np.zeros((self.pcap, x_dim), dtype=np.int64)
+                    return kernel(x, num_groups=4)
+                ''',
+            },
+        )
+        assert findings_for(root, ("retrace",)) == []
+
+
+# ------------------------------------------------ gen-3: envguard (envguard.py)
+
+
+class TestEnvGuard:
+    def test_unguarded_parse_fires_with_line(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import os
+
+                CHUNK = int(os.environ.get("KT_CHUNK", "64"))
+                ''',
+            },
+        )
+        found = findings_for(root, ("envguard",))
+        assert len(found) == 1
+        f = found[0]
+        assert f.line == 3 and "KT_CHUNK" in f.message
+
+    def test_guarded_parse_is_clean(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import os
+
+                try:
+                    CHUNK = int(os.environ.get("KT_CHUNK", "64"))
+                except ValueError:
+                    CHUNK = 64
+                ''',
+            },
+        )
+        assert findings_for(root, ("envguard",)) == []
+
+    def test_non_kt_knobs_ignored(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import os
+
+                PORT = int(os.environ.get("HTTP_PORT", "80"))
+                ''',
+            },
+        )
+        assert findings_for(root, ("envguard",)) == []
+
+    def test_subscript_and_getenv_forms(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "mod.py": '''\
+                import os
+
+                A = float(os.getenv("KT_A", "1.5"))
+                B = int(os.environ["KT_B"])
+                ''',
+            },
+        )
+        found = findings_for(root, ("envguard",))
+        assert [f.line for f in found] == [3, 4]
+
+    def test_real_bug_class_is_guarded_in_tree(self):
+        # the ADVICE r5 _GATHER_CHUNK_ELEMS class: the repo-wide gate
+        # (0 envguard findings) plus these two spot checks on the knobs
+        # the class was named after
+        import kube_throttler_tpu.ops.check as check
+
+        assert check._GATHER_CHUNK_ELEMS == 64 * 1024 * 1024
+        new, _, _ = run_repo(checks=("envguard",))
+        assert new == []

@@ -305,7 +305,7 @@ class TestMockserverFencing:
                 apiserver, self._client(apiserver, epoch=1),
                 crashtest._recompute_status(apiserver.store, live),
             )
-        assert apiserver.stale_epoch_rejected == 1
+        assert apiserver.stale_rejections() == 1
         assert (
             object_to_dict(apiserver.store.get_throttle("default", thr.name))
             == before
@@ -322,7 +322,7 @@ class TestMockserverFencing:
                 crashtest._recompute_status(apiserver.store, live),
             )
         assert apiserver.fencing_epoch == 4
-        assert apiserver.stale_epoch_rejected == 0
+        assert apiserver.stale_rejections() == 0
 
     def test_no_header_passes(self, apiserver):
         thr = crashtest._throttle(2)
@@ -338,7 +338,7 @@ class TestMockserverFencing:
             apiserver, self._client(apiserver),
             crashtest._recompute_status(apiserver.store, live),
         )
-        assert apiserver.stale_epoch_rejected == 0
+        assert apiserver.stale_rejections() == 0
 
     def test_stale_lease_write_rejected(self, apiserver):
         from kube_throttler_tpu.client.transport import FencedError
@@ -352,7 +352,7 @@ class TestMockserverFencing:
                 "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases/kt",
                 doc,
             )
-        assert apiserver.stale_epoch_rejected == 1
+        assert apiserver.stale_rejections() == 1
 
 
 class TestMockLeaseFaults:

@@ -1,0 +1,290 @@
+"""Eraser lockset race detector: every planted race shape must fire at
+the right file:line with both locksets, and the benign patterns must
+stay silent — the dynamic analog of test_analysis.py's
+fire-on-fixture/silent-on-repo contract.
+
+All planted fixtures run inside ``racedetect.capture()`` so their
+reports never leak into the suite-wide sessionfinish gate (which is
+itself what turns a REAL race anywhere in the tier-1 run into a
+failure). The suite arms ``KT_RACE_DETECT=1`` via conftest, so the
+classes declared here get their tracking descriptors at decoration
+time like any production class.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import os
+import threading
+
+import pytest
+
+from kube_throttler_tpu.utils import lockorder, racedetect
+
+pytestmark = pytest.mark.skipif(
+    not racedetect.enabled(), reason="KT_RACE_DETECT off for this run"
+)
+
+
+def run_in_thread(fn, name="racer"):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join()
+
+
+def make_box():
+    @lockorder.guard_attrs
+    class Box:
+        GUARDED_BY = {"_items": "self._lock", "_map": "self._lock"}
+
+        def __init__(self):
+            self._lock = lockorder.make_lock("racefix.box")
+            self._items = []
+            self._map = {}
+
+    return Box()
+
+
+class TestPlantedRaces:
+    def test_write_write_fires_with_line(self):
+        b = make_box()
+        with racedetect.capture() as cap:
+            b._items.append(1)  # main-thread unlocked write
+            racy_line = inspect.currentframe().f_lineno + 1
+            run_in_thread(lambda: b._items.append(2))
+        assert len(cap.reports) == 1
+        r = cap.reports[0]
+        assert r.kind == "write/write"
+        assert r.attr == "_items"
+        assert r.qual.endswith("Box._items")
+        # detection fires AT the second thread's access: the lambda on
+        # the planted line
+        assert f"test_racedetect.py:{racy_line}" in r.line
+        assert r.held == () and r.prior_held == ()
+        assert "test_racedetect.py" in r.site
+
+    def test_read_write_fires(self):
+        b = make_box()
+        with racedetect.capture() as cap:
+            def locked_write():
+                with b._lock:
+                    b._items.append(3)
+
+            locked_write()
+            run_in_thread(lambda: len(b._items))  # unlocked read
+            locked_write()  # the write that empties C(v)
+        assert [r.kind for r in cap.reports] == ["read/write"]
+        r = cap.reports[0]
+        assert "racefix.box" in r.held  # detecting write held the lock
+        assert r.prior_held == ()  # the unlocked read emptied the set
+
+    def test_lock_swap_fires(self):
+        @lockorder.guard_attrs
+        class Swap:
+            GUARDED_BY = {"_d": ("self._la", "self._lb")}
+
+            def __init__(self):
+                self._la = lockorder.make_lock("racefix.swap.a")
+                self._lb = lockorder.make_lock("racefix.swap.b")
+                self._d = {}
+
+        s = Swap()
+        with racedetect.capture() as cap:
+            def wa():
+                with s._la:
+                    s._d["a"] = 1  # STORE_SUBSCR classifies as write
+
+            def wb():
+                with s._lb:
+                    s._d["b"] = 2
+
+            wa()
+            run_in_thread(wb)
+            wa()
+        assert len(cap.reports) == 1
+        r = cap.reports[0]
+        assert r.kind == "write/write"
+        assert "racefix.swap.a" in r.held
+        assert "racefix.swap.b" in r.prior_held
+
+    def test_benign_initialization_is_silent(self):
+        b = make_box()
+        with racedetect.capture() as cap:
+            # single-owner init writes, then handoff: every cross-thread
+            # access holds the lock — Exclusive → Shared(-Modified) with
+            # a stable nonempty candidate set
+            b._items.append(0)
+            b._map["seed"] = 1
+
+            def locked_use():
+                with b._lock:
+                    b._items.append(9)
+                    return len(b._items) + len(b._map)
+
+            for _ in range(3):
+                run_in_thread(locked_use)
+        assert cap.reports == []
+
+    def test_read_share_is_silent(self):
+        # publish-then-read-only: unlocked reads from many threads never
+        # report (the read-share state exists exactly for this pattern)
+        b = make_box()
+        with racedetect.capture() as cap:
+            b._items.append(1)
+            for i in range(3):
+                run_in_thread(lambda: len(b._items), name=f"reader-{i}")
+        assert cap.reports == []
+
+    def test_one_report_per_attribute(self):
+        b = make_box()
+        with racedetect.capture() as cap:
+            for i in range(5):
+                run_in_thread(lambda: b._items.append(i))
+        assert len(cap.reports) == 1  # first observation only
+
+
+class TestWaivers:
+    def test_waived_race_is_suppressed_and_counted(self, monkeypatch):
+        b = make_box()
+        qual = f"{type(b).__module__}.{type(b).__qualname__}._items"
+        monkeypatch.setattr(racedetect, "_allow_cache", {qual: "test waiver"})
+        with racedetect.capture() as cap:
+            b._items.append(1)
+            run_in_thread(lambda: b._items.append(2))
+        assert cap.reports == []
+        assert qual in racedetect.fired_waivers()
+
+    def test_load_allow_parses_justifications(self, tmp_path):
+        p = tmp_path / "race_allow.txt"
+        p.write_text(
+            "# comment\n"
+            "engine.store.Store._objects  # GIL-atomic snapshot read\n"
+            "metrics.Registry._vals\n"
+        )
+        allow = racedetect.load_allow(str(p))
+        assert allow["engine.store.Store._objects"] == "GIL-atomic snapshot read"
+        assert allow["metrics.Registry._vals"] == ""
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "kube_throttler_tpu")
+
+
+def _guarded_attrs_in_repo():
+    """{Class.attr} across every GUARDED_BY table in the package (AST —
+    no imports, mirrors the static analyzer)."""
+    out = set()
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read())
+            except SyntaxError:
+                continue
+            rel = os.path.relpath(path, PKG)[:-3].replace(os.sep, ".")
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for stmt in node.body:
+                    if (
+                        isinstance(stmt, ast.Assign)
+                        and any(
+                            isinstance(t, ast.Name) and t.id == "GUARDED_BY"
+                            for t in stmt.targets
+                        )
+                    ):
+                        try:
+                            table = ast.literal_eval(stmt.value)
+                        except ValueError:
+                            continue
+                        for attr in table:
+                            out.add(f"{rel}.{node.name}.{attr}")
+    return out
+
+
+class TestAllowFileHygiene:
+    """The PR 10 stale-entry-is-an-error convention, enforced statically
+    so it never depends on which tests ran this session."""
+
+    def test_every_entry_justified_and_live(self):
+        allow = racedetect.load_allow()
+        guarded = _guarded_attrs_in_repo()
+        stale = [k for k in allow if k not in guarded]
+        unjustified = [k for k, why in allow.items() if not why.strip()]
+        assert not unjustified, (
+            f"race_allow.txt entries missing a justification: {unjustified}"
+        )
+        assert not stale, (
+            "race_allow.txt entries naming no current GUARDED_BY attr "
+            f"(waiver rot — delete them): {stale}"
+        )
+
+
+class TestMechanics:
+    def test_descriptor_preserves_dict_shape(self):
+        b = make_box()
+        b._items.append(1)
+        assert "_items" in b.__dict__  # storage under the plain key
+        assert b.__dict__["_items"] == [1]
+
+    def test_subscript_store_classified_as_write(self):
+        b = make_box()
+        with racedetect.capture() as cap:
+            b._map["k"] = 1
+            run_in_thread(lambda: b._map.update(j=2))
+        assert [r.kind for r in cap.reports] == ["write/write"]
+
+    def test_disabled_mode_installs_nothing(self):
+        import subprocess
+        import sys
+
+        code = (
+            "import os\n"
+            "os.environ['KT_RACE_DETECT'] = '0'\n"
+            "os.environ['KT_LOCK_ASSERT'] = '0'\n"
+            "from kube_throttler_tpu.utils import lockorder\n"
+            "@lockorder.guard_attrs\n"
+            "class Box:\n"
+            "    GUARDED_BY = {'_x': 'self._lock'}\n"
+            "    def __init__(self):\n"
+            "        self._lock = lockorder.make_lock('b')\n"
+            "        self._x = []\n"
+            "assert not hasattr(type(Box.__dict__.get('_x', None)), 'qual')\n"
+            "import threading\n"
+            "assert isinstance(Box()._lock, type(threading.Lock()))\n"
+            "print('ok')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert r.returncode == 0 and "ok" in r.stdout, r.stderr
+
+    def test_race_mode_alone_instruments_locks(self):
+        import subprocess
+        import sys
+
+        code = (
+            "import os\n"
+            "os.environ['KT_RACE_DETECT'] = '1'\n"
+            "os.environ['KT_LOCK_ASSERT'] = '0'\n"
+            "from kube_throttler_tpu.utils import lockorder\n"
+            "lk = lockorder.make_lock('x')\n"
+            "assert type(lk).__name__ == '_InstrumentedLock', type(lk)\n"
+            "with lk:\n"
+            "    assert lockorder.held_names() == ('x',)\n"
+            "print('ok')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert r.returncode == 0 and "ok" in r.stdout, r.stderr

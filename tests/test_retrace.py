@@ -1,0 +1,101 @@
+"""Runtime retrace budget (utils/retrace.py): registered jit entries'
+compile-cache sizes are snapshotted at warmup; a later tick whose
+counts grew past KT_JIT_RETRACE_BUDGET fails, naming the entries."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kube_throttler_tpu.utils import retrace
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch):
+    saved = dict(retrace._registry)
+    retrace.reset()
+    monkeypatch.setattr(retrace, "_registry", {})
+    yield
+    retrace._registry.update(saved)
+    retrace.reset()
+
+
+def _entry():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    return f
+
+
+class TestRegistry:
+    def test_register_all_picks_up_jit_entries(self):
+        f = _entry()
+        ns = {"f": f, "_private": _entry(), "plain": lambda x: x}
+        n = retrace.register_all(ns, "kube_throttler_tpu.ops.fake")
+        assert n == 1
+        assert retrace.registered() == ("ops.fake.f",)
+
+    def test_cache_sizes_count_compiles(self):
+        f = _entry()
+        retrace.register("e", f)
+        assert retrace.cache_sizes()["e"] == 0
+        f(jnp.ones(3))
+        assert retrace.cache_sizes()["e"] == 1
+        f(jnp.ones(3))  # same shape: cached
+        assert retrace.cache_sizes()["e"] == 1
+        f(jnp.ones(4))  # new shape: recompile
+        assert retrace.cache_sizes()["e"] == 2
+
+
+class TestBudget:
+    def test_disarmed_without_env(self, monkeypatch):
+        monkeypatch.delenv("KT_JIT_RETRACE_BUDGET", raising=False)
+        assert retrace.budget() is None
+        retrace.on_tick()  # no-op, no baseline taken
+        assert retrace._baseline is None
+
+    def test_malformed_env_disarms_not_crashes(self, monkeypatch):
+        monkeypatch.setenv("KT_JIT_RETRACE_BUDGET", "banana")
+        assert retrace.budget() is None
+        retrace.on_tick()
+
+    def test_fires_on_post_warmup_recompile(self, monkeypatch):
+        monkeypatch.setenv("KT_JIT_RETRACE_BUDGET", "0")
+        monkeypatch.setenv("KT_JIT_RETRACE_WARMUP", "1")
+        f = _entry()
+        retrace.register("e", f)
+        f(jnp.ones(3))
+        retrace.on_tick()  # warmup tick: baseline pinned at 1 compile
+        f(jnp.ones(3))
+        retrace.on_tick()  # steady state: same shape, no growth
+        f(jnp.ones(7))  # shape leak
+        with pytest.raises(retrace.RetraceBudgetExceeded) as ei:
+            retrace.on_tick()
+        assert "e: +1" in str(ei.value)
+
+    def test_budget_allows_n_recompiles(self, monkeypatch):
+        monkeypatch.setenv("KT_JIT_RETRACE_BUDGET", "2")
+        monkeypatch.setenv("KT_JIT_RETRACE_WARMUP", "1")
+        f = _entry()
+        retrace.register("e", f)
+        f(jnp.ones(3))
+        retrace.on_tick()
+        f(jnp.ones(4))
+        f(jnp.ones(5))
+        retrace.on_tick()  # +2 == budget: still inside
+        f(jnp.ones(6))
+        with pytest.raises(retrace.RetraceBudgetExceeded):
+            retrace.on_tick()
+
+    def test_tick_wired_into_aggregate_drain(self):
+        # the devicestate tick path calls on_tick() — prove the wiring
+        # exists by source, not by spinning a full manager here (the
+        # integration tiers drive that with the budget armed)
+        import inspect
+
+        from kube_throttler_tpu.engine import devicestate
+
+        src = inspect.getsource(devicestate.DeviceStateManager.aggregate_used_for)
+        assert "_retrace_on_tick()" in src

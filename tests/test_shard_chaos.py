@@ -136,7 +136,7 @@ def test_sigkill_worker_mid_churn_degrades_then_recovers(sharded_stack):
     probe = make_pod("probe", labels={"grp": "g1"}, requests={"cpu": "100m"})
     assert victim in front._pod_target_shards(probe)
     _churn(front, rng, 30)
-    os.kill(sup.procs[victim].pid, signal.SIGKILL)
+    os.kill(sup.shard_proc(victim).pid, signal.SIGKILL)
     _churn(front, rng, 20)  # churn continues against a dark shard
     # degraded window: fail-safe verdicts + degraded health (sampled
     # before the supervisor's restart completes)
@@ -152,13 +152,13 @@ def test_sigkill_worker_mid_churn_degrades_then_recovers(sharded_stack):
             saw_failsafe = True
             assert state in ("degraded", "down")
             break
-        if state == "ok" and sup.restarts[victim] > 0:
+        if state == "ok" and sup.restart_counts()[victim] > 0:
             break  # restarted before we could sample the window
         time.sleep(0.01)
-    assert saw_failsafe or sup.restarts[victim] > 0
+    assert saw_failsafe or sup.restart_counts()[victim] > 0
     # recovery: restart + resync must bring health back and lose nothing
     assert _wait_health(front, "ok", timeout=120.0)
-    assert sup.restarts[victim] >= 1
+    assert sup.restart_counts()[victim] >= 1
     _churn(front, rng, 20)  # post-recovery churn lands on the rejoined shard
     _settle(front)
     _assert_converged(front)
@@ -193,10 +193,13 @@ def test_fault_site_shard_worker_kill_recovers(tmp_path):
         _seed(front)
         # churn until the plan fires on some worker (hit 6 at one shard)
         deadline = time.monotonic() + 60.0
-        while sum(sup.restarts.values()) == 0 and time.monotonic() < deadline:
+        while (
+            sum(sup.restart_counts().values()) == 0
+            and time.monotonic() < deadline
+        ):
             _churn(front, rng, 10)
             time.sleep(0.1)
-        assert sum(sup.restarts.values()) >= 1, "fault site never fired"
+        assert sum(sup.restart_counts().values()) >= 1, "fault site never fired"
         assert _wait_health(front, "ok", timeout=120.0)
         _settle(front)
         _assert_converged(front)

@@ -520,7 +520,7 @@ def run_splitbrain(seed: int = 0) -> dict:
             )
 
         status_put(client_a, harness.recompute_status(server.store, thr))
-        assert server.fencing_epoch == 1 and server.stale_epoch_rejected == 0
+        assert server.fencing_epoch == 1 and server.stale_rejections() == 0
 
         # failover: the standby bumps past term 1 and writes
         epoch_b.observe(1)
@@ -540,7 +540,7 @@ def run_splitbrain(seed: int = 0) -> dict:
         except FencedError:
             rejected = True
         assert rejected, "stale-epoch status PUT was accepted (split brain!)"
-        assert server.stale_epoch_rejected == 1
+        assert server.stale_rejections() == 1
         assert (
             object_to_dict(server.store.get_throttle("default", thr.name))
             == state_before
@@ -577,7 +577,7 @@ def run_splitbrain(seed: int = 0) -> dict:
         )
         assert fenced.wait(5.0), "committer never fired on_fenced"
         committer.stop()
-        total_rejected = server.stale_epoch_rejected
+        total_rejected = server.stale_rejections()
         assert total_rejected >= 2
         return {
             "seed": seed,

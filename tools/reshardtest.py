@@ -183,7 +183,7 @@ def run_case(site, mode, seed):
             def revive():
                 time.sleep(1.0)
                 handle.alive = True
-                handle.dirty = False
+                handle.clear_dirty()
 
             threading.Thread(target=revive, daemon=True).start()
             report = ReshardCoordinator(front).rescale(target, deadline_s=60.0)

@@ -37,6 +37,10 @@ try:
     TUNNEL_PORT = int(os.environ.get("KT_TUNNEL_PROBE_PORT", "8103"))
 except ValueError:
     TUNNEL_PORT = 8103  # malformed override must not kill an 11h watch
+# the tunnel terminal is localhost in every deployment so far, but a
+# non-local terminal otherwise forces the FULL_PROBE_EVERY fallback for
+# the whole watch — make the host overridable and LOGGED (ADVICE r5)
+TUNNEL_HOST = os.environ.get("KT_TUNNEL_PROBE_HOST", "127.0.0.1")
 
 # every Nth attempt runs the full jax probe even when the port pre-probe
 # says down — a rotated/wrong port can then cost at most N-1 intervals,
@@ -53,7 +57,7 @@ def _tunnel_port_up(timeout: float = 3.0) -> bool:
     import socket
 
     try:
-        with socket.create_connection(("127.0.0.1", TUNNEL_PORT), timeout=timeout):
+        with socket.create_connection((TUNNEL_HOST, TUNNEL_PORT), timeout=timeout):
             return True
     except OSError:
         return False
@@ -67,7 +71,10 @@ def probe_once(timeout: float = 60.0, force_full: bool = False) -> bool:
     if not _tunnel_port_up():
         if not force_full:
             return False
-        log(f"port {TUNNEL_PORT} closed; running the periodic full probe anyway")
+        log(
+        f"{TUNNEL_HOST}:{TUNNEL_PORT} closed; running the periodic full "
+        "probe anyway"
+    )
     code = (
         f"import sys; sys.path.insert(0, {REPO!r})\n"
         "from kube_throttler_tpu.utils.platform import honor_jax_platforms_env\n"
@@ -141,12 +148,14 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true", help="run bench --quick instead of full scale")
     args = ap.parse_args()
 
-    # state the configured pre-probe port once: a silently wrong port (env
-    # typo, rotated tunnel) otherwise just reads as "backend down" for up
-    # to FULL_PROBE_EVERY-1 intervals with nothing in the log to diagnose
+    # state the configured pre-probe host:port once: a silently wrong
+    # endpoint (env typo, rotated tunnel, non-local terminal) otherwise
+    # just reads as "backend down" for up to FULL_PROBE_EVERY-1 intervals
+    # with nothing in the log to diagnose (ADVICE r5)
     log(
-        f"pre-probe port {TUNNEL_PORT} "
-        f"(KT_TUNNEL_PROBE_PORT={os.environ.get('KT_TUNNEL_PROBE_PORT', 'unset')}); "
+        f"pre-probe {TUNNEL_HOST}:{TUNNEL_PORT} "
+        f"(KT_TUNNEL_PROBE_HOST={os.environ.get('KT_TUNNEL_PROBE_HOST', 'unset')}, "
+        f"KT_TUNNEL_PROBE_PORT={os.environ.get('KT_TUNNEL_PROBE_PORT', 'unset')}); "
         f"full jax probe every {FULL_PROBE_EVERY} attempts regardless"
     )
     deadline = time.monotonic() + args.deadline_s
