@@ -748,6 +748,10 @@ def main(argv: Optional[list] = None) -> int:
         # GANG journal stamps flow to the recovered journal from here on
         plugin.gang.journal = journal
         recovery.restore_gangs(plugin.gang, journal)
+        # PREEMPT eviction brackets flow to the recovered journal from
+        # here on (uncommitted ones were already rolled back to zero
+        # evictions inside recover_store)
+        plugin.preempt.journal = journal
         diverged = recovery.reconcile(
             plugin.informers,
             device_manager=plugin.device_manager,

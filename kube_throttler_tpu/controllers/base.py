@@ -100,6 +100,10 @@ class ControllerBase:
         # registry exists); remote publication is observed by the async
         # committer at PUT completion instead
         self.lag_metrics = None
+        # policy-weighted flip promotion (docs/policy.md): key → hi-lane
+        # priority, wired by the plugin from the policy engine's accel-
+        # class value weights. None/0 keeps the lane's original FIFO.
+        self.flip_priority_fn: Optional[Callable[[str], int]] = None
         if self.resync_interval is not None:
             self.workqueue.add_after(RESYNC_KEY, self.resync_interval)
 
@@ -162,6 +166,30 @@ class ControllerBase:
 
     def enqueue_after(self, key: str, duration: timedelta) -> None:
         self.workqueue.add_after(key, duration)
+
+    def flip_priorities(self, keys) -> Optional[Dict[str, int]]:
+        """Policy promotion priorities for a flip-promoted key set (the
+        workqueue's (-priority, seq) hi-lane ordering input). None — the
+        original FIFO — when no policy fn is wired or no key carries a
+        non-zero weight, so the default path allocates nothing."""
+        fn = self.flip_priority_fn
+        if fn is None:
+            return None
+        out: Dict[str, int] = {}
+        for key in keys:
+            try:
+                p = fn(key)
+            except Exception:  # pragma: no cover — policy must not stall flips
+                p = 0
+            if p:
+                out[key] = p
+        return out or None
+
+    def throttle_by_key(self, key: str):
+        """Kind-specific store lookup by queue/store key (implemented by
+        each controller; used by policy flip weighting and the preemption
+        coordinator's candidate gathering)."""
+        raise NotImplementedError
 
     # ------------------------------------------------- batched-drain commit
 

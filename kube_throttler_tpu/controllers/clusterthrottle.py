@@ -177,8 +177,11 @@ class ClusterThrottleController(ControllerBase):
         promote = flips.get("promote")
         if promote:
             # classification-delta flips outside this drain: queue-front
-            # promotion (see ThrottleController.reconcile_batch)
-            self.workqueue.add_all_priority(promote)
+            # promotion, policy-weighted (see ThrottleController.
+            # reconcile_batch)
+            self.workqueue.add_all_priority(
+                promote, priorities=self.flip_priorities(promote)
+            )
         drained_flips = flips.get("drained", frozenset())
         # three-phase drain, mirroring ThrottleController.reconcile_batch:
         # compute → one batched status write → per-key post-write work
@@ -280,6 +283,10 @@ class ClusterThrottleController(ControllerBase):
             else:
                 terminated.append(pod)
         return non_terminated, terminated
+
+    def throttle_by_key(self, key: str) -> ClusterThrottle:
+        # cluster keys carry the NamespacedName leading "/" (api/types.py)
+        return self._get_cluster_throttle(key.lstrip("/"))
 
     def affected_cluster_throttle_keys(self, pod: Pod) -> List[str]:
         ns = self._get_namespace(pod.namespace)

@@ -175,8 +175,11 @@ class ThrottleController(ControllerBase):
             # keys OUTSIDE this drain whose published throttled flags
             # disagree with the fresh aggregates (the classification
             # delta): jump them to the queue front so their flip publishes
-            # next drain instead of after a full refresh-backlog cycle
-            self.workqueue.add_all_priority(promote)
+            # next drain instead of after a full refresh-backlog cycle —
+            # policy-weighted (valued accel classes drain first)
+            self.workqueue.add_all_priority(
+                promote, priorities=self.flip_priorities(promote)
+            )
         drained_flips = flips.get("drained", frozenset())
         # phase 1: pure status computation + the unreserve sets
         plans = []  # (key, thr, new_thr | None, unreserve_list)
@@ -280,6 +283,10 @@ class ThrottleController(ControllerBase):
         if self.device_manager is not None:
             return self.device_manager.affected_throttle_keys(self.KIND, pod)
         return [t.key for t in self.affected_throttles(pod)]
+
+    def throttle_by_key(self, key: str) -> Throttle:
+        namespace, _, name = key.partition("/")
+        return self._get_throttle(namespace, name)
 
     def affected_throttles(self, pod: Pod) -> List[Throttle]:
         if self.device_manager is not None:

@@ -130,6 +130,15 @@ class StoreJournal:
         # group's member reservations back (GangLedger.rollback_uncommitted).
         # Single-writer under the journal lock, read after replay.
         self.gang_ops: dict = {}
+        # PREEMPT control lines (policy/preempt.py): preempt_id → {"op":
+        # last op seen ("begin"|"commit"|"rollback"), "victims": [...],
+        # "objects": [...serialized victim pods...]}. A begin-without-
+        # commit tail is a mid-eviction crash: recovery re-creates the
+        # victims from the begin line's objects — an uncommitted
+        # preemption rolls back to ZERO evictions (the GANG contract's
+        # mirror, but over store state, so the rollback payload must ride
+        # the journal). Single-writer under the journal lock.
+        self.preempt_ops: dict = {}
         self._lines = 0
         self._file = None
         # running position of the journal content: byte length + sha256 of
@@ -153,6 +162,8 @@ class StoreJournal:
         self.compact_failures = 0  # compactions aborted (old log kept)
         self.replayed_events = 0  # events applied by the last replay
         self.stale_epoch_rejected = 0  # appends refused by the fencing gate
+        self.preempts_rolled_back = 0  # uncommitted preemptions rolled back
+        self.preempt_victims_restored = 0  # victim pods re-created by rollback
 
     # -- replay -------------------------------------------------------------
 
@@ -272,6 +283,23 @@ class StoreJournal:
                 elif group in self.gang_ops and "members" in self.gang_ops[group]:
                     entry["members"] = self.gang_ops[group]["members"]
                 self.gang_ops[group] = entry
+            return
+        if etype == "PREEMPT":
+            # preemption control line (policy/preempt.py): victim-eviction
+            # bracket — no store effect on replay; last op per id wins.
+            # The begin line carries the serialized victims so recovery
+            # can restore an uncommitted eviction to zero victims
+            # (rollback_uncommitted_preempts).
+            pid = str(event.get("id", ""))
+            if pid:
+                entry = {"op": str(event.get("op", ""))}
+                prev = self.preempt_ops.get(pid) or {}
+                for field in ("victims", "victimObjects"):
+                    if event.get(field) is not None:
+                        entry[field] = list(event[field])
+                    elif field in prev:
+                        entry[field] = prev[field]
+                self.preempt_ops[pid] = entry
             return
         kind = event["kind"]
         obj = object_from_dict({**event["object"], "kind": kind})
@@ -599,6 +627,24 @@ class StoreJournal:
                     new_sha.update(data)
                     new_bytes += len(data)
                     lines += 1
+                for pid, entry in sorted(self.preempt_ops.items()):
+                    # in-flight preemptions survive compaction the same way
+                    # (protocol checker: control types survive the
+                    # re-emit): a begin-without-commit marker — WITH its
+                    # victim payload — is how recovery learns a mid-
+                    # eviction crash must restore the victims; finished
+                    # preemptions carry no future meaning and drop
+                    if entry.get("op") != "begin":
+                        continue
+                    record = {"type": "PREEMPT", "op": "begin", "id": pid}
+                    for field in ("victims", "victimObjects"):
+                        if field in entry:
+                            record[field] = list(entry[field])
+                    data = (json.dumps(record) + "\n").encode("utf-8")
+                    f.write(data.decode("utf-8"))
+                    new_sha.update(data)
+                    new_bytes += len(data)
+                    lines += 1
                 for kind, obj in objs:
                     data = (
                         json.dumps(
@@ -700,6 +746,57 @@ class StoreJournal:
             self._sha.update(data)
             self._bytes += len(data)
             self._lines += 1
+
+    def append_preempt(
+        self, op: str, preempt_id: str, victims=None, objects=None
+    ) -> None:
+        """Append a PREEMPT control line (policy/preempt.py): ``op`` is
+        ``begin`` / ``commit`` / ``rollback``. The begin line carries the
+        victim keys AND their serialized objects — unlike GANG stamps
+        (advisory; the invariant is lock-held), this payload IS the crash
+        contract: a begin-without-commit tail tells recovery to re-create
+        exactly these objects (``rollback_uncommitted_preempts``), rolling
+        an interrupted eviction back to zero victims. No store effect on
+        replay; a fenced or closed journal drops the stamp like any other
+        refused append (the eviction then has no rollback guarantee, but a
+        fenced replica must not evict at all — the scheduler is gone)."""
+        record = {"type": "PREEMPT", "op": str(op), "id": str(preempt_id)}
+        if victims is not None:
+            record["victims"] = list(victims)
+        if objects is not None:
+            record["victimObjects"] = list(objects)
+        with self._lock:
+            entry = {"op": str(op)}
+            prev = self.preempt_ops.get(str(preempt_id)) or {}
+            for field in ("victims", "victimObjects"):
+                if record.get(field) is not None:
+                    entry[field] = list(record[field])
+                elif field in prev:
+                    entry[field] = prev[field]
+            self.preempt_ops[str(preempt_id)] = entry
+            if self._file is None:
+                return
+            if self.fencing is not None and self.fencing.is_stale():
+                self.stale_epoch_rejected += 1
+                return
+            data = (json.dumps(record) + "\n").encode("utf-8")
+            self._file.write(data.decode("utf-8"))
+            self._file.flush()
+            self._sha.update(data)
+            self._bytes += len(data)
+            self._lines += 1
+
+    def open_preempts(self) -> dict:
+        """Begin-without-commit preemptions (id → entry with victims +
+        objects), read under the journal lock — the snapshot payload
+        carries these so a tail-mode recovery whose anchor sits PAST the
+        begin line still knows which eviction to roll back."""
+        with self._lock:
+            return {
+                pid: {k: (list(v) if isinstance(v, list) else v) for k, v in e.items()}
+                for pid, e in self.preempt_ops.items()
+                if e.get("op") == "begin"
+            }
 
     def set_epoch(self, epoch: int) -> None:
         """Append a fencing EPOCH control line (engine/replication.py):
@@ -823,6 +920,44 @@ class StoreJournal:
                 self._file = None
 
 
+def rollback_uncommitted_preempts(
+    store: Store, journal: StoreJournal, extra_ops: Optional[dict] = None
+) -> Tuple[int, int]:
+    """Roll every begin-without-commit preemption back to ZERO evictions:
+    re-create each victim whose DELETED line landed (from the begin
+    line's serialized objects) and stamp ``rollback``. The creates run
+    through the live store, so they re-journal as ADDED lines and the log
+    stays self-reproducing. ``extra_ops`` merges snapshot-carried open
+    preemptions under the journal's own (the journal wins per id — it is
+    strictly newer). Returns ``(preempts rolled back, victims
+    restored)``; also accumulated on the journal's counters for recovery
+    reports. Idempotent: a rolled-back id's last op is ``rollback`` and
+    is skipped on any later pass."""
+    ops = dict(extra_ops or {})
+    ops.update(journal.preempt_ops)
+    rolled = restored = 0
+    for pid in sorted(ops):
+        entry = ops[pid]
+        if entry.get("op") != "begin":
+            continue
+        for d in entry.get("victimObjects") or []:
+            try:
+                obj = object_from_dict({**d, "kind": "Pod"})
+                store.create_pod(obj)
+                restored += 1
+            except ValueError:
+                pass  # still present: its DELETED never reached the log
+        journal.append_preempt("rollback", pid)
+        rolled += 1
+        logger.warning(
+            "journal %s: preemption %s crashed mid-eviction; rolled back "
+            "to zero evictions", journal.path, pid,
+        )
+    journal.preempts_rolled_back += rolled
+    journal.preempt_victims_restored += restored
+    return rolled, restored
+
+
 def attach(
     store: Store,
     path: str,
@@ -877,4 +1012,13 @@ def attach(
     # batched mutations (micro-batched ingest, batched status drains) group-
     # commit through on_batch; the per-event handler skips those dispatches
     store.add_batch_listener(journal)
+    if start_offset == 0:
+        # full replay ⇒ preempt_ops is complete: roll uncommitted
+        # preemptions back to zero evictions here, so EVERY full-replay
+        # consumer (genesis recovery, the crash harness's pure-replay
+        # oracle, a restarting standby) lands on the same contract without
+        # separate wiring. Tail replays defer to RecoveryManager.
+        # restore_preemptions, which merges the snapshot's open-preempt
+        # payload (the begin line may predate the anchor).
+        rollback_uncommitted_preempts(store, journal)
     return journal

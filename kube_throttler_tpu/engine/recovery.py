@@ -76,6 +76,8 @@ class RecoveryReport:
     gangs_restored: int = 0
     gangs_expired_dropped: int = 0
     gangs_rolled_back: int = 0  # journal begin-without-commit rollbacks
+    preempts_rolled_back: int = 0  # uncommitted preemptions rolled back
+    preempt_victims_restored: int = 0  # victim pods re-created by rollback
     epoch: int = 0  # highest fencing epoch found (snapshot header + journal)
     divergences: int = 0
     repaired_keys: List[str] = field(default_factory=list)
@@ -208,6 +210,17 @@ class RecoveryManager:
             # store": the fresh log would otherwise start empty and a later
             # genesis fallback would lose the snapshot's objects
             journal.compact()
+        # uncommitted-preemption rollback (zero evictions, the GANG
+        # contract's store-state mirror): full replays already rolled back
+        # inside attach(); merging the snapshot's open-preempt payload
+        # covers tail/snapshot-only modes whose anchor sits past the
+        # PREEMPT begin line. Idempotent per id (rollback-stamped ids skip).
+        from .journal import rollback_uncommitted_preempts
+
+        extra_preempts = (payload or {}).get("preempts") or {}
+        rollback_uncommitted_preempts(store, journal, extra_ops=extra_preempts)
+        self.report.preempts_rolled_back = journal.preempts_rolled_back
+        self.report.preempt_victims_restored = journal.preempt_victims_restored
         self.report.journal_mode = mode
         self.report.journal_lines_replayed = journal.replayed_events
         self.report.journal_interior_skipped = journal.replay_skipped
@@ -413,6 +426,8 @@ class RecoveryManager:
             "gangsRestored": r.gangs_restored,
             "gangsExpiredDropped": r.gangs_expired_dropped,
             "gangsRolledBack": r.gangs_rolled_back,
+            "preemptsRolledBack": r.preempts_rolled_back,
+            "preemptVictimsRestored": r.preempt_victims_restored,
             "reconcileDivergences": r.divergences,
             "durationSeconds": round(r.duration_s, 4),
         }

@@ -640,6 +640,7 @@ class StandbyReplicator:
         ops: List[Tuple[str, str, object]] = []
         epochs: List[int] = []
         gangs: List[Tuple[str, str, Optional[list]]] = []
+        preempts: List[dict] = []
         for raw in data.split(b"\n"):
             line = raw.strip()
             if not line:
@@ -662,6 +663,14 @@ class StandbyReplicator:
                             event.get("members"),
                         )
                     )
+                    continue
+                if event.get("type") == "PREEMPT":
+                    # preemption control line (protocol checker): forward
+                    # into OUR journal so a promoted standby still knows
+                    # which mid-eviction preemptions to roll back to zero
+                    # victims — dropping it would count as corruption and
+                    # silently lose the crash-rollback payload
+                    preempts.append(event)
                     continue
                 kind = event["kind"]
                 obj = object_from_dict({**event["object"], "kind": kind})
@@ -688,6 +697,15 @@ class StandbyReplicator:
         for op, group, members in gangs:
             if group:
                 self.journal.append_gang(op, group, members)
+        for event in preempts:
+            pid = str(event.get("id", ""))
+            if pid:
+                self.journal.append_preempt(
+                    str(event.get("op", "")),
+                    pid,
+                    victims=event.get("victims"),
+                    objects=event.get("victimObjects"),
+                )
         return len(ops)
 
     # -- lifecycle -----------------------------------------------------------

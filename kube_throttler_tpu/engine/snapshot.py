@@ -271,6 +271,16 @@ class SnapshotManager:
                         if self.gang_ledger is not None
                         else None
                     ),
+                    # open (begin-without-commit) preemptions at cut time:
+                    # a tail-mode recovery whose anchor sits past the
+                    # PREEMPT begin line still learns which eviction to
+                    # roll back (engine/journal.py open_preempts; store →
+                    # journal lock order, the dispatch path's own)
+                    "preempts": (
+                        self.journal.open_preempts()
+                        if self.journal is not None
+                        else None
+                    ),
                     "published": (
                         self.device_manager.published_flags()
                         if self.device_manager is not None

@@ -29,7 +29,10 @@ pipeline (flip-first publication):
   Promoting an item already queued normal MOVES it (an item is only ever
   queued once — dedup is lane-global); promoting an item in processing
   re-queues it into the priority lane at Done() with its recorded
-  priority. Used for throttles whose ``status.throttled`` flag is about
+  priority. Promoting an item ALREADY in the hi lane at a different
+  priority re-orders it in place (lazy heap supersede) — a pod/group
+  priority-annotation update reorders already-queued work instead of
+  riding the stale enqueue-time priority. Used for throttles whose ``status.throttled`` flag is about
   to flip: they overtake the value-only refresh backlog, which at full
   scale is the difference between ~100ms and multi-second flip
   publication.
@@ -97,7 +100,13 @@ class RateLimitingQueue:
         # priority lane, drained first: heap of (-priority, seq, item) —
         # highest priority first, ties in enqueue (age) order
         self._queue_hi: List[Tuple[int, int, str]] = []
-        self._hi: Set[str] = set()  # members of _queue_hi
+        # members of _queue_hi: item → its LIVE heap entry (-priority,
+        # seq). Re-prioritizing a queued item pushes a fresh entry and
+        # rebinds the mapping; the superseded heap entry is skipped lazily
+        # at pop (it no longer matches). Without this, hi-lane priority
+        # was pinned at enqueue time — a pod/group priority-annotation
+        # update could not reorder already-queued work.
+        self._hi: Dict[str, Tuple[int, int]] = {}
         # promoted while processing: done() re-queues into the hi lane at
         # the recorded priority (item → priority)
         self._hi_pending: Dict[str, int] = {}
@@ -162,8 +171,9 @@ class RateLimitingQueue:
     def _push_hi_locked(self, item: str, priority: int) -> None:
         assert_held(self._lock, "RateLimitingQueue._push_hi_locked")
         self._seq += 1
-        heapq.heappush(self._queue_hi, (-int(priority), self._seq, item))
-        self._hi.add(item)
+        entry = (-int(priority), self._seq)
+        heapq.heappush(self._queue_hi, (entry[0], entry[1], item))
+        self._hi[item] = entry
 
     def add_all_priority(self, items, priorities: Optional[Dict[str, int]] = None) -> None:
         """Add/promote items into the ordered priority lane (one lock
@@ -182,7 +192,15 @@ class RateLimitingQueue:
             for item in items:
                 prio = int(priorities.get(item, 0)) if priorities else 0
                 if item in self._hi:
-                    continue  # already prioritized
+                    if self._hi[item][0] == -prio:
+                        continue  # already queued at this priority
+                    # RE-prioritize in place: a priority-annotation update
+                    # must reorder already-queued work, not ride the stale
+                    # enqueue-time priority. Push a fresh entry (rebinding
+                    # _hi); the superseded heap entry is skipped at pop.
+                    self._push_hi_locked(item, prio)
+                    added = True
+                    continue
                 if item in self._dirty:
                     if item in self._processing:
                         self._hi_pending[item] = prio
@@ -207,9 +225,15 @@ class RateLimitingQueue:
         KT_LOCK_ASSERT=1). Priority lane first; ``hi_only`` refuses to touch
         the normal lane (the flip express drain). Returns (item, was_hi)."""
         assert_held(self._lock, "RateLimitingQueue._pop_ready_locked")
-        if self._queue_hi:
-            _, _, item = heapq.heappop(self._queue_hi)
-            self._hi.discard(item)
+        item = None
+        while self._queue_hi:
+            negp, seq, cand = heapq.heappop(self._queue_hi)
+            if self._hi.get(cand) != (negp, seq):
+                continue  # superseded by a re-prioritize: skip the stale entry
+            del self._hi[cand]
+            item = cand
+            break
+        if item is not None:
             was_hi = True
         elif self._queue and not hi_only:
             item = self._queue.pop(0)
@@ -232,13 +256,15 @@ class RateLimitingQueue:
         the workers use the lane to shape the drain (a priority first-key
         triggers the flip express drain, controllers/base._drain_more)."""
         with self._cond:
-            while not (self._queue or self._queue_hi) and not self._shutdown:
+            # _hi, not _queue_hi: the heap may hold only superseded
+            # (re-prioritized) entries, which pop as nothing
+            while not (self._queue or self._hi) and not self._shutdown:
                 # untimed callers still wake on every add/done/shutdown
                 # notify; the 1s re-check is only a lost-wakeup safety net
                 if not self._cond.wait(timeout=timeout if timeout is not None else 1.0):
                     if timeout is not None:
                         raise TimeoutError
-            if self._shutdown and not (self._queue or self._queue_hi):
+            if self._shutdown and not (self._queue or self._hi):
                 raise ShutDown
             return self._pop_ready_locked()
 
@@ -311,7 +337,9 @@ class RateLimitingQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._queue) + len(self._queue_hi)
+            # _hi, not _queue_hi: the heap may carry superseded
+            # (re-prioritized) entries that no longer represent items
+            return len(self._queue) + len(self._hi)
 
     # -- internals ---------------------------------------------------------
 

@@ -186,6 +186,7 @@ KNOWN_SITES = frozenset(
         "ingest.batch.partial",
         "gang.reserve.partial",
         "crash.gang.partial_reserve",
+        "crash.preempt.partial_evict",
         "crash.journal.append",
         "crash.journal.torn",
         "crash.journal.compact",

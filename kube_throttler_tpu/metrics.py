@@ -137,6 +137,17 @@ METRIC_NAMES = frozenset(
         "kube_throttler_hunt_corpus_size",
         "kube_throttler_hunt_findings_total",
         "kube_throttler_hunt_shrink_steps_total",
+        # preemption & policy engine (register_preempt_metrics /
+        # policy/preempt.py): cycle/victim counters, the no-progress
+        # outcomes (infeasible), the crash/live rollback counter, the
+        # evicted-then-readmitted churn counter the preemption-storm
+        # scenario gates on, and the victim-selection latency histogram
+        "kube_throttler_preempt_cycles_total",
+        "kube_throttler_preempt_victims_total",
+        "kube_throttler_preempt_infeasible_total",
+        "kube_throttler_preempt_rolled_back_total",
+        "kube_throttler_preempt_readmitted_total",
+        "kube_throttler_preempt_select_duration_seconds",
         # columnar arena store (register_store_metrics / engine/columnar.py):
         # slot population/recycling, intern-pool growth, and how often the
         # lazy edge materializes full API objects
@@ -669,6 +680,61 @@ def register_gang_metrics(registry: Registry, ledger) -> "HistogramVec":
 
     registry.register_pre_expose(flush)
     return check_h
+
+
+def register_preempt_metrics(registry: Registry, coordinator) -> "HistogramVec":
+    """Preemption & policy observability (policy/preempt.py): cycle and
+    victim counters sampled from the coordinator at scrape time, plus the
+    victim-selection latency histogram the coordinator observes inline
+    per cycle (returned, like the gang check histogram). The readmitted
+    counter is the victim-churn signal the preemption-storm scenario's
+    no-thrash SLO gate reads."""
+    cycles_c = registry.counter_vec(
+        "kube_throttler_preempt_cycles_total",
+        "preemption cycles that evicted at least one victim",
+        [],
+    )
+    victims_c = registry.counter_vec(
+        "kube_throttler_preempt_victims_total",
+        "victim pods evicted (whole gangs count every member)",
+        [],
+    )
+    infeasible_c = registry.counter_vec(
+        "kube_throttler_preempt_infeasible_total",
+        "cycles that evicted NOTHING because no victim set could admit "
+        "the group (member-exceeds, no eligible victims, or insufficient "
+        "eligible capacity)",
+        [],
+    )
+    rolled_c = registry.counter_vec(
+        "kube_throttler_preempt_rolled_back_total",
+        "evictions rolled back to zero victims (live mid-eviction failure; "
+        "crash rollbacks surface via the recovery report instead)",
+        [],
+    )
+    readmitted_c = registry.counter_vec(
+        "kube_throttler_preempt_readmitted_total",
+        "evicted pods readmitted within the churn window — the thrash "
+        "signal the preemption-storm scenario bounds",
+        [],
+    )
+    select_h = registry.histogram_vec(
+        "kube_throttler_preempt_select_duration_seconds",
+        "deficit derivation + candidate gathering + ranked victim "
+        "selection latency per cycle (batched kernel or host oracle)",
+        [],
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+    )
+
+    def flush() -> None:
+        cycles_c.set_key((), float(coordinator.cycles_total))
+        victims_c.set_key((), float(coordinator.victims_total))
+        infeasible_c.set_key((), float(coordinator.infeasible_total))
+        rolled_c.set_key((), float(coordinator.rolled_back_total))
+        readmitted_c.set_key((), float(coordinator.readmitted_total))
+
+    registry.register_pre_expose(flush)
+    return select_h
 
 
 def register_ha_metrics(registry: Registry, coordinator) -> None:

@@ -1,0 +1,70 @@
+"""Batched victim selection — the greedy ranked-prefix walk as ONE
+``lax.scan`` dispatch.
+
+Victim selection is inherently sequential: whether candidate *i* is taken
+depends on which deficits its selected predecessors already covered. The
+host oracle (policy/victims.py ``sequential_victim_select``) expresses
+that as a Python loop; this kernel expresses the SAME recurrence as a
+``lax.scan`` over the ranked contribution matrix, so a tick's whole
+candidate set is judged in one dispatch with no per-candidate host round
+trip. Semantics are pinned to the oracle by the seeded equivalence sweep
+and the hypothesis twin (tests/test_policy.py,
+tests/test_victim_property.py): identical verdicts AND identical selected
+sets on identical ranked inputs.
+
+Operands (policy/victims.py ``build_selection_problem`` flattens them from
+the per-(kind, throttle, dim) deficits derived off the same sparse
+matched-cols structures the gang kernel reads):
+
+- ``contrib`` int64[N, M] — row i = ranked candidate i's freed capacity
+  per flattened deficit dim (milli-units / counts; zero-padded rows are
+  never selected, so N ladder-pads freely);
+- ``deficit`` int64[M] — the positive capacity shortfalls (≤ 0 cells are
+  already met; zero-padded dims are inert).
+
+``max_victims`` is a STATIC cap (0 = uncapped): the scan stops taking
+once the cap is reached, exactly like the oracle's early break.
+
+The recurrence per candidate: take iff any dim has ``contrib > 0`` while
+``remaining > 0`` (and the cap allows), then subtract the WHOLE row —
+over-freeing is fine (an evicted pod frees everything it held), and
+subtracting unconditionally-on-take keeps the arithmetic identical to the
+oracle's ``remaining -= row``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("max_victims",))
+def victim_select(contrib, deficit, max_victims: int = 0):
+    """→ ``(selected bool[N], ok bool, remaining int64[M])`` — see module
+    docstring. ``contrib``/``deficit`` must be int64 (exact milli-unit
+    arithmetic; the dtype checker's stance on every admission plane)."""
+
+    def step(carry, row):
+        remaining, count = carry
+        helps = jnp.any((row > 0) & (remaining > 0))
+        if max_victims > 0:  # static branch: cap compiled in or out
+            take = helps & (count < max_victims)
+        else:
+            take = helps
+        remaining = jnp.where(take, remaining - row, remaining)
+        return (remaining, count + take.astype(jnp.int32)), take
+
+    (remaining, _count), selected = jax.lax.scan(
+        step, (deficit, jnp.int32(0)), contrib
+    )
+    ok = jnp.all(remaining <= 0)
+    return selected, ok, remaining
+
+
+# runtime retrace budget (KT_JIT_RETRACE_BUDGET): every jit entry here
+# reports its compile-cache size per tick — see utils/retrace.py
+from ..utils.retrace import register_all as _register_retrace
+
+_register_retrace(globals(), __name__)

@@ -49,6 +49,12 @@ class KubeThrottlerPluginArgs:
     # falls back to reservation_ttl (and to reserve-until-observed when
     # that is None too)
     gang_reservation_ttl: Optional[timedelta] = None
+    # policy-as-data (policy/spec.py, docs/policy.md): the ``policies``
+    # config key — a list of PolicySpec dicts with RFC3339 activation
+    # windows (first active wins, the temporaryThresholdOverrides
+    # discipline). Empty ⇒ the built-in default: weights 1.0, preemption
+    # off. Hot-swappable at runtime via plugin.set_policy_specs.
+    policy_specs: tuple = ()
 
 
 def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
@@ -103,6 +109,8 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
     if gang_ttl is not None and gang_ttl <= timedelta(0):
         raise ValueError(f"gangReservationTTL must be positive: {raw_gang_ttl!r}")
 
+    from ..policy.spec import policy_specs_from_config
+
     return KubeThrottlerPluginArgs(
         name=name,
         target_scheduler_name=target,
@@ -112,6 +120,7 @@ def decode_plugin_args(config: Mapping[str, Any]) -> KubeThrottlerPluginArgs:
         num_key_mutex=int(config.get("numKeyMutex", 0) or 0) or 128,
         reservation_ttl=reservation_ttl,
         gang_reservation_ttl=gang_ttl,
+        policy_specs=policy_specs_from_config(config.get("policies")),
     )
 
 

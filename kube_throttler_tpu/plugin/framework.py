@@ -31,6 +31,14 @@ class Status:
     def is_success(self) -> bool:
         return self.code == StatusCode.SUCCESS
 
+    def is_unschedulable(self) -> bool:
+        """Capacity-shaped rejection (either unschedulable code) — the
+        rejections gang-aware preemption may resolve; ERROR is not one."""
+        return self.code in (
+            StatusCode.UNSCHEDULABLE,
+            StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE,
+        )
+
     def message(self) -> str:
         return ", ".join(self.reasons)
 

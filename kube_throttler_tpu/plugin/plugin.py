@@ -138,10 +138,22 @@ class KubeThrottler:
             # promotes stale-flag keys straight into the priority lanes
             # (one add_all_priority per kind per batch — devicestate
             # _promote_ingest_flips)
+            # promotion order is policy-weighted (flip_priorities reads
+            # the controller's flip_priority_fn, wired below once the
+            # policy engine exists): valued accel classes' flips drain
+            # ahead of their hi-lane peers
             self.device_manager.install_flip_promoters(
                 {
-                    "throttle": self.throttle_ctr.workqueue.add_all_priority,
-                    "clusterthrottle": self.cluster_throttle_ctr.workqueue.add_all_priority,
+                    "throttle": (
+                        lambda keys, _c=self.throttle_ctr: _c.workqueue.add_all_priority(
+                            keys, priorities=_c.flip_priorities(keys)
+                        )
+                    ),
+                    "clusterthrottle": (
+                        lambda keys, _c=self.cluster_throttle_ctr: _c.workqueue.add_all_priority(
+                            keys, priorities=_c.flip_priorities(keys)
+                        )
+                    ),
                 }
             )
         self.throttle_ctr.tracer = self.tracer
@@ -180,9 +192,46 @@ class KubeThrottler:
         # member lifecycle: bound members admit, deleted pre-admission
         # members roll the whole group back (store → gang lock order)
         store.add_event_handler("Pod", self.gang.on_pod_event, replay=False)
-        from ..metrics import register_gang_metrics
+        # policy engine + preemption coordinator (policy/, docs/policy.md):
+        # policy-as-data value weights drive victim selection and the flip
+        # promotion priorities below; the coordinator owns the journaled,
+        # gang-atomic eviction cycle the scheduler triggers when a high-
+        # priority group is capacity-rejected. The journal is late-bound
+        # by the CLI like the gang ledger's.
+        from ..policy.preempt import PreemptionCoordinator
+        from ..policy.spec import PolicyEngine
+
+        self.policy = PolicyEngine(specs=args.policy_specs, clock=clock)
+        self.preempt = PreemptionCoordinator(
+            policy=self.policy,
+            kind_controllers=(
+                ("throttle", self.throttle_ctr),
+                ("clusterthrottle", self.cluster_throttle_ctr),
+            ),
+            store=store,
+            gang_ledger=self.gang,
+            device_manager=self.device_manager,
+        )
+        # admission ages + evicted-then-readmitted churn (both gated on
+        # the active policy enabling preemption — zero per-pod state kept
+        # otherwise, the PR 11 memory posture)
+        store.add_event_handler("Pod", self.preempt.on_pod_event, replay=False)
+        # the controllers' flip promotion order consumes the policy
+        # weights: a throttle declaring accel classes the policy values
+        # above default promotes ahead of its hi-lane peers (workqueue
+        # (-priority, seq) ordering)
+        self.throttle_ctr.flip_priority_fn = self._policy_flip_priority(
+            self.throttle_ctr
+        )
+        self.cluster_throttle_ctr.flip_priority_fn = self._policy_flip_priority(
+            self.cluster_throttle_ctr
+        )
+        from ..metrics import register_gang_metrics, register_preempt_metrics
 
         self._gang_check_hist = register_gang_metrics(self.metrics_registry, self.gang)
+        self.preempt.select_hist = register_preempt_metrics(
+            self.metrics_registry, self.preempt
+        )
         # local-path flip/total status-lag histograms; a lane-aware remote
         # writer (AsyncStatusCommitter) observes the "remote" path itself
         lag_metrics = StatusLagMetrics(self.metrics_registry, "local")
@@ -637,6 +686,59 @@ class KubeThrottler:
                 self.gang.rollback_group(group_key, "unreserve")
             except Exception:
                 logger.exception("Failed to unreserve gang %s", group_key)
+
+    # ----------------------------------------------------- policy / preempt
+
+    def _policy_flip_priority(self, ctr):
+        """Per-key hi-lane promotion priority for ``ctr``'s flips: the
+        policy weight margin of the throttle's declared accel classes
+        (PolicySpec.promotion_priority). Zero — the original FIFO lane —
+        for throttles with no classes, unknown keys, or a weightless
+        policy, so the default path is byte-identical."""
+
+        def fn(key: str) -> int:
+            spec = self.policy.active()
+            if not spec.class_weights:
+                return 0  # weightless policy: skip the store lookup entirely
+            try:
+                thr = ctr.throttle_by_key(key)
+            except Exception:
+                return 0
+            classes = [
+                e.accel_class for e in thr.spec.accel_class_thresholds
+            ]
+            if not classes:
+                return 0
+            return spec.promotion_priority(classes)
+
+        return fn
+
+    def set_policy_specs(self, specs) -> int:
+        """Hot-swap the whole policy (the temporaryThresholdOverrides
+        discipline applied to policy-as-data): accepts PolicySpec objects
+        or their dict wire form. Returns the new policy generation."""
+        from ..policy.spec import PolicySpec, policy_spec_from_dict
+
+        decoded = [
+            s if isinstance(s, PolicySpec) else policy_spec_from_dict(s)
+            for s in specs
+        ]
+        return self.policy.set_specs(decoded)
+
+    def maybe_preempt_gang(self, group_key: str, pods: Sequence[Pod]) -> bool:
+        """Gang-aware preemption entry (scheduler._schedule_gang calls
+        this after a capacity rejection): one coordinator cycle — policy
+        gate → deficits → ranked victim selection (batched kernel ≡
+        sequential oracle) → journaled, gang-atomic delete-then-requeue
+        eviction. True iff victims were evicted (the freed capacity's
+        requeue hints will re-drive the group)."""
+        with self.tracer.trace("preempt"):
+            try:
+                report = self.preempt.preempt_for_gang(group_key, list(pods))
+            except Exception:
+                logger.exception("preemption cycle failed for gang %s", group_key)
+                return False
+            return report["evicted"] > 0
 
     # ----------------------------------------------------------------- events
 

@@ -175,6 +175,29 @@ def _scenarios() -> List[Scenario]:
             ),
             leader_kill=True,
         ),
+        Scenario(
+            name="preempt_storm",
+            description=(
+                "preemption storm: waves of high-priority gangs land on "
+                "throttles filled by low-priority running work (some of it "
+                "gang-shaped), each wave forcing gang-aware victim "
+                "selection, whole-gang eviction, and delete-then-requeue "
+                "admission; evicted victims are recreated between waves so "
+                "the no-thrash SLO gate (evicted-then-readmitted rate "
+                "bounded) has a real churn signal. Driven by "
+                "scenarios/preemption.py through a real plugin + scheduler "
+                "stack with a preemption-enabled policy — excluded from "
+                "the generic replay matrix (like smoke), wired into "
+                "`make scenario-test` via its own runner"
+            ),
+            duration_s=6.0,
+            arrival=Arrival(kind="bursts", rate_hz=400.0, burst_s=0.5, idle_s=1.0),
+            topology=Topology(
+                pods=480, throttles=24, groups=12, nodes=8,
+                gang_size=4, priority_levels=4,
+            ),
+            slo=SloGates(flip_p99_ms=2500.0),
+        ),
     ]
 
 
@@ -221,7 +244,10 @@ def load_regressions() -> List[Dict]:
 
 
 def corpus(include_smoke: bool = False) -> List[Scenario]:
-    out = _scenarios()
+    # preempt_storm never rides the generic replay matrix: its gates need
+    # the scheduler+preemption stack its dedicated runner builds
+    # (scenarios/preemption.py, its own `make scenario-test` line)
+    out = [s for s in _scenarios() if s.name != "preempt_storm"]
     return out if include_smoke else [s for s in out if s.name != "smoke"]
 
 

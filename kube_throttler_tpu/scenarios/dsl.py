@@ -96,8 +96,13 @@ class Topology:
     ``accel-class`` annotations, and ``class_threshold_frac`` > 0 gives
     the flip-band throttles per-class ``accelClassThresholds`` entries
     (class c's threshold scaled down by up to that fraction), so the
-    class-resolved admission inequality diverges from the base one. All
-    three default OFF — committed traces stay byte-identical."""
+    class-resolved admission inequality diverges from the base one.
+
+    Priority axis (PR 15's preemption & policy paths): ``priority_levels``
+    > 0 spreads the population over that many ``priority`` annotations
+    (0..levels-1), the preemption-shaped distribution the policy layer's
+    ordered lanes and victim ranking read. All four default OFF —
+    committed traces stay byte-identical."""
 
     pods: int = 5000
     throttles: int = 300
@@ -107,6 +112,7 @@ class Topology:
     gang_size: int = 0
     accel_classes: int = 0
     class_threshold_frac: float = 0.0
+    priority_levels: int = 0
 
 
 @dataclass(frozen=True)

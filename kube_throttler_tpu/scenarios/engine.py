@@ -59,7 +59,7 @@ __all__ = ["run_scenario"]
 
 
 def _materialize_pod(name: str, grp: str, node: str, cpu_m: int,
-                     acl=None, gang=None, gsz: int = 0):
+                     acl=None, gang=None, gsz: int = 0, pri=None):
     from dataclasses import replace as _replace
 
     from ..api.pod import make_pod
@@ -67,6 +67,7 @@ def _materialize_pod(name: str, grp: str, node: str, cpu_m: int,
     pod = make_pod(
         name, labels={"grp": grp}, requests={"cpu": f"{cpu_m}m"},
         accel_class=acl, group=gang, group_size=gsz or None,
+        priority=pri,
     )
     pod = _replace(pod, spec=_replace(pod.spec, node_name=node))
     pod.status.phase = "Running"
@@ -74,14 +75,17 @@ def _materialize_pod(name: str, grp: str, node: str, cpu_m: int,
 
 
 def _pod_fields(spec_or_op: Dict) -> Dict:
-    """The gang/accel annotation fields a topology spec or trace op may
-    carry (absent on every axis-off trace — committed corpus unchanged)."""
+    """The gang/accel/priority annotation fields a topology spec or trace
+    op may carry (absent on every axis-off trace — committed corpus
+    unchanged)."""
     out = {}
     if "acl" in spec_or_op:
         out["acl"] = spec_or_op["acl"]
     if "gang" in spec_or_op:
         out["gang"] = spec_or_op["gang"]
         out["gsz"] = int(spec_or_op.get("gsz", 0))
+    if "pri" in spec_or_op:
+        out["pri"] = int(spec_or_op["pri"])
     return out
 
 

@@ -95,6 +95,7 @@ BOUNDS = {
     "gang_size": (0, 48),
     "accel_classes": (0, 6),
     "class_threshold_frac": (0.0, 0.8),
+    "priority_levels": (0, 10),
 }
 
 
@@ -162,6 +163,9 @@ def normalize(scn: Scenario) -> Scenario:
         class_threshold_frac=round(
             _clamp(float(topo.class_threshold_frac),
                    *BOUNDS["class_threshold_frac"]), 3
+        ),
+        priority_levels=_clamp(
+            int(topo.priority_levels), *BOUNDS["priority_levels"]
         ),
     )
     arrival = replace(
@@ -300,6 +304,40 @@ def _mut_topology_accel(scn: Scenario, rng: random.Random):
     )
 
 
+def _mut_topology_priority(scn: Scenario, rng: random.Random):
+    """Priority-distribution axis (PR 15's policy paths): spread the
+    population over N priority annotations — level choices cross the
+    ordered-lane and victim-ranking code paths with both shallow and deep
+    priority ladders."""
+    choices = [0, 2, 3, 5, 8]
+    if scn.topology.priority_levels in choices:
+        choices.remove(scn.topology.priority_levels)
+    return replace(
+        scn,
+        topology=replace(scn.topology, priority_levels=rng.choice(choices)),
+    )
+
+
+def _mut_preempt_shape(scn: Scenario, rng: random.Random):
+    """Preemption-toggle axis: arm (or disarm) the preemption-SHAPED
+    topology — gangs AND a priority ladder together, the precondition for
+    every gang-aware preemption path (a gang axis alone never ranks
+    victims; a priority axis alone never forms groups)."""
+    if scn.topology.gang_size > 0 and scn.topology.priority_levels > 0:
+        return replace(
+            scn,
+            topology=replace(scn.topology, gang_size=0, priority_levels=0),
+        )
+    return replace(
+        scn,
+        topology=replace(
+            scn.topology,
+            gang_size=rng.choice([2, 4, 8]),
+            priority_levels=rng.choice([2, 3, 5]),
+        ),
+    )
+
+
 def _mut_pattern(scn: Scenario, rng: random.Random):
     patterns = ["churn", "drain", "herd"]
     if scn.pattern in patterns:
@@ -414,6 +452,8 @@ MUTATORS: List[Tuple[str, Callable[[Scenario, random.Random], Optional[Scenario]
     ("topology_nodes", _mut_topology_nodes),
     ("topology_gang", _mut_topology_gang),
     ("topology_accel", _mut_topology_accel),
+    ("topology_priority", _mut_topology_priority),
+    ("preempt_shape", _mut_preempt_shape),
     ("pattern", _mut_pattern),
     ("mix", _mut_mix),
     ("leader_kill", _mut_leader_kill),

@@ -79,6 +79,7 @@ def build_topology(scn: Scenario, seed: int) -> Dict:
     n_hot = int(topo.pods * topo.hot_frac)
     n_classes = getattr(topo, "accel_classes", 0)
     gang_size = getattr(topo, "gang_size", 0)
+    n_priorities = getattr(topo, "priority_levels", 0)
     gang_counters: Dict[str, int] = {}
     pods: List[Dict] = []
     for i in range(topo.pods):
@@ -89,15 +90,17 @@ def build_topology(scn: Scenario, seed: int) -> Dict:
             "cpu_m": rng.randrange(1, 8) * 100,
             "node": f"n{i % max(topo.nodes, 1)}",
         }
-        # gang/heterogeneity axes (PR 7 admission paths): keys appear ONLY
-        # when the axis is on, so axis-off topologies — every committed
-        # trace — keep their exact bytes and shas
+        # gang/heterogeneity/priority axes (PR 7 + PR 15 admission and
+        # policy paths): keys appear ONLY when the axis is on, so axis-off
+        # topologies — every committed trace — keep their exact bytes/shas
         if n_classes > 0:
             spec["acl"] = f"ac{i % n_classes}"
         if gang_size > 0:
             c = gang_counters.get(grp, 0)
             gang_counters[grp] = c + 1
             spec["gang"] = f"gg-{grp}-{c // gang_size}"
+        if n_priorities > 0:
+            spec["pri"] = rng.randrange(n_priorities)
         pods.append(spec)
     return {"pods": pods, "n_hot": n_hot}
 
@@ -119,8 +122,10 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
     node_of = {p["name"]: p["node"] for p in topology["pods"]}
     acl_of = {p["name"]: p["acl"] for p in topology["pods"] if "acl" in p}
     gang_of = {p["name"]: p["gang"] for p in topology["pods"] if "gang" in p}
+    pri_of = {p["name"]: p["pri"] for p in topology["pods"] if "pri" in p}
     n_classes = getattr(topo, "accel_classes", 0)
     gang_size = getattr(topo, "gang_size", 0)
+    n_priorities = getattr(topo, "priority_levels", 0)
 
     def annot_fields(name: str) -> Dict:
         out: Dict = {}
@@ -129,6 +134,8 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
         if name in gang_of:
             out["gang"] = gang_of[name]
             out["gsz"] = gang_size
+        if name in pri_of:
+            out["pri"] = pri_of[name]
         return out
     alive = [p["name"] for p in topology["pods"]]
     alive_set = set(alive)
@@ -175,6 +182,8 @@ def build_trace(scn: Scenario, seed: int) -> Tuple[Dict, List[Dict]]:
         node_of[name] = node
         if n_classes > 0 and name not in acl_of:
             acl_of[name] = f"ac{rng.randrange(n_classes)}"
+        if n_priorities > 0 and name not in pri_of:
+            pri_of[name] = rng.randrange(n_priorities)
         alive.append(name)
         alive_set.add(name)
         emit(

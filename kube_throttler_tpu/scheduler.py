@@ -214,6 +214,10 @@ class Scheduler:
         # MODIFIED: adjust occupancy for bind/unbind/termination transitions
         # AND in-place request edits (same node, different requests), then
         # treat the change as a requeue hint for unschedulable pods
+        new_priority = priority_of(pod)
+        priority_changed = (
+            event.old_obj is None or priority_of(event.old_obj) != new_priority
+        )
         with self._cv:
             before = self._occupies_node_locked(event.old_obj)
             after = self._occupies_node_locked(pod)
@@ -224,6 +228,18 @@ class Scheduler:
                     self._bound_per_node[after] += 1
             self._track_usage_locked(before, event.old_obj, -1)
             self._track_usage_locked(after, pod, +1)
+            if priority_changed and pod.key in self._queued_keys:
+                # stale-priority requeue fix: a priority-annotation update
+                # re-orders already-queued work — candidate selection reads
+                # the queued entry's priority, so rewrite it in place (the
+                # workqueue hi lane re-orders the same way)
+                for q in self._active:
+                    if q.key == pod.key:
+                        q.priority = new_priority
+                        break
+                parked = self._unschedulable.get(pod.key)
+                if parked is not None:
+                    parked.priority = new_priority
         self._wake_unschedulable()
 
     def _on_cluster_event(self, event: Event) -> None:
@@ -376,7 +392,19 @@ class Scheduler:
         """Greedy all-members placement with TENTATIVE occupancy: either
         every member gets a node (respecting max-pods and declared
         allocatable against the members placed before it) or the whole
-        placement fails — the node-capacity half of all-or-nothing."""
+        placement fails — the node-capacity half of all-or-nothing.
+
+        Rank-aware contiguity (policy.rankAwarePlacement, on by default —
+        the MPI-locality hint of docs/policy.md): the node list IS the
+        topology order (racks/hosts enumerate adjacently), so each rank
+        prefers its predecessor's node, then the nearest index, among the
+        FEASIBLE nodes only. Feasibility is unchanged — a gang that fit
+        under first-fit still fits here; what changes is that a multi-host
+        gang stops fragmenting across distant nodes when a near one has
+        room. Rank 0 (and the scoring-off path) keeps the original
+        lowest-index first-fit."""
+        rank_aware = self._placement_rank_aware()
+        node_idx = {n.name: i for i, n in enumerate(self.nodes)}
         with self._cv:
             counts = dict(self._bound_per_node)
             used = {
@@ -385,9 +413,11 @@ class Scheduler:
                 if cap is not None
             }
             out: List[Node] = []
+            prev_idx: Optional[int] = None
             for pod in pods:
                 req = pod_request_resource_list(pod)
                 chosen = None
+                best_score = None
                 for node in self.nodes:
                     if counts[node.name] >= node.max_pods:
                         continue
@@ -400,15 +430,34 @@ class Scheduler:
                             for r, q in req.items()
                         ):
                             continue
-                    chosen = node
-                    break
+                    if not rank_aware or prev_idx is None:
+                        chosen = node
+                        break  # original first-fit
+                    idx = node_idx[node.name]
+                    score = (0 if idx == prev_idx else 1, abs(idx - prev_idx), idx)
+                    if best_score is None or score < best_score:
+                        best_score = score
+                        chosen = node
                 if chosen is None:
                     return None
                 counts[chosen.name] += 1
                 if self._alloc_cap[chosen.name] is not None:
                     rl_add(used[chosen.name], req)
                 out.append(chosen)
+                prev_idx = node_idx[chosen.name]
             return out
+
+    def _placement_rank_aware(self) -> bool:
+        """The active policy's rankAwarePlacement knob (docs/policy.md) —
+        True when the plugin carries no policy engine (the default spec's
+        value)."""
+        policy = getattr(self.plugin, "policy", None)
+        if policy is None:
+            return True
+        try:
+            return bool(policy.active().rank_aware_placement)
+        except Exception:  # pragma: no cover — a policy bug must not stop binds
+            return True
 
     def _schedule_gang(
         self, queued: _QueuedPod, pod: Pod, group: PodGroup, now: float, gen: int
@@ -432,6 +481,16 @@ class Scheduler:
         status = self.plugin.pre_filter_gang(group.key, members)
         if not status.is_success():
             self._record_failed_scheduling(pod, status.message())
+            if status.is_unschedulable():
+                # gang-aware preemption (docs/policy.md): a capacity
+                # rejection may be resolvable by evicting lower-priority
+                # running work. Eviction is delete-then-requeue — the
+                # DELETED events free node slots and used sums, the freed-
+                # capacity flips publish through the priority lane, and
+                # the deletes themselves are requeue hints — so this cycle
+                # just parks; the wake generation check below keeps the
+                # group active when victims were actually evicted.
+                self.plugin.maybe_preempt_gang(group.key, members)
             self._park(queued, now, gen)
             return None
 

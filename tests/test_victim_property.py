@@ -1,0 +1,68 @@
+"""Hypothesis property: the batched victim-selection kernel
+(ops/victim_select.py) is equivalent to the SEQUENTIAL host oracle
+(policy/victims.py ``sequential_victim_select``) on the verdict, the
+selected victim SET, and the remaining-deficit vector — over generated
+contribution matrices, deficit vectors, and victim caps, plus the
+padding form the device wrapper dispatches (ladder-padded rows/dims must
+be inert).
+
+Guarded by importorskip like tests/test_gang_property.py; the seeded
+deterministic twin (tests/test_policy.py TestKernelOracleSeeded) keeps
+the equivalence tested on environments without hypothesis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st
+
+from kube_throttler_tpu.ops.victim_select import victim_select
+from kube_throttler_tpu.policy.victims import sequential_victim_select
+
+amounts = st.sampled_from([0, 0, 1, 2, 5, 100, 333, 1000])
+deficits = st.sampled_from([0, 1, 4, 250, 900, 2000])
+
+
+@st.composite
+def problems(draw):
+    n = draw(st.integers(min_value=1, max_value=32))
+    m = draw(st.integers(min_value=1, max_value=6))
+    contrib = np.array(
+        [[draw(amounts) for _ in range(m)] for _ in range(n)], dtype=np.int64
+    )
+    deficit = np.array([draw(deficits) for _ in range(m)], dtype=np.int64)
+    cap = draw(st.sampled_from([0, 0, 1, 2, n]))
+    return contrib, deficit, cap
+
+
+@settings(max_examples=60, deadline=None)
+@given(problems())
+def test_kernel_equals_sequential_oracle(problem):
+    contrib, deficit, cap = problem
+    ok_s, sel_s, rem_s = sequential_victim_select(deficit, contrib, max_victims=cap)
+    sel_k, ok_k, rem_k = victim_select(contrib, deficit, max_victims=cap)
+    assert bool(np.asarray(ok_k)) == ok_s
+    assert list(np.nonzero(np.asarray(sel_k))[0]) == sel_s
+    assert np.asarray(rem_k).tolist() == rem_s.tolist()
+
+
+@settings(max_examples=25, deadline=None)
+@given(problems(), st.integers(min_value=0, max_value=8))
+def test_padding_is_inert(problem, pad):
+    """Zero-padded candidate rows and zero-deficit dims — the wrapper's
+    ladder form — never change the verdict or the selected set."""
+    contrib, deficit, cap = problem
+    n, m = contrib.shape
+    contrib_p = np.zeros((n + pad, m + pad), dtype=np.int64)
+    contrib_p[:n, :m] = contrib
+    deficit_p = np.zeros(m + pad, dtype=np.int64)
+    deficit_p[:m] = deficit
+    sel_a, ok_a, _ = victim_select(contrib, deficit, max_victims=cap)
+    sel_b, ok_b, _ = victim_select(contrib_p, deficit_p, max_victims=cap)
+    assert bool(np.asarray(ok_a)) == bool(np.asarray(ok_b))
+    assert list(np.nonzero(np.asarray(sel_a))[0]) == list(
+        np.nonzero(np.asarray(sel_b))[0]
+    )

@@ -66,6 +66,7 @@ CRASH_SITES = (
     "crash.journal.compact",
     "crash.journal.group_commit",
     "crash.gang.partial_reserve",
+    "crash.preempt.partial_evict",
     "crash.snapshot.begin",
     "crash.snapshot.tmp_partial",
     "crash.snapshot.pre_rename",
@@ -94,6 +95,11 @@ def default_hit(site: str, seed: int) -> int:
         # hit once per gang MEMBER-key add (~2-4 per gang reserve): odd
         # indices land mid-group — the exact partial-reserve instant
         return 3 + 8 * seed
+    if site == "crash.preempt.partial_evict":
+        # hit once per victim delete (~2-4 per preempt cycle): spread so
+        # each seed dies mid-eviction of a different cycle — some victims
+        # deleted, the commit line never lands
+        return 2 + 7 * seed
     return 1 + seed
 
 
@@ -137,6 +143,16 @@ def run_child(args) -> int:
         gang_ledger=gangs,
     )
     snapshotter.bind_journal(journal, every_lines=args.snapshot_every)
+    from kube_throttler_tpu.policy.preempt import PreemptionCoordinator
+    from kube_throttler_tpu.policy.spec import PolicyEngine
+
+    # journaled eviction driver (no controllers: the child exercises the
+    # PREEMPT begin → deletes → commit bracket and its crash artifacts,
+    # not victim selection — that has its own seeded equivalence tier)
+    preempt = PreemptionCoordinator(
+        PolicyEngine(), kind_controllers=(), store=store,
+        gang_ledger=gangs, journal=journal, faults=plan,
+    )
 
     rng = random.Random(args.seed)
     if store.get_namespace("default") is None:
@@ -185,8 +201,12 @@ def run_child(args) -> int:
                 bound = replace(p, spec=replace(p.spec, node_name="node-1"))
                 bound = replace(bound, status=replace(bound.status, phase="Running"))
                 store.update_pod(bound)
-        elif op < 0.6:  # delete a pod
-            pods = store.list_pods("default")
+        elif op < 0.6:  # delete a pod (never a "pv" preempt victim: their
+            # presence/absence is the preempt oracle's witness — a random
+            # delete of a rolled-back victim would fake a violation)
+            pods = [
+                p for p in store.list_pods("default") if not p.name.startswith("pv")
+            ]
             if pods:
                 p = rng.choice(pods)
                 store.delete_pod(p.namespace, p.name)
@@ -208,7 +228,7 @@ def run_child(args) -> int:
             name = rng.choice(throttles)
             thr = store.get_throttle("default", name)
             store.update_throttle_status(_recompute_status(store, thr))
-        elif op < 0.95:  # gang churn: all-or-nothing group reserve/rollback
+        elif op < 0.93:  # gang churn: all-or-nothing group reserve/rollback
             if rng.random() < 0.75 or not gangs.pending_groups():
                 name = rng.choice(throttles)
                 gid = rng.randrange(10**6)
@@ -235,6 +255,46 @@ def run_child(args) -> int:
                 rec = next(iter(gangs._groups.values()), None)  # noqa: SLF001
                 if rec is not None:
                     gangs.rollback_group(rec.group_key, "workload churn")
+        elif op < 0.96:  # preemption: journaled gang-atomic victim eviction
+            # victims are created RUNNING then evicted through the real
+            # PREEMPT begin → delete-per-victim → commit bracket;
+            # crash.preempt.partial_evict fires inside the delete loop —
+            # the oracle must then find either every victim restored
+            # (uncommitted ⇒ zero evictions) or every victim gone
+            # (committed), never a half-evicted set
+            vid = rng.randrange(10**6)
+            victims = []
+            if rng.random() < 0.5:  # whole-gang victim unit
+                size = rng.randrange(2, 5)
+                for i in range(size):
+                    victims.append(
+                        make_pod(
+                            f"pv{vid}-r{i}",
+                            labels={"grp": rng.choice(throttles)},
+                            requests={"cpu": "150m"},
+                            group=f"pg{vid}",
+                            group_size=size,
+                            node_name="node-1",
+                            phase="Running",
+                        )
+                    )
+            else:
+                for i in range(rng.randrange(1, 3)):
+                    victims.append(
+                        make_pod(
+                            f"pv{vid}-s{i}",
+                            labels={"grp": rng.choice(throttles)},
+                            requests={"cpu": "150m"},
+                            node_name="node-1",
+                            phase="Running",
+                        )
+                    )
+            for p in victims:
+                try:
+                    store.create_pod(p)
+                except ValueError:
+                    pass
+            preempt.execute_eviction(f"default/pre-{vid}", victims)
         else:  # reservation churn with mixed TTLs
             name = rng.choice(throttles)
             cache = reservations["throttle"]
@@ -443,6 +503,33 @@ def run_crash_cycle(
             assert pk in recorded_members, (
                 f"{site} seed={seed} hit={hit}: orphan gang-member "
                 f"reservation {pk} on {tk} outside any restored group"
+            )
+
+    # oracle 6: preemption all-or-nothing — recovery leaves NO open
+    # (begin) preemption; a committed one's victims are all gone; an
+    # uncommitted (now rollback-stamped) one's victims are ALL present —
+    # zero half-evicted victim sets, gang units included (a victim gang's
+    # members share one preempt's victim list)
+    live_pods = {p.key for p in recovered.list_pods("default")}
+    for pid, entry in rec_journal.preempt_ops.items():
+        op = entry.get("op")
+        assert op != "begin", (
+            f"{site} seed={seed} hit={hit}: preemption {pid} still open "
+            "(begin without commit) after recovery"
+        )
+        vkeys = set(entry.get("victims") or [])
+        if op == "commit":
+            present = vkeys & live_pods
+            assert not present, (
+                f"{site} seed={seed} hit={hit}: committed preemption {pid} "
+                f"left victims alive: {sorted(present)}"
+            )
+        elif op == "rollback":
+            missing = vkeys - live_pods
+            assert not missing, (
+                f"{site} seed={seed} hit={hit}: rolled-back preemption "
+                f"{pid} did not restore victims {sorted(missing)} — "
+                "a HALF-EVICTED victim set survived"
             )
 
     return {
