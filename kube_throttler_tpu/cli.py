@@ -95,11 +95,31 @@ def _serve_sharded(args, plugin_args, leader_elect: bool, stop) -> int:
         if not elector.acquire(stop):
             return 0
 
+    # mixed fleets: --shard-connect SID=HOST:PORT shards are dialed, not
+    # spawned (somebody else runs those workers — another host, a pod)
+    remote_workers = {}
+    for spec in getattr(args, "shard_connect", None) or []:
+        sid_s, _, hostport = spec.partition("=")
+        try:
+            sid = int(sid_s)
+        except ValueError:
+            raise SystemExit(f"--shard-connect: bad shard id in {spec!r}")
+        if not (0 <= sid < args.shards) or ":" not in hostport:
+            raise SystemExit(
+                f"--shard-connect: want SID=HOST:PORT with 0 <= SID < "
+                f"--shards, got {spec!r}"
+            )
+        remote_workers[sid] = hostport
+    transport = getattr(args, "shard_transport", "socketpair")
+    if remote_workers and transport != "tcp":
+        transport = "tcp"  # remote workers imply the fleet transport
+
     metrics_registry = Registry()
     front = AdmissionFront(
         args.shards,
         metrics_registry=metrics_registry,
         name=plugin_args.name,
+        rpc_deadline=getattr(args, "shard_rpc_deadline", 30.0),
     )
     supervisor = ShardSupervisor(
         front,
@@ -108,8 +128,14 @@ def _serve_sharded(args, plugin_args, leader_elect: bool, stop) -> int:
         use_device=not args.no_device,
         data_dir=args.data_dir or None,
         ingest_batch=getattr(args, "ingest_batch", "adaptive"),
+        transport=transport,
+        remote_workers=remote_workers,
     )
-    print(f"spawning {args.shards} shard workers...", flush=True)
+    print(
+        f"spawning {args.shards - len(remote_workers)} shard workers "
+        f"({transport}; {len(remote_workers)} remote)...",
+        flush=True,
+    )
     supervisor.start()
     if front.store.get_namespace("default") is None:
         front.store.create_namespace(Namespace("default"))
@@ -237,6 +263,31 @@ def main(argv: Optional[list] = None) -> int:
         "planes+controllers), behind a scatter-gather admission front on "
         "this process (docs/PERFORMANCE.md 'Multiprocess keyspace "
         "sharding'). 0 = single-process engine. Standalone mode only",
+    )
+    serve.add_argument(
+        "--shard-transport",
+        choices=("socketpair", "tcp"),
+        default="socketpair",
+        help="how the front reaches its shard workers: 'socketpair' "
+        "(inherited fd, children on this host) or 'tcp' (the cross-host "
+        "fleet transport: per-shard connection pools, reconnect backoff, "
+        "epoch-fenced frames — docs/robustness.md 'Cross-host fleet')",
+    )
+    serve.add_argument(
+        "--shard-connect",
+        action="append",
+        metavar="SID=HOST:PORT",
+        help="mixed fleets: do not spawn shard SID locally, dial a worker "
+        "somebody else runs (`python -m kube_throttler_tpu.sharding.worker "
+        "--listen ...`). Repeatable; implies --shard-transport tcp",
+    )
+    serve.add_argument(
+        "--shard-rpc-deadline",
+        type=float,
+        default=30.0,
+        help="per-op deadline budget (seconds) for front→shard RPCs; a "
+        "scatter call that outruns it degrades fail-safe instead of "
+        "blocking admission (the bulk triage op keeps a 120s floor)",
     )
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
     serve.add_argument(

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -173,6 +174,21 @@ class Store:
         # per-event handlers early-return on it (they already processed the
         # batch in on_batch). Only ever read under the store lock.
         self._in_batch_dispatch = False
+
+    # -- atomic sections ---------------------------------------------------
+
+    @contextmanager
+    def atomic(self):
+        """Hold the store lock across a multi-read (or read-modify) section.
+
+        Every mutation dispatches its events to listeners UNDER this
+        (reentrant) lock, so a section inside ``atomic()`` observes a
+        frozen store AND is totally ordered against every listener
+        callback — the property resync snapshots need: no event routed
+        concurrently can land in a shard queue between the snapshot's
+        reads and its enqueue (sharding/front.py resync_shard)."""
+        with self._lock:
+            yield self
 
     # -- watch ------------------------------------------------------------
 

@@ -126,6 +126,28 @@ reshard.dest.crash          the handoff DESTINATION dies mid-warm-up: mode
                             chunk, "error" fails the import RPC — the
                             coordinator aborts and retries after the
                             supervisor restart (worker reshard_import)
+net.connect.refused         the TCP shard client's connect() attempt is
+                            refused — the reconnector backs off (jittered
+                            exponential, PR 1 Backoff) and retries
+                            (sharding/ipc.py TcpShardClient)
+net.send.torn_frame         a framed send writes only a PREFIX of the
+                            frame and then the socket dies: the peer's
+                            read_frame sees a short read → treats the
+                            stream as closed (no partial frame is ever
+                            surfaced to the dispatcher)
+net.recv.stall              the receive path stalls for the rule's
+                            ``delay`` before reading the next frame (a
+                            slow link / half-open socket — deadlines must
+                            fire, dispatch must not block)
+net.partition               the link blackholes: sends raise without
+                            writing a byte and the connection is torn
+                            down. Armed per-direction, so one rule makes
+                            an ASYMMETRIC partition; the client degrades
+                            to fail-safe verdicts until heal + resync
+net.reconnect.storm         a just-reestablished connection is killed
+                            again immediately (flapping link): the
+                            reconnector must keep backing off, not
+                            hot-loop (sharding/ipc.py TcpShardClient)
 reshard.fence.race          the fence step loses a race (a concurrent
                             epoch superseded the handoff): the source
                             unfences and the range aborts back to it
@@ -217,6 +239,11 @@ KNOWN_SITES = frozenset(
         "reshard.dest.crash",
         "reshard.fence.race",
         "reshard.front.crash",
+        "net.connect.refused",
+        "net.send.torn_frame",
+        "net.recv.stall",
+        "net.partition",
+        "net.reconnect.storm",
     }
 )
 

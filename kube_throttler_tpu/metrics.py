@@ -155,6 +155,15 @@ METRIC_NAMES = frozenset(
         "kube_throttler_store_arena_slots_recycled_total",
         "kube_throttler_store_intern_pool_size",
         "kube_throttler_store_materializations_total",
+        # cross-host shard fleet (register_net_metrics / sharding/ipc.py
+        # TcpShardClient): reconnect churn, RPCs that outran their
+        # deadline budget, send-queue depth while partitioned, and
+        # cumulative partition downtime — the partition runbook's four
+        # signals (docs/robustness.md "Cross-host fleet")
+        "kube_throttler_net_reconnects_total",
+        "kube_throttler_net_rpc_deadline_exceeded_total",
+        "kube_throttler_net_send_queue_depth",
+        "kube_throttler_net_partition_seconds",
     }
 )
 
@@ -905,6 +914,62 @@ def register_shard_metrics(registry: Registry, front) -> Dict[str, object]:
 
     registry.register_pre_expose(flush)
     return {"scatter": scatter_h, "aborts": aborts_c, "misses": misses_c}
+
+
+def register_net_metrics(registry: Registry, front) -> Dict[str, object]:
+    """Cross-host fleet transport observability (sharding/ipc.py
+    ``TcpShardClient``), sampled at scrape time from the shard handles.
+    Socketpair/local handles report zeros for the TCP-only families, so
+    one dashboard covers mixed fleets. The four signals the partition
+    runbook watches: reconnect churn (a flapping link keeps the counter
+    moving), deadline-exceeded RPCs (a slow link that has not yet died),
+    send-queue depth (events parked behind a partition — shed pressure),
+    and cumulative partition downtime per shard."""
+    reconnects_c = registry.counter_vec(
+        "kube_throttler_net_reconnects_total",
+        "shard transport re-establishments after a connection loss",
+        ["shard"],
+    )
+    deadline_c = registry.counter_vec(
+        "kube_throttler_net_rpc_deadline_exceeded_total",
+        "shard RPCs abandoned because their per-op deadline budget "
+        "(--shard-rpc-deadline) elapsed",
+        ["shard"],
+    )
+    depth_g = registry.gauge_vec(
+        "kube_throttler_net_send_queue_depth",
+        "events queued at the front awaiting transport to the shard "
+        "(bounded; overflow sheds pod upserts and marks dirty)",
+        ["shard"],
+    )
+    partition_g = registry.gauge_vec(
+        "kube_throttler_net_partition_seconds",
+        "cumulative seconds the shard's primary connection has been "
+        "down, including the outage in progress",
+        ["shard"],
+    )
+
+    def flush() -> None:
+        for sid in range(front.n_shards):
+            handle = front.shards.get(sid)
+            if handle is None:
+                continue
+            key = (str(sid),)
+            reconnects_c.set_key(key, float(getattr(handle, "reconnects", 0)))
+            deadline_c.set_key(
+                key, float(getattr(handle, "deadline_exceeded", 0))
+            )
+            depth_g.set_key(key, float(handle.pending_events()))
+            outage = getattr(handle, "outage_seconds", None)
+            partition_g.set_key(key, outage() if outage is not None else 0.0)
+
+    registry.register_pre_expose(flush)
+    return {
+        "reconnects": reconnects_c,
+        "deadline_exceeded": deadline_c,
+        "queue_depth": depth_g,
+        "partition_seconds": partition_g,
+    }
 
 
 def register_reshard_metrics(registry: Registry, front) -> Dict[str, object]:

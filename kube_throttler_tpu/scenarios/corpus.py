@@ -176,6 +176,37 @@ def _scenarios() -> List[Scenario]:
             leader_kill=True,
         ),
         Scenario(
+            name="partition_bad_day",
+            description=(
+                "the composed bad day replayed through a TCP shard fleet "
+                "(transport='tcp' supervisor) with a seeded ASYMMETRIC "
+                "network partition mid-storm: one shard's client-side "
+                "net.partition window blackholes front→worker sends while "
+                "the worker stays healthy, then heals into an epoch-bumped "
+                "resync (stale frames fenced), plus one post-heal torn "
+                "frame so reconnect runs twice. Trace bytes are IDENTICAL "
+                "to bad_day (the net faults live client-side, outside the "
+                "trace) — the gates are the deterministic ones: zero wrong "
+                "verdicts, zero lost flips, bounded heal→converged "
+                "recovery, clean two-phase audits, real fencing evidence. "
+                "Driven by scenarios/partition.py — excluded from the "
+                "generic replay matrix (like preempt_storm), wired into "
+                "`make scenario-test` via its own runner"
+            ),
+            duration_s=7.0,
+            arrival=Arrival(kind="diurnal", rate_hz=700.0, trough_frac=0.3, cycles=1.5),
+            topology=Topology(pods=6000, throttles=300, groups=150, nodes=8),
+            faults=(
+                FaultSpec(
+                    site="net.partition", mode="error", window=(3.5, 5.5)
+                ),
+                FaultSpec(site="net.send.torn_frame", mode="torn", times=1),
+            ),
+            # no flip SLO: the partition window IS the latency story; the
+            # runner gates recovery + the zero-wrong/zero-lost invariants
+            slo=SloGates(flip_p99_ms=10_000.0, recovery_s=20.0),
+        ),
+        Scenario(
             name="preempt_storm",
             description=(
                 "preemption storm: waves of high-priority gangs land on "
@@ -246,8 +277,13 @@ def load_regressions() -> List[Dict]:
 def corpus(include_smoke: bool = False) -> List[Scenario]:
     # preempt_storm never rides the generic replay matrix: its gates need
     # the scheduler+preemption stack its dedicated runner builds
-    # (scenarios/preemption.py, its own `make scenario-test` line)
-    out = [s for s in _scenarios() if s.name != "preempt_storm"]
+    # (scenarios/preemption.py, its own `make scenario-test` line).
+    # partition_bad_day likewise: it needs the TCP fleet its runner builds
+    # (scenarios/partition.py, its own `make scenario-test` line)
+    out = [
+        s for s in _scenarios()
+        if s.name not in ("preempt_storm", "partition_bad_day")
+    ]
     return out if include_smoke else [s for s in out if s.name != "smoke"]
 
 

@@ -71,11 +71,21 @@ MUTABLE_FAULT_SITES: Dict[str, Tuple[str, ...]] = {
     "reshard.dest.crash": ("kill", "error"),
     "reshard.fence.race": ("error",),
     "reshard.front.crash": ("error",),
+    # the network-fault axis: net.* sites only fire on the TCP transport,
+    # so a program arming any of them rides the sharded tier with a
+    # transport="tcp" fleet (run_sharded_program arms them client-side)
+    "net.connect.refused": ("error",),
+    "net.send.torn_frame": ("torn",),
+    "net.recv.stall": ("delay",),
+    "net.partition": ("error",),
+    "net.reconnect.storm": ("error",),
 }
 
 # the sharded-tier families: a program arming any of these is evaluated
-# through the multiprocess replayer, not the single-process engine
-SHARD_TIER_PREFIXES = ("shard.", "reshard.")
+# through the multiprocess replayer, not the single-process engine.
+# net.* rides the same tier (the sites live in the TCP framing layer —
+# a single-process replay could never reach them)
+SHARD_TIER_PREFIXES = ("shard.", "reshard.", "net.")
 
 
 def needs_shard_tier(scn: Scenario) -> bool:

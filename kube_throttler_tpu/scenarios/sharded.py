@@ -310,7 +310,7 @@ def run_sharded_bad_day(
 # the hunt's sharded tier: arbitrary DSL programs through the real stack
 # --------------------------------------------------------------------------
 
-SHARD_TIER_PREFIXES = ("shard.", "reshard.")
+SHARD_TIER_PREFIXES = ("shard.", "reshard.", "net.")
 
 
 def run_sharded_program(
@@ -368,6 +368,11 @@ def run_sharded_program(
         if f.site in ("reshard.fence.race", "reshard.front.crash")
         or (f.site == "reshard.dest.crash" and f.mode != "kill")
     ]
+    # net.* fires in the TCP framing layer: a program arming any of them
+    # runs the fleet over transport="tcp" and arms the rules CLIENT-side
+    # on one shard's handle (the same one-victim convention as
+    # shard.worker.kill) — asymmetric by construction
+    net_armed = [f for f in shard_faults if f.site.startswith("net.")]
     do_rescale = any(f.site.startswith("reshard.") for f in shard_faults)
 
     plan = FaultPlan(seed=seed)
@@ -393,11 +398,42 @@ def run_sharded_program(
         front,
         use_device=True,
         restart_backoff=0.3,
+        transport="tcp" if net_armed else "socketpair",
         worker_args=["--prepare-ttl", str(prepare_ttl_s)],
         per_shard_args=per_shard,
         env={**os.environ, "KT_SHARD_QUIET": "1", "KT_LOCK_ASSERT": "0"},
     )
     supervisor.start(ready_timeout=300.0)
+
+    net_plan: Optional[FaultPlan] = None
+    net_t0: List[float] = [float("inf")]
+    if net_armed:
+        net_plan = FaultPlan(seed=seed)
+        # DSL windows are virtual trace-seconds; the client-side plan runs
+        # on the wall clock — scale by replay-time / trace-time (hit-count
+        # rules pass through unscaled, same quantization posture as the
+        # worker-side rules above)
+        wall_per_virtual = (len(ops) / pace_hz) / max(scn.duration_s, 1e-9)
+        for f in net_armed:
+            window = None
+            if f.window is not None:
+                window = (
+                    f.window[0] * wall_per_virtual,
+                    f.window[1] * wall_per_virtual,
+                )
+            # an unbounded blackhole rule would hold the shard down past
+            # every gate deadline and hunt the harness, not the code: a
+            # windowless rule defaults to a small finite burst
+            times = f.times if f.times is not None else (
+                None if window is not None else 3
+            )
+            net_plan.rule(
+                f.site, mode=f.mode, probability=f.probability,
+                times=times, delay=f.delay, window=window,
+            )
+        net_plan.set_time_source(lambda: time.perf_counter() - net_t0[0])
+        net_sid = 1 if n_shards > 1 else 0
+        front.shards[net_sid].faults = net_plan
     report: Dict = {
         "scenario": scn.name,
         "tier": "sharded",
@@ -436,6 +472,7 @@ def run_sharded_program(
         rescale_thread: Optional[threading.Thread] = None
         rescale_idx = int(len(ops) * 0.4) if do_rescale else -1
         t0 = time.perf_counter()
+        net_t0[0] = t0  # anchor the client-side net plan's wall clock
         for i, op in enumerate(ops):
             next_at = t0 + i / pace_hz
             delay = next_at - time.perf_counter()
@@ -569,6 +606,9 @@ def run_sharded_program(
         # coverage fingerprint: in-process firings from the plan history,
         # worker-side firings witnessed by their observable effects
         fp_sites = {site: len(v) for site, v in plan.snapshot().items()}
+        if net_plan is not None:
+            for site, v in net_plan.snapshot().items():
+                fp_sites[site] = fp_sites.get(site, 0) + len(v)
         rep = rescale_result.get("report") or {}
         if kill_armed and restarts_total:
             fp_sites["shard.worker.kill"] = fp_sites.get(

@@ -104,9 +104,20 @@ class AdmissionFront:
         event_recorder=None,
         faults=None,
         name: str = "kube-throttler",
+        rpc_deadline: float = 30.0,
+        rpc_deadlines: Optional[Dict[str, float]] = None,
     ):
         self.n_shards = int(n_shards)
         self.name = name
+        # per-op RPC deadline budget (--shard-rpc-deadline): every
+        # scatter resolves its timeout through deadline_for(op). The
+        # batch triage op keeps a wide floor — one device pass over a
+        # full shard population legitimately outlives a point RPC
+        self.rpc_deadline = float(rpc_deadline)
+        self.rpc_deadlines: Dict[str, float] = {
+            "pre_filter_batch": max(120.0, self.rpc_deadline),
+        }
+        self.rpc_deadlines.update(rpc_deadlines or {})
         self.ring = HashRing(self.n_shards)
         self.store = store if store is not None else Store()
         self.metrics_registry = metrics_registry or Registry()
@@ -174,6 +185,11 @@ class AdmissionFront:
         self._m_scatter = m["scatter"]
         self._m_aborts = m["aborts"]
         self._m_misses = m["misses"]
+        from ..metrics import register_net_metrics
+
+        # kube_throttler_net_* families: transport health per shard,
+        # sampled from the handles at scrape (TCP fleets; zeros locally)
+        self.net_metrics = register_net_metrics(self.metrics_registry, self)
         from ..metrics import register_reshard_metrics
 
         # kube_throttler_reshard_* families: the gauge samples
@@ -215,7 +231,13 @@ class AdmissionFront:
             handle = self.shards.get(sid)
             state = "ok"
             if handle is None or not handle.alive:
-                state, down = "down", down + 1
+                down += 1
+                state = "down"
+                if handle is not None and getattr(handle, "transport", "") == "tcp":
+                    # connection lost ≠ process died: the TCP client is
+                    # reconnecting on its own — the supervisor must NOT
+                    # spuriously restart a partitioned remote worker
+                    state = "disconnected"
             elif handle.is_dirty():
                 state = "degraded"
             detail[f"shard-{sid}"] = state
@@ -427,9 +449,19 @@ class AdmissionFront:
 
     # ----------------------------------------------------------- scatter RPC
 
-    def _scatter(self, targets: Sequence[int], op: str, payload, timeout=30.0):
+    def deadline_for(self, op: str) -> float:
+        """The per-op RPC deadline budget for a scatter call."""
+        return self.rpc_deadlines.get(op, self.rpc_deadline)
+
+    def _scatter(
+        self, targets: Sequence[int], op: str, payload,
+        timeout: Optional[float] = None,
+    ):
         """Fan an RPC out to ``targets``; returns {shard_id: result}.
-        Shard failures surface as the exception object in the map."""
+        Shard failures surface as the exception object in the map.
+        ``timeout=None`` resolves through the per-op deadline budget."""
+        if timeout is None:
+            timeout = self.deadline_for(op)
         t0 = time.monotonic()
         targets = list(targets)
 
@@ -560,7 +592,7 @@ class AdmissionFront:
         applies)."""
         with self.tracer.trace("prefilter_batch"):
             alive = [s for s in range(self.n_shards) if self._alive(s) is not None]
-            results = self._scatter(alive, "pre_filter_batch", None, timeout=120.0)
+            results = self._scatter(alive, "pre_filter_batch", None)
             # during a live reshard the AND-merge must consult only each
             # pod's AUTHORITATIVE owners: a warming mirror's verdict is
             # advisory (it may lag the source), and a dead mirror must not
@@ -639,8 +671,13 @@ class AdmissionFront:
             results = self._scatter(targets, "reserve_prepare", {"txn": txn, "pod": pod})
             failed = {sid: r for sid, r in results.items() if isinstance(r, Exception)}
             if failed:
-                prepared = [sid for sid in targets if sid not in failed]
-                self._scatter(prepared, "txn_abort", {"txn": txn})
+                # abort EVERY target, not just the ones that answered ok:
+                # a prepare that TIMED OUT may still have landed (the
+                # deadline is the front's clock, not the shard's) — only
+                # an abort addressed to all of them guarantees zero
+                # orphans now rather than after the shard's TTL reaper.
+                # Shards that never saw the prepare no-op the abort
+                self._scatter(targets, "txn_abort", {"txn": txn})
                 with self._txn_lock:
                     self.two_phase_aborts += 1
                 self._m_aborts.inc({})
@@ -759,8 +796,9 @@ class AdmissionFront:
                 results.update(r)
             failed = {sid: r for sid, r in results.items() if isinstance(r, Exception)}
             if failed:
-                prepared = [sid for sid in targets if sid not in failed]
-                self._scatter(prepared, "txn_abort", {"txn": txn})
+                # same zero-orphan discipline as reserve(): a timed-out
+                # gang_prepare may have landed — abort ALL targets
+                self._scatter(targets, "txn_abort", {"txn": txn})
                 with self._txn_lock:
                     self.two_phase_aborts += 1
                 self._m_aborts.inc({})
@@ -887,6 +925,23 @@ class AdmissionFront:
         handle = self.shards.get(shard_id)
         if handle is None:
             return 0
+        bump = getattr(handle, "bump_epoch", None)
+        if bump is not None:
+            # fence the past before replaying the present: frames from
+            # before the heal (a partitioned peer's view, bytes parked in
+            # a kernel buffer) must be refused once this resync lands
+            bump()
+        # store.atomic(): snapshot reads and the enqueue must be ATOMIC
+        # w.r.t. dispatch — mutations dispatch (and _flush_buffers
+        # enqueues) under the store lock, so holding it here means no
+        # live event can land in the shard queue between this snapshot's
+        # reads and its enqueue. Without it, an event routed while we
+        # iterate sits BEFORE the (older) snapshot in the queue and the
+        # worker keeps the stale object forever.
+        with self.store.atomic():
+            return self._resync_locked(shard_id, handle)
+
+    def _resync_locked(self, shard_id: int, handle) -> int:
         ops: List[tuple] = []
         want: Dict[str, List[str]] = {
             "Namespace": [], "Throttle": [], "ClusterThrottle": [], "Pod": [],
@@ -971,6 +1026,9 @@ class AdmissionFront:
             s["alive"] = True
             s["events_sent"] = handle.events_sent
             s["dropped_at_front"] = handle.dropped
+            s["transport"] = getattr(handle, "transport", "socketpair")
+            s["reconnects"] = getattr(handle, "reconnects", 0)
+            s["rpc_deadline_exceeded"] = getattr(handle, "deadline_exceeded", 0)
             shards[sid] = s
         with self._route_lock:
             misses = self.route_misses

@@ -1,8 +1,8 @@
-"""Local IPC between the admission front and shard workers.
+"""IPC between the admission front and shard workers — socketpair or TCP.
 
 Frame protocol (both directions, over one stream socket per shard):
 
-    [4-byte little-endian length][pickled (mtype, rid, body)]
+    [4-byte little-endian length][pickled (mtype, rid, body, epoch)]
 
 Message types:
 
@@ -20,6 +20,39 @@ Message types:
   controllers wrote a Throttle/ClusterThrottle status) streaming back
   so the front's store stays the merged read view — flips first, like
   the two-lane pipeline they came from.
+- ``"sub"``  front→shard, one-way (TCP only): subscribe THIS connection
+  to the shard's push stream. A socketpair carries exactly one
+  connection so the worker binds pushes at accept; a TCP client keeps a
+  small pool of connections and nominates its primary lane.
+
+Epoch fencing (PR 6 ``FencingEpoch``, end to end over the wire): every
+frame carries the sender's view of the shard's fencing epoch. The front
+owns the counter — it bumps it at the head of every resync (a restart,
+a reconnect after a partition, a reshard retarget) — and the worker
+tracks the max it has seen. A frame stamped with a LOWER epoch is a
+message from the past: a partitioned-then-healed peer, or bytes that sat
+in a kernel buffer across a heal. The worker drops stale ``evt`` batches
+and refuses stale ``req`` frames with a :class:`FencedError` body (the
+on-the-wire 409); the front drops stale ``push`` frames. Socketpair
+transports never bump (epoch 0 both sides), so the fencing layer is
+inert there — a dead child's socket dies with it.
+
+Network fault sites (``net.*`` in faults/plan.py), injected HERE at the
+framing layer so one seeded :class:`~..faults.plan.FaultPlan` drives
+both transports identically:
+
+- ``net.partition``       — sends raise without writing a byte
+  (blackholed link). Armed per-plan-holder, so arming only one
+  direction makes an ASYMMETRIC partition.
+- ``net.send.torn_frame`` — a send writes only a prefix of the frame,
+  then dies; the peer's ``read_frame`` must surface it as a clean EOF,
+  never a partial frame.
+- ``net.recv.stall``      — the receive path sleeps the rule's
+  ``delay`` before the next frame (slow link / half-open socket).
+- ``net.connect.refused`` — the TCP client's connect attempt is
+  refused (checked in the reconnector).
+- ``net.reconnect.storm`` — a just-reestablished connection dies again
+  immediately (flapping link; the backoff must keep growing).
 
 Overflow posture mirrors ``MicroBatchIngest``: the event queue is
 bounded and sheds ONLY pod upserts (verdict-safe); a shed marks the
@@ -34,8 +67,9 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from collections import deque
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..utils.lockorder import guard_attrs, make_lock
 
@@ -49,17 +83,46 @@ Op = Tuple[str, str, object]
 
 
 class ShardUnavailable(Exception):
-    """The shard's transport is down (process died / socket closed)."""
+    """The shard's transport is down (process died / socket closed /
+    partitioned / RPC deadline exceeded)."""
 
 
-def send_frame(sock: socket.socket, send_lock, mtype: str, rid: int, body) -> None:
-    payload = pickle.dumps((mtype, rid, body), protocol=PICKLE_PROTO)
+class FencedError(RuntimeError):
+    """The peer refused a stale-epoch frame — the wire-level 409. The
+    holder of a stale epoch missed a resync/reshard/promotion while
+    partitioned and must NOT be trusted until re-synced."""
+
+
+def send_frame(
+    sock: socket.socket, send_lock, mtype: str, rid: int, body,
+    epoch: int = 0, faults=None,
+) -> None:
+    """Pickle and send one frame. ``faults`` arms the framing-layer
+    ``net.*`` sites (same seeded plan drives socketpair and TCP)."""
+    payload = pickle.dumps((mtype, rid, body, epoch), protocol=PICKLE_PROTO)
+    frame = _LEN.pack(len(payload)) + payload
+    if faults is not None:
+        fault = faults.check("net.partition")
+        if fault is not None:
+            # blackholed link: nothing reaches the wire; the caller
+            # handles it exactly like a peer that vanished
+            raise OSError(f"injected partition (hit {fault.hit}): frame blackholed")
+        fault = faults.check("net.send.torn_frame")
+        if fault is not None:
+            with send_lock:
+                sock.sendall(frame[: max(1, len(frame) // 2)])
+            raise OSError(f"injected torn frame (hit {fault.hit}): prefix only")
     with send_lock:
-        sock.sendall(_LEN.pack(len(payload)) + payload)
+        sock.sendall(frame)
 
 
-def read_frame(rfile) -> Optional[Tuple[str, int, object]]:
-    """Read one frame from a buffered reader; None on EOF."""
+def read_frame(rfile, faults=None) -> Optional[Tuple[str, int, object, int]]:
+    """Read one frame from a buffered reader; None on EOF or a torn
+    (short) frame — a partial frame is never surfaced."""
+    if faults is not None:
+        fault = faults.check("net.recv.stall")
+        if fault is not None:
+            fault.sleep()  # slow link: the peer's deadlines must fire
     header = rfile.read(_LEN.size)
     if not header or len(header) < _LEN.size:
         return None
@@ -67,7 +130,27 @@ def read_frame(rfile) -> Optional[Tuple[str, int, object]]:
     payload = rfile.read(n)
     if len(payload) < n:
         return None
-    return pickle.loads(payload)
+    try:
+        return pickle.loads(payload)
+    except Exception:  # noqa: BLE001 — undecodable bytes = torn stream
+        # a torn write can leave the stream mid-frame: the bytes after
+        # the tear parse as a bogus length and land here. The lane is
+        # unrecoverable (framing lost) — report EOF, the peer redials.
+        return None
+
+
+def _raise_shard_error(shard_id: int, op: str, body) -> None:
+    """Map a shard-side ``(False, body)`` RPC answer to the right client
+    exception: a ``FencedError:``-prefixed body is the wire 409."""
+    msg = str(body)
+    if msg.startswith("FencedError"):
+        raise FencedError(f"shard {shard_id} {op} fenced: {msg}")
+    raise RuntimeError(f"shard {shard_id} {op} failed: {msg}")
+
+
+def _sheddable(op: Op) -> bool:
+    verb, kind, _ = op
+    return kind == "Pod" and verb != "delete"
 
 
 @guard_attrs
@@ -81,6 +164,7 @@ class ShardClient:
     are decoupled from the store lock the router runs under.
     """
 
+    transport = "socketpair"
     MAX_QUEUE = 65536
     EVT_BATCH = 512
 
@@ -88,6 +172,7 @@ class ShardClient:
         "_queue": "self._qlock",
         "_pending": "self._plock",
         "_rid": "self._plock",
+        "deadline_exceeded": "self._plock",
         "dropped": "self._qlock",
         "dirty": "self._qlock",
     }
@@ -100,6 +185,8 @@ class ShardClient:
         on_down: Optional[Callable[[int], None]] = None,
         faults=None,
         maxsize: Optional[int] = None,
+        default_deadline: float = 30.0,
+        deadlines: Optional[Dict[str, float]] = None,
     ):
         self.shard_id = shard_id
         self.sock = sock
@@ -107,6 +194,11 @@ class ShardClient:
         self.on_down = on_down
         self.faults = faults
         self.maxsize = maxsize or self.MAX_QUEUE
+        # per-op RPC deadline budget: explicit per-op entries override
+        # the default; ``request(timeout=None)`` resolves through this
+        self.default_deadline = float(default_deadline)
+        self.deadlines: Dict[str, float] = dict(deadlines or {})
+        self.epoch = 0  # socketpair transport never bumps (fencing inert)
         self._send_lock = make_lock(f"shard.client.send.{shard_id}")
         self._qlock = make_lock(f"shard.client.queue.{shard_id}")
         self._qcond = threading.Condition(self._qlock)
@@ -122,6 +214,8 @@ class ShardClient:
         self.frames_sent = 0
         self.dropped = 0  # verdict-safe sheds (queue overflow)
         self.dirty = False  # lost events/sends — needs resync
+        self.deadline_exceeded = 0  # RPCs that outran their budget
+        self.reconnects = 0  # a socketpair cannot reconnect (metrics parity)
         self._sender = threading.Thread(
             target=self._send_loop, name=f"shard{shard_id}-send", daemon=True
         )
@@ -133,11 +227,6 @@ class ShardClient:
 
     # ------------------------------------------------------------- events
 
-    @staticmethod
-    def _sheddable(op: Op) -> bool:
-        verb, kind, _ = op
-        return kind == "Pod" and verb != "delete"
-
     def enqueue_ops(self, ops: Sequence[Op]) -> None:
         """Queue ops for the shard; never blocks (verdict-safe shed)."""
         with self._qcond:
@@ -146,14 +235,14 @@ class ShardClient:
             for op in ops:
                 if len(self._queue) >= self.maxsize:
                     idx = next(
-                        (i for i, q in enumerate(self._queue) if self._sheddable(q)),
+                        (i for i, q in enumerate(self._queue) if _sheddable(q)),
                         None,
                     )
                     if idx is not None:
                         del self._queue[idx]
                         self.dropped += 1
                         self.dirty = True
-                    elif self._sheddable(op):
+                    elif _sheddable(op):
                         self.dropped += 1
                         self.dirty = True
                         continue
@@ -202,7 +291,8 @@ class ShardClient:
                             raise OSError(
                                 f"injected IPC send failure (hit {fault.hit})"
                             )
-                    send_frame(self.sock, self._send_lock, "evt", 0, batch)
+                    send_frame(self.sock, self._send_lock, "evt", 0, batch,
+                               epoch=self.epoch, faults=self.faults)
                     self.events_sent += len(batch)
                     self.frames_sent += 1
                 except OSError:
@@ -221,9 +311,16 @@ class ShardClient:
 
     # ---------------------------------------------------------------- RPC
 
-    def request(self, op: str, payload=None, timeout: float = 30.0):
+    def deadline_for(self, op: str) -> float:
+        return self.deadlines.get(op, self.default_deadline)
+
+    def request(self, op: str, payload=None, timeout: Optional[float] = None):
         """Blocking RPC; raises :class:`ShardUnavailable` on a dead shard
-        or timeout, re-raises shard-side errors as RuntimeError."""
+        or an exceeded deadline, :class:`FencedError` on a stale-epoch
+        refusal, re-raises other shard-side errors as RuntimeError.
+        ``timeout=None`` resolves through the per-op deadline budget."""
+        if timeout is None:
+            timeout = self.deadline_for(op)
         if not self._alive:
             raise ShardUnavailable(f"shard {self.shard_id} is down")
         with self._plock:
@@ -232,7 +329,8 @@ class ShardClient:
             slot = [threading.Event(), None]
             self._pending[rid] = slot
         try:
-            send_frame(self.sock, self._send_lock, "req", rid, (op, payload))
+            send_frame(self.sock, self._send_lock, "req", rid, (op, payload),
+                       epoch=self.epoch, faults=self.faults)
         except OSError:
             with self._plock:
                 self._pending.pop(rid, None)
@@ -241,6 +339,7 @@ class ShardClient:
         if not slot[0].wait(timeout):
             with self._plock:
                 self._pending.pop(rid, None)
+                self.deadline_exceeded += 1
             raise ShardUnavailable(
                 f"shard {self.shard_id} did not answer {op} within {timeout}s"
             )
@@ -248,16 +347,16 @@ class ShardClient:
             raise ShardUnavailable(f"shard {self.shard_id} died during {op}")
         ok, body = slot[1]
         if not ok:
-            raise RuntimeError(f"shard {self.shard_id} {op} failed: {body}")
+            _raise_shard_error(self.shard_id, op, body)
         return body
 
     def _read_loop(self) -> None:
         try:
             while True:
-                frame = read_frame(self._rfile)
+                frame = read_frame(self._rfile, self.faults)
                 if frame is None:
                     break
-                mtype, rid, body = frame
+                mtype, rid, body, _epoch = frame
                 if mtype == "res":
                     with self._plock:
                         slot = self._pending.pop(rid, None)
@@ -307,10 +406,510 @@ class ShardClient:
             pass
 
 
+class _Conn:
+    """One established TCP connection in a :class:`TcpShardClient` pool:
+    socket + its send lock + the reader thread bound to it."""
+
+    def __init__(self, shard_id: int, idx: int, sock: socket.socket):
+        self.idx = idx
+        self.sock = sock
+        self.send_lock = make_lock(f"shard.tcp.send.{shard_id}.{idx}")
+        self.reader: Optional[threading.Thread] = None
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@guard_attrs
+class TcpShardClient:
+    """Front-side handle for one shard over TCP — the cross-host fleet
+    transport. Same surface as :class:`ShardClient`, plus:
+
+    - a small **connection pool**: lane 0 is the primary (carries the
+      ordered ``evt`` stream and subscribes to the shard's ``push``
+      stream via a ``sub`` frame); extra lanes are parallel RPC lanes so
+      a slow scatter call cannot head-of-line-block its neighbors.
+    - a **reconnector** with jittered-exponential backoff (the PR 1
+      ``Backoff``): connection loss does NOT kill the handle. While the
+      primary lane is down the client reports ``alive=False`` — the
+      front degrades to fail-safe verdicts, exactly like a dead child —
+      and on re-establishment it fires ``on_up`` so the supervisor runs
+      the PR 9 resync (which first bumps the fencing epoch).
+    - **per-op deadlines** (``deadline_for``) and **epoch stamping** on
+      every outgoing frame; stale ``push`` frames from a
+      healed-but-not-yet-resynced worker are dropped, stale-epoch RPC
+      refusals surface as :class:`FencedError`.
+
+    The bounded send queue keeps the PR 1 watch-queue discipline: store
+    dispatch NEVER blocks on the network — overflow sheds pod upserts
+    (verdict-safe) and marks the shard dirty for the next resync.
+    """
+
+    transport = "tcp"
+    MAX_QUEUE = 65536
+    EVT_BATCH = 512
+
+    GUARDED_BY = {
+        "_queue": "self._qlock",
+        "_pending": "self._plock",
+        "_rid": "self._plock",
+        "_rr": "self._plock",
+        "deadline_exceeded": "self._plock",
+        "dropped": "self._qlock",
+        "dirty": "self._qlock",
+        "_conns": "self._clock",
+        "reconnects": "self._clock",
+        "partition_seconds": "self._clock",
+        "_down_since": "self._clock",
+    }
+
+    def __init__(
+        self,
+        shard_id: int,
+        host: str,
+        port: int,
+        on_push: Optional[Callable[[int, list], None]] = None,
+        on_down: Optional[Callable[[int], None]] = None,
+        on_up: Optional[Callable[[int], None]] = None,
+        faults=None,
+        maxsize: Optional[int] = None,
+        pool_size: int = 2,
+        default_deadline: float = 30.0,
+        deadlines: Optional[Dict[str, float]] = None,
+        connect_timeout: float = 5.0,
+        backoff_base: float = 0.05,
+        backoff_cap: float = 2.0,
+    ):
+        from ..client.transport import Backoff  # PR 1 jittered exponential
+
+        self.shard_id = shard_id
+        self.host = host
+        self.port = int(port)
+        self.on_push = on_push
+        self.on_down = on_down
+        self.on_up = on_up
+        self.faults = faults
+        self.maxsize = maxsize or self.MAX_QUEUE
+        self.pool_size = max(1, int(pool_size))
+        self.default_deadline = float(default_deadline)
+        self.deadlines: Dict[str, float] = dict(deadlines or {})
+        self.connect_timeout = connect_timeout
+        # the fencing epoch this front believes the shard is at.
+        # Single-writer: only bump_epoch (the resync path) advances it;
+        # sender/request threads read the int (atomic in CPython)
+        self.epoch = 1
+        self._backoff = Backoff(base=backoff_base, cap=backoff_cap)
+        self._qlock = make_lock(f"shard.tcp.queue.{shard_id}")
+        self._qcond = threading.Condition(self._qlock)
+        self._queue: "deque[Op]" = deque()
+        self._plock = make_lock(f"shard.tcp.pending.{shard_id}")
+        self._pending = {}  # rid -> [threading.Event, response|None, conn]
+        self._rid = 0
+        self._rr = 0  # round-robin cursor over live RPC lanes
+        self._clock = make_lock(f"shard.tcp.conns.{shard_id}")
+        self._ccond = threading.Condition(self._clock)
+        self._conns: List[Optional[_Conn]] = [None] * self.pool_size
+        self._alive = False  # primary lane state; flips in _set_primary
+        self._ever_up = False
+        self._closed = False
+        # single-writer stats; read by metrics at scrape
+        self.events_sent = 0
+        self.frames_sent = 0
+        self.dropped = 0
+        self.dirty = False
+        self.deadline_exceeded = 0
+        self.reconnects = 0  # primary-lane re-establishments after a drop
+        self.partition_seconds = 0.0  # cumulative primary-lane downtime
+        self.fenced_pushes = 0  # stale-epoch pushes dropped (reader thread)
+        self._down_since: Optional[float] = time.monotonic()
+        self._sender = threading.Thread(
+            target=self._send_loop, name=f"shard{shard_id}-tcp-send", daemon=True
+        )
+        self._maintainer = threading.Thread(
+            target=self._maintain_loop, name=f"shard{shard_id}-tcp-conn", daemon=True
+        )
+        self._sender.start()
+        self._maintainer.start()
+
+    # ------------------------------------------------------------ connection
+
+    def _open_conn(self, idx: int) -> _Conn:
+        """Dial one lane (NOT under any lock — connect blocks). Raises
+        OSError on failure; installs + returns the live conn."""
+        if self.faults is not None:
+            fault = self.faults.check("net.connect.refused")
+            if fault is not None:
+                raise ConnectionRefusedError(
+                    f"injected connect refusal (hit {fault.hit})"
+                )
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout
+        )
+        try:
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(self.shard_id, idx, sock)
+            if idx == 0:
+                # nominate this lane as the push stream (and teach the
+                # worker our current epoch before any RPC rides it).
+                # Faults apply here too: under net.partition the sub
+                # frame blackholes like any other send, so a partitioned
+                # client stays DOWN in backoff instead of flapping
+                # up-then-down once per establishment
+                send_frame(sock, conn.send_lock, "sub", 0, None,
+                           epoch=self.epoch, faults=self.faults)
+            if self.faults is not None:
+                fault = self.faults.check("net.reconnect.storm")
+                if fault is not None:
+                    raise OSError(
+                        f"injected reconnect storm (hit {fault.hit}): "
+                        "fresh connection killed"
+                    )
+        except BaseException:
+            sock.close()
+            raise
+        reader = threading.Thread(
+            target=self._read_conn, args=(conn,),
+            name=f"shard{self.shard_id}-tcp-read{idx}", daemon=True,
+        )
+        conn.reader = reader
+        with self._ccond:
+            self._conns[idx] = conn
+        reader.start()
+        return conn
+
+    def _maintain_loop(self) -> None:
+        # top-level routing (threads checker): the reconnector IS the
+        # heal path — if it died, a transient partition would be
+        # permanent while the front reports degraded forever
+        try:
+            while True:
+                with self._ccond:
+                    if self._closed:
+                        return
+                    missing = [
+                        i for i in range(self.pool_size) if self._conns[i] is None
+                    ]
+                    if not missing:
+                        self._ccond.wait(0.2)
+                        continue
+                primary_was_down = 0 in missing
+                opened_primary = False
+                failed = False
+                for idx in missing:
+                    if self._closed:
+                        return
+                    try:
+                        self._open_conn(idx)
+                        if idx == 0:
+                            opened_primary = True
+                    except OSError:
+                        failed = True
+                if opened_primary and primary_was_down:
+                    self._backoff.reset()
+                    self._set_primary_up()
+                if failed and not self._closed:
+                    delay = self._backoff.next()
+                    with self._ccond:
+                        if not self._closed:
+                            self._ccond.wait(delay)
+        except Exception:  # noqa: BLE001 — route the death, don't hide it
+            logger.exception("shard %d: tcp reconnector died", self.shard_id)
+
+    def _set_primary_up(self) -> None:
+        reconnected = False
+        with self._ccond:
+            if self._ever_up:
+                self.reconnects += 1
+                reconnected = True
+            if self._down_since is not None:
+                self.partition_seconds += time.monotonic() - self._down_since
+                self._down_since = None
+            self._ever_up = True
+            self._alive = True
+            self._ccond.notify_all()
+        with self._qcond:
+            self._qcond.notify_all()  # sender: the evt lane is back
+        logger.info(
+            "shard %d: tcp primary lane %s (%s:%d)",
+            self.shard_id, "reconnected" if reconnected else "connected",
+            self.host, self.port,
+        )
+        if reconnected and self.on_up is not None:
+            # the supervisor's heal path: bump the fencing epoch, then
+            # resync (replay + prune + re-push — no lost flips)
+            self.on_up(self.shard_id)
+
+    def _conn_dead(self, conn: _Conn) -> None:
+        """Tear down one lane; lane 0 dying marks the shard down."""
+        conn.close()
+        with self._ccond:
+            if self._conns[conn.idx] is not conn:
+                return  # already replaced
+            self._conns[conn.idx] = None
+            primary = conn.idx == 0
+            if primary:
+                was = self._alive
+                self._alive = False
+                if self._down_since is None:
+                    self._down_since = time.monotonic()
+            self._ccond.notify_all()
+        # fail only the RPCs that were in flight on THIS lane
+        stale = []
+        with self._plock:
+            for rid, slot in list(self._pending.items()):
+                if slot[2] is conn:
+                    stale.append(self._pending.pop(rid))
+        for slot in stale:
+            slot[0].set()
+        if primary:
+            with self._qcond:
+                self.dirty = True
+                self._qcond.notify_all()
+            if was and not self._closed and self.on_down is not None:
+                self.on_down(self.shard_id)
+
+    def _primary(self) -> Optional[_Conn]:
+        with self._ccond:
+            return self._conns[0]
+
+    def _pick_conn(self) -> Optional[_Conn]:
+        with self._ccond:
+            live = [c for c in self._conns if c is not None]
+        if not live:
+            return None
+        with self._plock:
+            self._rr += 1
+            return live[self._rr % len(live)]
+
+    # ------------------------------------------------------------- events
+
+    def enqueue_ops(self, ops: Sequence[Op]) -> None:
+        """Queue ops for the shard; never blocks — store dispatch must
+        not wait on the network (verdict-safe shed on overflow)."""
+        with self._qcond:
+            if self._closed:
+                return
+            for op in ops:
+                if len(self._queue) >= self.maxsize:
+                    idx = next(
+                        (i for i, q in enumerate(self._queue) if _sheddable(q)),
+                        None,
+                    )
+                    if idx is not None:
+                        del self._queue[idx]
+                        self.dropped += 1
+                        self.dirty = True
+                    elif _sheddable(op):
+                        self.dropped += 1
+                        self.dirty = True
+                        continue
+                self._queue.append(op)
+            self._qcond.notify()
+
+    def _send_loop(self) -> None:
+        # top-level routing (threads checker): sender death = down shard
+        try:
+            while True:
+                with self._qcond:
+                    while not self._queue and not self._closed:
+                        self._qcond.wait(0.2)
+                    if self._closed and not self._queue:
+                        return
+                conn = self._primary()
+                if conn is None:
+                    if self._closed:
+                        return
+                    # partitioned: hold the (bounded) queue; the shed +
+                    # dirty + resync-on-heal path repairs any overflow
+                    with self._ccond:
+                        if self._conns[0] is None and not self._closed:
+                            self._ccond.wait(0.2)
+                    continue
+                with self._qcond:
+                    batch = [
+                        self._queue.popleft()
+                        for _ in range(min(len(self._queue), self.EVT_BATCH))
+                    ]
+                if not batch:
+                    continue
+                try:
+                    if self.faults is not None:
+                        fault = self.faults.check("shard.ipc.send")
+                        if fault is not None:
+                            raise OSError(
+                                f"injected IPC send failure (hit {fault.hit})"
+                            )
+                    send_frame(conn.sock, conn.send_lock, "evt", 0, batch,
+                               epoch=self.epoch, faults=self.faults)
+                    self.events_sent += len(batch)
+                    self.frames_sent += 1
+                except OSError:
+                    # link gone mid-send: these events are lost — the
+                    # reconnect's resync (replay + prune) repairs the gap
+                    with self._qcond:
+                        self.dropped += len(batch)
+                        self.dirty = True
+                    self._conn_dead(conn)
+        except Exception:  # noqa: BLE001 — route the death, don't hide it
+            logger.exception("shard %d: tcp sender died", self.shard_id)
+            with self._qcond:
+                self.dirty = True
+
+    # ---------------------------------------------------------------- RPC
+
+    def deadline_for(self, op: str) -> float:
+        return self.deadlines.get(op, self.default_deadline)
+
+    def request(self, op: str, payload=None, timeout: Optional[float] = None):
+        """Blocking RPC with a per-op deadline; raises
+        :class:`ShardUnavailable` when the link is down or the deadline
+        passes, :class:`FencedError` on a stale-epoch refusal."""
+        if timeout is None:
+            timeout = self.deadline_for(op)
+        if not self.alive:
+            raise ShardUnavailable(
+                f"shard {self.shard_id} is unreachable ({self.host}:{self.port})"
+            )
+        conn = self._pick_conn()
+        if conn is None:
+            raise ShardUnavailable(
+                f"shard {self.shard_id} has no live connection"
+            )
+        with self._plock:
+            self._rid += 1
+            rid = self._rid
+            slot = [threading.Event(), None, conn]
+            self._pending[rid] = slot
+        try:
+            send_frame(conn.sock, conn.send_lock, "req", rid, (op, payload),
+                       epoch=self.epoch, faults=self.faults)
+        except OSError:
+            with self._plock:
+                self._pending.pop(rid, None)
+            self._conn_dead(conn)
+            raise ShardUnavailable(
+                f"shard {self.shard_id} send failed ({self.host}:{self.port})"
+            ) from None
+        if not slot[0].wait(timeout):
+            with self._plock:
+                self._pending.pop(rid, None)
+                self.deadline_exceeded += 1
+            raise ShardUnavailable(
+                f"shard {self.shard_id} did not answer {op} within {timeout}s"
+            )
+        if slot[1] is None:
+            raise ShardUnavailable(
+                f"shard {self.shard_id} connection died during {op}"
+            )
+        ok, body = slot[1]
+        if not ok:
+            _raise_shard_error(self.shard_id, op, body)
+        return body
+
+    def _read_conn(self, conn: _Conn) -> None:
+        rfile = conn.sock.makefile("rb")
+        try:
+            while True:
+                frame = read_frame(rfile, self.faults)
+                if frame is None:
+                    break
+                mtype, rid, body, epoch = frame
+                if mtype == "res":
+                    with self._plock:
+                        slot = self._pending.pop(rid, None)
+                    if slot is not None:
+                        slot[1] = body
+                        slot[0].set()
+                elif mtype == "push":
+                    if epoch < self.epoch:
+                        # a healed worker replaying its pre-partition view:
+                        # fenced — the resync re-push will carry the truth
+                        self.fenced_pushes += 1
+                    elif self.on_push is not None:
+                        self.on_push(self.shard_id, body)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            pass
+        except Exception:  # noqa: BLE001 — route the death, don't hide it
+            logger.exception("shard %d: tcp reader died", self.shard_id)
+        finally:
+            try:
+                rfile.close()
+            except OSError:
+                pass
+            self._conn_dead(conn)
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def alive(self) -> bool:
+        return self._alive and not self._closed
+
+    def bump_epoch(self) -> int:
+        """Advance the fencing epoch (resync head): frames stamped with
+        the previous epoch — from a partitioned peer or a stale kernel
+        buffer — are refused from here on."""
+        self.epoch += 1
+        return self.epoch
+
+    def is_dirty(self) -> bool:
+        with self._qcond:
+            return self.dirty
+
+    def mark_dirty(self) -> None:
+        with self._qcond:
+            self.dirty = True
+
+    def clear_dirty(self) -> None:
+        with self._qcond:
+            self.dirty = False
+
+    def pending_events(self) -> int:
+        with self._qcond:
+            return len(self._queue)
+
+    def outage_seconds(self) -> float:
+        """Cumulative primary-lane downtime, including the current
+        outage if one is in progress (the partition_seconds metric)."""
+        with self._ccond:
+            total = self.partition_seconds
+            if self._down_since is not None:
+                total += time.monotonic() - self._down_since
+            return total
+
+    def close(self) -> None:
+        self._closed = True
+        with self._qcond:
+            self._qcond.notify_all()
+        with self._ccond:
+            conns = [c for c in self._conns if c is not None]
+            self._conns = [None] * self.pool_size
+            self._alive = False
+            self._ccond.notify_all()
+        for conn in conns:
+            conn.close()
+        with self._plock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for slot in pending:
+            slot[0].set()
+        self._maintainer.join(timeout=2.0)
+        self._sender.join(timeout=2.0)
+
+
 class LocalShard:
     """In-process shard handle for deterministic tests: wraps a
     :class:`worker.ShardCore` directly — same surface as
     :class:`ShardClient`, no sockets, events applied synchronously."""
+
+    transport = "local"
 
     def __init__(self, shard_id: int, core, on_push=None):
         self.shard_id = shard_id
@@ -320,6 +919,9 @@ class LocalShard:
         self.dropped = 0
         self.dirty = False
         self.alive = True
+        self.epoch = 0
+        self.deadline_exceeded = 0
+        self.reconnects = 0
         if on_push is not None:
             core.push = lambda items: on_push(shard_id, items)
 
@@ -344,12 +946,15 @@ class LocalShard:
     def clear_dirty(self) -> None:
         self.dirty = False
 
-    def request(self, op: str, payload=None, timeout: float = 30.0):
+    def deadline_for(self, op: str) -> float:
+        return 30.0
+
+    def request(self, op: str, payload=None, timeout: Optional[float] = None):
         if not self.alive:
             raise ShardUnavailable(f"shard {self.shard_id} is down")
         ok, body = self.core.rpc(op, payload)
         if not ok:
-            raise RuntimeError(f"shard {self.shard_id} {op} failed: {body}")
+            _raise_shard_error(self.shard_id, op, body)
         return body
 
     def close(self) -> None:
@@ -359,7 +964,9 @@ class LocalShard:
 __all__ = [
     "Op",
     "ShardClient",
+    "TcpShardClient",
     "ShardUnavailable",
+    "FencedError",
     "LocalShard",
     "send_frame",
     "read_frame",

@@ -7,6 +7,23 @@ case the kill-a-shard smoke drives, and the monitor turns it into:
 mark down (front degrades fail-safe) → respawn → full resync from the
 front's merged store (replay + prune) → shard recomputes and re-pushes
 every status (no lost flips).
+
+Fleet modes (ROADMAP 2(b), "from one wide host to a fleet"):
+
+- ``transport="tcp"`` — children still spawn locally but serve the
+  framed protocol over TCP (``--listen 127.0.0.1:0``; the bound port
+  rendezvous is an atomically-written ``--port-file``, race-free even
+  with ephemeral ports). The front talks :class:`~.ipc.TcpShardClient`.
+- ``remote_workers={sid: "host:port"}`` — those shards are NOT spawned:
+  somebody else runs them (another host, a StatefulSet pod). The
+  supervisor only dials them; there is no process to babysit.
+
+The monitor distinguishes **process died** (``proc.poll()`` — respawn +
+resync) from **connection lost** (``on_down`` while the process is
+alive, or any remote worker): the TCP client reconnects on its own with
+jittered-exponential backoff and the heal path (``on_up``) runs the
+epoch-bump + resync — a transient partition never triggers a spurious
+local restart.
 """
 
 from __future__ import annotations
@@ -16,13 +33,14 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from typing import Dict, List, Optional
 
 from ..utils.lockorder import guard_attrs, make_lock
 from .front import AdmissionFront
-from .ipc import ShardClient
+from .ipc import ShardClient, TcpShardClient
 
 logger = logging.getLogger(__name__)
 
@@ -37,6 +55,7 @@ class ShardSupervisor:
     GUARDED_BY = {
         "procs": "self._proc_lock",
         "restarts": "self._proc_lock",
+        "conn_lost": "self._proc_lock",
     }
 
     def __init__(
@@ -52,7 +71,11 @@ class ShardSupervisor:
         worker_args: Optional[List[str]] = None,
         per_shard_args: Optional[Dict[int, List[str]]] = None,
         env: Optional[dict] = None,
+        transport: str = "socketpair",
+        remote_workers: Optional[Dict[int, str]] = None,
     ):
+        if transport not in ("socketpair", "tcp"):
+            raise ValueError(f"unknown shard transport {transport!r}")
         self.front = front
         self.n_shards = front.n_shards
         self.name = name
@@ -67,9 +90,16 @@ class ShardSupervisor:
         # (chaos rules that must not re-arm on a monitor respawn)
         self.per_shard_args: Dict[int, List[str]] = dict(per_shard_args or {})
         self.env = env
+        self.transport = transport
+        # shards somebody else runs (cross-host fleet): dialed, never
+        # spawned, never restarted — their heal path is reconnect+resync
+        self.remote_workers: Dict[int, str] = dict(remote_workers or {})
+        self._rendezvous_dir: Optional[str] = None
+        self._port_seq = 0
         self._proc_lock = make_lock("shard.supervisor.procs")
         self.procs: Dict[int, subprocess.Popen] = {}
         self.restarts: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
+        self.conn_lost: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         # one rescale at a time: concurrent callers fail fast (two ring
@@ -79,34 +109,127 @@ class ShardSupervisor:
 
     # ------------------------------------------------------------- spawning
 
-    def _spawn(
+    def _base_argv(self, shard_id: int) -> List[str]:
+        argv = [
+            sys.executable, "-m", "kube_throttler_tpu.sharding.worker",
+            "--shard-id", str(shard_id),
+            "--shards", str(self.n_shards),
+            "--name", self.name,
+            "--target-scheduler-name", self.target_scheduler,
+            "--ingest-batch", str(self.ingest_batch),
+        ]
+        if not self.use_device:
+            argv.append("--no-device")
+        if self.data_dir:
+            argv += ["--data-dir", os.path.join(self.data_dir, f"shard-{shard_id}")]
+        return argv
+
+    def _extra_argv(self, shard_id: int, extra_args: Optional[List[str]]) -> List[str]:
+        argv = list(self.worker_args)
+        # one-shot args (a chaos rule armed for THIS incarnation only:
+        # a monitor respawn after the armed kill must come up clean,
+        # not re-arm the same crash forever)
+        if extra_args is None:
+            extra_args = self.per_shard_args.pop(shard_id, None)
+        if extra_args:
+            argv += list(extra_args)
+        return argv
+
+    def _child_env(self) -> dict:
+        env = dict(os.environ if self.env is None else self.env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def _tcp_client(self, shard_id: int, host: str, port: int) -> TcpShardClient:
+        return TcpShardClient(
+            shard_id,
+            host,
+            port,
+            on_push=self.front.apply_status_push,
+            on_down=self._on_shard_down,
+            on_up=self._on_shard_up,
+            faults=self.front.faults,
+            default_deadline=self.front.rpc_deadline,
+            deadlines=self.front.rpc_deadlines,
+        )
+
+    def _attach_remote(self, shard_id: int) -> None:
+        """Dial a worker somebody else runs (``remote_workers``): no
+        process, no restarts — connection loss is the client's problem
+        (backoff + reconnect + resync), never the monitor's."""
+        host, _, port = self.remote_workers[shard_id].rpartition(":")
+        client = self._tcp_client(shard_id, host or "127.0.0.1", int(port))
+        self.front.attach_shard(shard_id, client)
+        return None
+
+    def _spawn_tcp(
         self, shard_id: int, extra_args: Optional[List[str]] = None
     ) -> subprocess.Popen:
+        """Spawn a local child serving TCP (``--listen 127.0.0.1:0``) and
+        dial it. The kernel picks the port; the child publishes it via an
+        atomically-renamed port file — no parse-the-stdout races."""
+        if self._rendezvous_dir is None:
+            self._rendezvous_dir = tempfile.mkdtemp(prefix="kt-shard-ports-")
+        self._port_seq += 1
+        port_file = os.path.join(
+            self._rendezvous_dir, f"shard-{shard_id}.{self._port_seq}.port"
+        )
+        argv = self._base_argv(shard_id) + [
+            "--listen", "127.0.0.1:0",
+            "--port-file", port_file,
+        ] + self._extra_argv(shard_id, extra_args)
+        proc = subprocess.Popen(
+            argv,
+            env=self._child_env(),
+            stdout=subprocess.DEVNULL if self._child_env().get("KT_SHARD_QUIET") else None,
+            stderr=None,
+        )
+        try:
+            hostport = self._await_port_file(port_file, proc, timeout=120.0)
+            host, _, port = hostport.rpartition(":")
+            client = self._tcp_client(shard_id, host, int(port))
+        except BaseException:
+            proc.kill()
+            raise
+        with self._proc_lock:
+            self.procs[shard_id] = proc
+        self.front.attach_shard(shard_id, client)
+        return proc
+
+    @staticmethod
+    def _await_port_file(path: str, proc: subprocess.Popen, timeout: float) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    text = fh.read().strip()
+                if text:
+                    return text
+            except OSError:
+                pass
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker exited rc={proc.returncode} before publishing "
+                    "its port"
+                )
+            time.sleep(0.05)
+        raise RuntimeError(f"no port file at {path} within {timeout}s")
+
+    def _spawn(
+        self, shard_id: int, extra_args: Optional[List[str]] = None
+    ) -> Optional[subprocess.Popen]:
+        if shard_id in self.remote_workers:
+            return self._attach_remote(shard_id)
+        if self.transport == "tcp":
+            return self._spawn_tcp(shard_id, extra_args)
         parent_sock, child_sock = socket.socketpair()
         try:
-            argv = [
-                sys.executable, "-m", "kube_throttler_tpu.sharding.worker",
-                "--shard-id", str(shard_id),
-                "--shards", str(self.n_shards),
-                "--ipc-fd", str(child_sock.fileno()),
-                "--name", self.name,
-                "--target-scheduler-name", self.target_scheduler,
-                "--ingest-batch", str(self.ingest_batch),
-            ]
-            if not self.use_device:
-                argv.append("--no-device")
-            if self.data_dir:
-                argv += ["--data-dir", os.path.join(self.data_dir, f"shard-{shard_id}")]
-            argv += self.worker_args
-            # one-shot args (a chaos rule armed for THIS incarnation only:
-            # a monitor respawn after the armed kill must come up clean,
-            # not re-arm the same crash forever)
-            if extra_args is None:
-                extra_args = self.per_shard_args.pop(shard_id, None)
-            if extra_args:
-                argv += list(extra_args)
-            env = dict(os.environ if self.env is None else self.env)
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            argv = (
+                self._base_argv(shard_id)
+                + ["--ipc-fd", str(child_sock.fileno())]
+                + self._extra_argv(shard_id, extra_args)
+            )
+            env = self._child_env()
             proc = subprocess.Popen(
                 argv,
                 pass_fds=[child_sock.fileno()],
@@ -121,6 +244,8 @@ class ShardSupervisor:
                 on_push=self.front.apply_status_push,
                 on_down=self._on_shard_down,
                 faults=self.front.faults,
+                default_deadline=self.front.rpc_deadline,
+                deadlines=self.front.rpc_deadlines,
             )
         except BaseException:
             # a failed exec (or client construction) must not leak the
@@ -150,7 +275,7 @@ class ShardSupervisor:
                         raise RuntimeError(
                             f"shard {sid} did not become ready in {ready_timeout}s"
                         ) from None
-                    if spawned[sid].poll() is not None:
+                    if spawned[sid] is not None and spawned[sid].poll() is not None:
                         raise RuntimeError(
                             f"shard {sid} exited rc={spawned[sid].returncode} "
                             "during startup"
@@ -164,7 +289,44 @@ class ShardSupervisor:
     # ------------------------------------------------------------ monitoring
 
     def _on_shard_down(self, shard_id: int) -> None:
+        with self._proc_lock:
+            proc = self.procs.get(shard_id)
+        if shard_id in self.remote_workers or (
+            proc is not None and proc.poll() is None
+        ):
+            # CONNECTION lost, not a process death: the TCP client is
+            # already backing off toward a reconnect, and the heal path
+            # (on_up → epoch bump + resync) repairs state. The monitor
+            # keys restarts on proc.poll() alone, so a transient
+            # partition never triggers a spurious local restart
+            with self._proc_lock:
+                self.conn_lost[shard_id] = self.conn_lost.get(shard_id, 0) + 1
+            logger.warning(
+                "shard %d connection lost (worker alive; reconnecting)",
+                shard_id,
+            )
+            return
         logger.warning("shard %d transport down", shard_id)
+
+    def _on_shard_up(self, shard_id: int) -> None:
+        """TCP heal path: the client reconnected on its own (the worker
+        never died, it was partitioned). Epoch-bump + full resync — the
+        PR 9 no-lost-flips repair, fenced against the stale past."""
+        logger.info("shard %d reconnected; resyncing", shard_id)
+        try:
+            self.front.resync_shard(shard_id)
+        except Exception:  # noqa: BLE001 — the reconnector must survive
+            logger.exception("shard %d post-reconnect resync failed", shard_id)
+            handle = self.front.shards.get(shard_id)
+            if handle is not None:
+                handle.mark_dirty()
+
+    def connection_losses(self) -> Dict[int, int]:
+        """Copy of the per-shard connection-loss counters (the monitor's
+        'connection lost ≠ process died' evidence; tests/scenarios poll
+        this next to restart_counts)."""
+        with self._proc_lock:
+            return dict(self.conn_lost)
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(0.2):
@@ -229,7 +391,7 @@ class ShardSupervisor:
 
     # ------------------------------------------------------ live resharding
 
-    def _wait_ready(self, sid: int, proc: subprocess.Popen,
+    def _wait_ready(self, sid: int, proc: Optional[subprocess.Popen],
                     ready_timeout: float) -> None:
         deadline = time.monotonic() + ready_timeout
         while True:
@@ -241,7 +403,7 @@ class ShardSupervisor:
                     raise RuntimeError(
                         f"shard {sid} did not become ready in {ready_timeout}s"
                     ) from None
-                if proc.poll() is not None:
+                if proc is not None and proc.poll() is not None:
                     raise RuntimeError(
                         f"shard {sid} exited rc={proc.returncode} during startup"
                     ) from None

@@ -15,7 +15,15 @@ Run as a process:
         --shard-id 0 --shards 4 --ipc-fd 3 [--data-dir DIR] [--no-device]
 
 The supervisor passes the socketpair fd; everything else arrives over
-the socket (events to ingest, RPCs to answer).
+the socket (events to ingest, RPCs to answer). A cross-host fleet
+worker listens instead of inheriting:
+
+    python -m kube_throttler_tpu.sharding.worker \
+        --shard-id 0 --shards 4 --listen 0.0.0.0:7781 [--port-file F]
+
+and serves the SAME framed protocol over TCP (``serve_tcp``): each
+accepted connection is one front lane; frames carry the fencing epoch
+so a partitioned-then-healed peer is fenced, not trusted.
 
 Two-phase reserve, shard side: ``reserve_prepare`` performs the real
 reserve on this shard's matching throttles and parks the transaction in
@@ -81,6 +89,9 @@ class ShardCore:
         "reshard_aborts": "self._txn_lock",
         "reaped_handoffs": "self._txn_lock",
         "_push_buf": "self._push_lock",
+        "wire_epoch": "self._epoch_lock",
+        "fenced_events": "self._epoch_lock",
+        "fenced_reqs": "self._epoch_lock",
     }
 
     def __init__(
@@ -191,6 +202,14 @@ class ShardCore:
         self._push_lock = make_lock(f"shard.push.{shard_id}")
         self._push_cond = threading.Condition(self._push_lock)
         self._push_buf: List[Tuple[str, object]] = []
+        # wire fencing (sharding/ipc.py): the max fencing epoch seen on
+        # ANY connection. The front bumps its counter at the head of
+        # every resync, so a frame stamped below this watermark is from
+        # before a heal/reshard — fenced, not trusted
+        self._epoch_lock = make_lock(f"shard.wire_epoch.{shard_id}")
+        self.wire_epoch = 0
+        self.fenced_events = 0  # stale-epoch evt ops dropped
+        self.fenced_reqs = 0  # stale-epoch RPCs refused (the wire 409)
         self._stop = threading.Event()
         for kind in ("Throttle", "ClusterThrottle"):
             self.store.add_event_handler(kind, self._on_status_event, replay=False)
@@ -256,6 +275,27 @@ class ShardCore:
             except Exception:  # noqa: BLE001 — keep the pusher alive
                 logger.exception("shard %d: push loop error", self.shard_id)
                 self._stop.wait(0.05)
+
+    # ---------------------------------------------------------------- fencing
+
+    def observe_epoch(self, epoch: int, mtype: str = "req", n: int = 1) -> bool:
+        """Track the max fencing epoch seen on the wire; ``False`` means
+        the frame is from the PAST — a partitioned-then-healed peer (or
+        bytes that sat in a kernel buffer across a heal) replaying a view
+        that missed a resync/reshard — and must be fenced, not trusted."""
+        with self._epoch_lock:
+            if epoch >= self.wire_epoch:
+                self.wire_epoch = epoch
+                return True
+            if mtype == "evt":
+                self.fenced_events += n
+            else:
+                self.fenced_reqs += 1
+            return False
+
+    def current_epoch(self) -> int:
+        with self._epoch_lock:
+            return self.wire_epoch
 
     # ---------------------------------------------------------------- events
 
@@ -490,7 +530,13 @@ class ShardCore:
             "reaped_txns": reaped,
             "pending_txns": pending,
             "epoch": self.epoch.current() if self.epoch is not None else 0,
+            "wire_epoch": self.current_epoch(),
+            "fenced_frames": self._fenced_counts(),
         }
+
+    def _fenced_counts(self) -> Dict[str, int]:
+        with self._epoch_lock:
+            return {"events": self.fenced_events, "reqs": self.fenced_reqs}
 
     def _rpc_drain(self, payload):
         timeout = float(payload.get("timeout", 5.0)) if payload else 5.0
@@ -939,42 +985,106 @@ class ShardCore:
             self.journal.close()
 
 
-def serve(core: ShardCore, sock: socket.socket) -> None:
+def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
     """The worker's IPC loop: read frames until EOF. Events apply via the
     ingest pipeline (non-blocking); RPCs answer from a small pool so a
-    long batch call cannot park the event stream."""
+    long batch call cannot park the event stream.
+
+    Over TCP every accepted connection runs its own ``serve()`` against
+    the shared core (``bind_push=False``): the client's primary lane
+    subscribes to the push stream with a ``sub`` frame, extra lanes are
+    parallel RPC lanes. Responses and pushes are stamped with the max
+    fencing epoch the core has seen; stale-epoch frames are fenced —
+    ``evt`` batches dropped, ``req`` refused with a ``FencedError`` body
+    (the wire-level 409)."""
     from concurrent.futures import ThreadPoolExecutor
 
     from .ipc import read_frame, send_frame
 
     send_lock = make_lock(f"shard.serve.{core.shard_id}")
-    core.push = lambda items: send_frame(sock, send_lock, "push", 0, items)
+
+    def push(items) -> None:
+        send_frame(sock, send_lock, "push", 0, items,
+                   epoch=core.current_epoch(), faults=core.faults)
+
+    if bind_push:
+        core.push = push
     pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="shard-rpc")
     rfile = sock.makefile("rb")
 
     def answer(rid: int, op: str, payload) -> None:
         result = core.rpc(op, payload)
         try:
-            send_frame(sock, send_lock, "res", rid, result)
+            send_frame(sock, send_lock, "res", rid, result,
+                       epoch=core.current_epoch(), faults=core.faults)
         except OSError:
             pass  # front went away; the supervisor restarts us if needed
 
+    def refuse(rid: int, stale_epoch: int) -> None:
+        body = (
+            False,
+            f"FencedError: stale epoch {stale_epoch} < {core.current_epoch()}",
+        )
+        try:
+            send_frame(sock, send_lock, "res", rid, body,
+                       epoch=core.current_epoch(), faults=core.faults)
+        except OSError:
+            pass
+
     try:
         while True:
-            frame = read_frame(rfile)
+            frame = read_frame(rfile, core.faults)
             if frame is None:
                 return
-            mtype, rid, body = frame
+            mtype, rid, body, epoch = frame
             if mtype == "evt":
+                if not core.observe_epoch(epoch, "evt", len(body)):
+                    continue  # a stale peer's events must not touch state
                 core.handle_events(body)
             elif mtype == "req":
+                if not core.observe_epoch(epoch):
+                    pool.submit(refuse, rid, epoch)
+                    continue
                 op, payload = body
                 pool.submit(answer, rid, op, payload)
+            elif mtype == "sub":
+                core.observe_epoch(epoch, "sub")
+                core.push = push
     except OSError:
         return
     finally:
         pool.shutdown(wait=False)
         rfile.close()
+
+
+def serve_tcp(core: ShardCore, srv: socket.socket) -> None:
+    """The worker's TCP accept loop (``--listen``): each accepted
+    connection is one front lane served by :func:`serve` against the
+    shared core. Returns when the listener socket is closed."""
+
+    def lane(conn: socket.socket, peer) -> None:
+        try:
+            serve(core, conn, bind_push=False)
+        except Exception:  # noqa: BLE001 — route the death, don't hide it
+            logger.exception(
+                "shard %d: connection from %s died", core.shard_id, peer
+            )
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    while True:
+        try:
+            conn, peer = srv.accept()
+        except OSError:
+            return  # listener closed: shutdown
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        threading.Thread(
+            target=lane, args=(conn, peer),
+            name=f"shard{core.shard_id}-conn", daemon=True,
+        ).start()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -984,7 +1094,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="kube-throttler-shard")
     parser.add_argument("--shard-id", type=int, required=True)
     parser.add_argument("--shards", type=int, required=True)
-    parser.add_argument("--ipc-fd", type=int, required=True)
+    parser.add_argument(
+        "--ipc-fd", type=int, default=None,
+        help="inherited socketpair fd (supervisor child mode)",
+    )
+    parser.add_argument(
+        "--listen", default="",
+        help="serve the framed shard protocol over TCP on host:port "
+        "(port 0 = ephemeral) instead of an inherited fd — the "
+        "cross-host fleet worker mode",
+    )
+    parser.add_argument(
+        "--port-file", default="",
+        help="with --listen: atomically write the bound host:port here "
+        "once listening (the spawner's rendezvous, race-free even with "
+        "an ephemeral port)",
+    )
     parser.add_argument("--name", default="kube-throttler")
     parser.add_argument("--target-scheduler-name", default="my-scheduler")
     parser.add_argument("--data-dir", default="")
@@ -998,6 +1123,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "harness's kill/err injection, e.g. shard.worker.kill:kill:25",
     )
     args = parser.parse_args(argv)
+    if bool(args.listen) == (args.ipc_fd is not None):
+        parser.error("exactly one of --ipc-fd and --listen is required")
 
     logging.basicConfig(
         level=logging.INFO,
@@ -1028,6 +1155,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         faults=faults,
         prepare_ttl=args.prepare_ttl,
     )
+    if args.listen:
+        host, _, port = args.listen.rpartition(":")
+        srv = socket.create_server((host or "127.0.0.1", int(port)))
+        bound_host, bound_port = srv.getsockname()[:2]
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(f"{bound_host}:{bound_port}\n")
+            os.replace(tmp, args.port_file)
+        print(
+            f"shard {args.shard_id}/{args.shards} listening on "
+            f"{bound_host}:{bound_port}",
+            flush=True,
+        )
+        try:
+            serve_tcp(core, srv)
+        finally:
+            core.stop()
+            srv.close()
+        return 0
     sock = socket.socket(fileno=args.ipc_fd)
     print(f"shard {args.shard_id}/{args.shards} ready", flush=True)
     try:

@@ -161,10 +161,10 @@ class TestFraming:
         try:
             lock = threading.Lock()
             pod = make_pod("p", labels={"x": "y"}, requests={"cpu": "1"})
-            send_frame(a, lock, "evt", 7, [("upsert", "Pod", pod)])
+            send_frame(a, lock, "evt", 7, [("upsert", "Pod", pod)], epoch=3)
             rfile = b.makefile("rb")
-            mtype, rid, body = read_frame(rfile)
-            assert (mtype, rid) == ("evt", 7)
+            mtype, rid, body, epoch = read_frame(rfile)
+            assert (mtype, rid, epoch) == ("evt", 7, 3)
             verb, kind, got = body[0]
             assert (verb, kind, got.key, got.labels) == (
                 "upsert", "Pod", pod.key, {"x": "y"},

@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Network-fault chaos matrix for the cross-host TCP shard transport.
+
+Every ``net.*`` framing-layer fault site (faults/plan.py), armed
+client-side against one shard of a LIVE 2-worker ``transport="tcp"``
+fleet (real processes dialed over loopback — the same stack
+``--shard-transport tcp`` serves), x 3 seeds:
+
+    net.connect.refused:error   dials refused during reconnect; backoff
+                                must retry through to the heal
+    net.send.torn_frame:torn    a frame tears mid-write; the worker sees
+                                a short read and drops the lane cleanly
+    net.recv.stall:delay        the client's reader stalls mid-frame;
+                                RPCs ride the per-op deadline, not hang
+    net.partition:error         sends blackhole (asymmetric partition);
+                                degraded fail-safe verdicts, then heal ⇒
+                                epoch-bumped resync + re-push
+    net.reconnect.storm:error   every fresh connection dies at birth;
+                                jittered backoff must converge anyway
+
+While the fault is live the driver keeps churning pod events across
+flip thresholds, scattering ``pre_filter`` RPCs, and running
+reserve/unreserve two-phase transactions. After the heal the matrix
+asserts the full recovery contract:
+
+- the armed site actually FIRED (an unfired rule is a vacuous pass);
+- every shard reconnected and reports ``ok`` (no supervisor restart —
+  transient network loss must not look like process death);
+- ZERO wrong verdicts vs a single-process oracle rebuilt from the final
+  state (code + normalized reasons);
+- ZERO lost flips: every published ``status.throttled`` equals the
+  oracle's recompute;
+- ZERO orphan reservations: every worker's ``reshard_audit`` is clean —
+  a reserve whose prepare outran the deadline must have been aborted on
+  every target, not stranded.
+
+Run: ``python tools/netchaostest.py matrix`` (``make net-chaos``); the
+tier-1 smoke (tests/test_net_transport.py) runs one case small.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+SEEDS = (0, 1, 2)
+
+# site → (mode, rule kwargs): windowless rules stay finite so the fleet
+# always heals inside the case budget (an unbounded blackhole would gate
+# the harness's patience, not the code)
+CASES = (
+    ("net.connect.refused", "error", {"times": 2}),
+    ("net.send.torn_frame", "torn", {"times": 2}),
+    ("net.recv.stall", "delay", {"times": 3, "delay": 0.5}),
+    ("net.partition", "error", {"times": 6}),
+    ("net.reconnect.storm", "error", {"times": 2}),
+)
+
+# these sites only fire while (re)connecting — pair them with one torn
+# frame so the established lane actually drops and the dial path runs
+_NEEDS_SEVER = ("net.connect.refused", "net.reconnect.storm")
+
+
+def build_fleet(n_shards=2, n_throttles=24, n_pods=160, n_reserved=8,
+                rpc_deadline=10.0):
+    import tools.harness as H
+    from kube_throttler_tpu.api.pod import Namespace, make_pod
+    from kube_throttler_tpu.sharding.front import AdmissionFront
+    from kube_throttler_tpu.sharding.supervisor import ShardSupervisor
+
+    front = AdmissionFront(n_shards, rpc_deadline=rpc_deadline)
+    supervisor = ShardSupervisor(
+        front,
+        transport="tcp",
+        use_device=False,
+        restart_backoff=0.3,
+        env={**os.environ, "KT_SHARD_QUIET": "1", "KT_LOCK_ASSERT": "0"},
+    )
+    supervisor.start(ready_timeout=300.0)
+    try:
+        front.store.create_namespace(Namespace("default"))
+        for i in range(n_throttles):
+            front.store.create_throttle(H.make_throttle(i))
+        pods = []
+        for i in range(n_pods):
+            pod = make_pod(
+                f"p{i}", labels={"grp": f"g{i % n_throttles}"},
+                requests={"cpu": "100m"},
+            )
+            front.store.create_pod(pod)
+            pods.append(pod)
+        assert front.drain(120.0)
+        time.sleep(0.3)
+        # live reservations make the orphan audit meaningful: a two-phase
+        # txn stranded by a mid-prepare fault would show up against these
+        for pod in pods[:n_reserved]:
+            status = front.reserve(pod)
+            assert status.is_success(), status.reasons
+    except BaseException:
+        supervisor.stop()
+        front.stop()
+        raise
+    return front, supervisor, pods
+
+
+def churn(front, pods, rounds=6, per_round=60):
+    """Pod-update churn that swings group sums across flip thresholds
+    while the fault is live; interleaves scatter RPCs and two-phase
+    reserve/unreserve so every transport path sees the fault. Degraded
+    verdicts DURING the storm are fine (fail-safe by design) — only the
+    post-heal equality gates count."""
+    from kube_throttler_tpu.api.pod import make_pod
+
+    for r in range(rounds):
+        cpu = "450m" if r % 2 == 0 else "50m"
+        for i in range(min(per_round, len(pods))):
+            pod = pods[i]
+            front.store.update_pod(
+                make_pod(pod.name, labels=dict(pod.labels),
+                         requests={"cpu": cpu})
+            )
+        probe = pods[(r * 7) % len(pods)]
+        try:
+            front.pre_filter(probe)
+        except Exception:  # noqa: BLE001 — storm-time refusal is the point
+            pass
+        victim = pods[-1 - (r % 8)]
+        try:
+            st = front.reserve(victim)
+            if st.is_success():
+                front.unreserve(victim)
+        except Exception:  # noqa: BLE001 — storm-time refusal is the point
+            pass
+        time.sleep(0.25)
+
+
+def final_state(front):
+    """Oracle rebuild: (wrong verdicts, lost flips) vs the final state."""
+    import tools.harness as H
+    from kube_throttler_tpu.api.pod import Namespace
+    from kube_throttler_tpu.engine.store import Store
+
+    store = Store()
+    store.create_namespace(Namespace("default"))
+    for thr in front.store.list_throttles():
+        store.create_throttle(thr)
+    for pod in front.store.list_pods():
+        store.create_pod(pod)
+    oracle = H.build_plugin(store)
+    oracle.run_pending_once()
+    wrong = []
+    for pod in store.list_pods():
+        got = front.pre_filter(pod)
+        want = oracle.pre_filter(pod)
+        if got.code != want.code or H.normalized_reasons(
+            got.reasons
+        ) != H.normalized_reasons(want.reasons):
+            wrong.append(pod.key)
+    by_key = {t.key: t for t in store.list_throttles()}
+    stale = [
+        thr.key
+        for thr in front.store.list_throttles()
+        if (w := by_key.get(thr.key)) is not None
+        and thr.status.throttled != w.status.throttled
+    ]
+    oracle.stop()
+    return wrong, stale
+
+
+def audit_all(front):
+    bad = []
+    for sid in range(front.n_shards):
+        handle = front.shards.get(sid)
+        if handle is None or not handle.alive:
+            bad.append(f"shard-{sid}: down")
+            continue
+        a = handle.request("reshard_audit", None, timeout=30.0)
+        if a["orphan_reservations"]:
+            bad.append(f"shard-{sid}: orphans {a['orphan_reservations']}")
+        if a["pending_handoffs"]:
+            bad.append(f"shard-{sid}: pending handoffs")
+        if a["fenced_handoffs"]:
+            bad.append(f"shard-{sid}: fences {a['fenced_handoffs']}")
+    return bad
+
+
+def run_case(site, mode, seed, rule_kwargs=None, n_pods=160, rounds=6,
+             recovery_s=30.0):
+    from kube_throttler_tpu.faults.plan import FaultPlan
+
+    rule_kwargs = dict(rule_kwargs or {})
+    front, supervisor, pods = build_fleet(n_pods=n_pods)
+    result = {"case": f"{site}:{mode}", "seed": seed}
+    try:
+        target_sid = 1
+        handle = front.shards[target_sid]
+        plan = FaultPlan(seed=seed).rule(site, mode=mode, **rule_kwargs)
+        if site in _NEEDS_SEVER:
+            plan.rule("net.send.torn_frame", mode="torn", times=1)
+        handle.faults = plan
+
+        churn(front, pods, rounds=rounds)
+
+        # heal: the plan runs dry (finite times), the client reconnects,
+        # the supervisor resyncs — every shard must come back ok with NO
+        # process restart (network loss is not process death)
+        restarts_before = dict(supervisor.restart_counts())
+        deadline = time.monotonic() + recovery_s
+        recovered = False
+        while time.monotonic() < deadline:
+            state, _ = front._shards_health()
+            if state == "ok":
+                recovered = True
+                break
+            time.sleep(0.1)
+        assert recovered, f"fleet never recovered: {front._shards_health()}"
+        assert supervisor.restart_counts() == restarts_before, (
+            "supervisor restarted a worker over a transient network fault"
+        )
+        assert front.drain(120.0)
+        time.sleep(0.5)
+
+        fired = plan.fired(site)
+        assert fired >= 1, f"{site} never fired (vacuous pass)"
+        result["fired"] = fired
+        result["reconnects"] = getattr(handle, "reconnects", 0)
+        result["conn_lost"] = supervisor.connection_losses().get(target_sid, 0)
+        result["deadline_exceeded"] = getattr(handle, "deadline_exceeded", 0)
+
+        wrong, stale = final_state(front)
+        assert not wrong, f"wrong verdicts after heal: {wrong[:3]}"
+        assert not stale, f"lost flips after heal: {stale[:3]}"
+        bad = audit_all(front)
+        assert not bad, f"orphan audit failed: {bad}"
+        result["ok"] = True
+        return result
+    finally:
+        supervisor.stop()
+        front.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="netchaostest")
+    sub = parser.add_subparsers(dest="command", required=True)
+    m = sub.add_parser("matrix", help="every net.* site x 3 seeds")
+    m.add_argument("--seeds", default=",".join(str(s) for s in SEEDS))
+    m.add_argument("--json", default="", help="write the matrix report here")
+    one = sub.add_parser("one", help="a single case")
+    one.add_argument("--site", required=True)
+    one.add_argument("--mode", default="error")
+    one.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from kube_throttler_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    if args.command == "one":
+        kwargs = next(
+            (kw for s, md, kw in CASES if s == args.site and md == args.mode),
+            None,
+        )
+        result = run_case(args.site, args.mode, args.seed, rule_kwargs=kwargs)
+        print(json.dumps(result, indent=2))
+        return 0
+
+    seeds = [int(s) for s in args.seeds.split(",") if s != ""]
+    results, failures = [], 0
+    for site, mode, kwargs in CASES:
+        for seed in seeds:
+            label = f"{site}:{mode}"
+            t0 = time.monotonic()
+            try:
+                result = run_case(site, mode, seed, rule_kwargs=kwargs)
+                result["wall_s"] = round(time.monotonic() - t0, 1)
+                results.append(result)
+                print(f"PASS {label:<28} seed={seed} fired={result['fired']} "
+                      f"reconnects={result['reconnects']} "
+                      f"({result['wall_s']}s)")
+            except Exception as e:  # noqa: BLE001 — matrix reports, then fails
+                failures += 1
+                results.append({"case": label, "seed": seed, "error": repr(e)})
+                print(f"FAIL {label:<28} seed={seed}: {e!r}")
+    total = len(CASES) * len(seeds)
+    print(f"\n{total - failures}/{total} network-fault paths clean "
+          "(zero wrong verdicts, zero lost flips, zero orphan reservations)")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
