@@ -113,6 +113,23 @@ def _serve_sharded(args, plugin_args, leader_elect: bool, stop) -> int:
     transport = getattr(args, "shard_transport", "socketpair")
     if remote_workers and transport != "tcp":
         transport = "tcp"  # remote workers imply the fleet transport
+    auth_key = None
+    if transport == "tcp":
+        from .sharding.ipc import load_auth_key
+
+        auth_key = load_auth_key(getattr(args, "shard_auth_key_file", ""))
+        if auth_key is None and remote_workers:
+            # pickled frames to a peer we cannot authenticate: the
+            # workers will refuse a keyless non-loopback --listen, but
+            # say it HERE too so a loopback-tunnel setup is a choice,
+            # not an accident
+            print(
+                "WARNING: --shard-connect without a frame-auth key "
+                "(--shard-auth-key-file / $KT_SHARD_AUTH_KEY): shard "
+                "frames are unauthenticated pickle — only safe if every "
+                "hop is loopback or locked down out-of-band",
+                flush=True,
+            )
 
     metrics_registry = Registry()
     front = AdmissionFront(
@@ -130,6 +147,7 @@ def _serve_sharded(args, plugin_args, leader_elect: bool, stop) -> int:
         ingest_batch=getattr(args, "ingest_batch", "adaptive"),
         transport=transport,
         remote_workers=remote_workers,
+        auth_key=auth_key,
     )
     print(
         f"spawning {args.shards - len(remote_workers)} shard workers "
@@ -288,6 +306,16 @@ def main(argv: Optional[list] = None) -> int:
         help="per-op deadline budget (seconds) for front→shard RPCs; a "
         "scatter call that outruns it degrades fail-safe instead of "
         "blocking admission (the bulk triage op keeps a 120s floor)",
+    )
+    serve.add_argument(
+        "--shard-auth-key-file",
+        default="",
+        help="file holding the fleet's frame-auth pre-shared key (a "
+        "mounted Secret); falls back to $KT_SHARD_AUTH_KEY. Every TCP "
+        "shard frame is HMAC-authenticated with it before the pickle "
+        "payload is deserialized — REQUIRED for fleets that leave "
+        "loopback; the workers refuse a keyless non-loopback --listen "
+        "(docs/robustness.md 'Transport security')",
     )
     serve.add_argument("--no-device", action="store_true", help="host-oracle decisions only")
     serve.add_argument(

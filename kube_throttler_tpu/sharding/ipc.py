@@ -58,18 +58,37 @@ Overflow posture mirrors ``MicroBatchIngest``: the event queue is
 bounded and sheds ONLY pod upserts (verdict-safe); a shed marks the
 shard dirty so the supervisor's next resync repairs the gap. Sends to a
 dead shard count as route misses and mark it dirty likewise.
+
+TRUST BOUNDARY — the payload is pickle, and ``pickle.loads`` on
+attacker-controlled bytes is arbitrary code execution. Over a
+socketpair the peer is a child the supervisor forked from this code
+tree, so the trusted-local assumption holds by construction. Over TCP
+it does NOT: anything that can reach the port could feed the
+deserializer. Cross-host mode therefore authenticates every frame with
+a pre-shared key — ``[len][HMAC-SHA256(key, payload)][payload]`` — and
+the MAC is verified BEFORE the payload is unpickled; a frame that
+fails the MAC (no key, wrong key, tampered bytes) is dropped as a torn
+stream and the lane dies. The worker refuses to listen on a
+non-loopback address without a key (``worker.py --auth-key-file`` /
+``KT_SHARD_AUTH_KEY``). The key authenticates, it does not encrypt:
+frames still travel plaintext, so keep the port scoped to the fleet
+(NetworkPolicy, private network) — see deploy/sharded-fleet.yaml and
+docs/robustness.md "Transport security".
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import logging
+import os
 import pickle
 import socket
 import struct
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..utils.lockorder import guard_attrs, make_lock
 
@@ -77,6 +96,35 @@ logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
 PICKLE_PROTO = 5
+# A 4-byte length from a torn/hostile stream can claim up to 4 GiB;
+# nothing legitimate approaches this (evt batches cap at EVT_BATCH ops,
+# reshard slices chunk well below it) — anything larger is a misaligned
+# tear or garbage and must die as a torn stream, not an allocation.
+MAX_FRAME = 64 * 1024 * 1024
+_MAC_LEN = hashlib.sha256().digest_size  # 32
+
+AuthKey = Optional[Union[str, bytes]]
+
+
+def _as_key_bytes(key: AuthKey) -> Optional[bytes]:
+    if key is None or isinstance(key, bytes):
+        return key
+    return key.encode("utf-8")
+
+
+def load_auth_key(path: str = "", env: str = "KT_SHARD_AUTH_KEY") -> Optional[bytes]:
+    """Resolve the fleet's frame-auth pre-shared key: an explicit key
+    file (a mounted Secret) wins over the environment variable; either
+    is stripped of surrounding whitespace. ``None`` = unauthenticated
+    (loopback/socketpair only)."""
+    if path:
+        with open(path, "rb") as fh:
+            key = fh.read().strip()
+        if not key:
+            raise ValueError(f"auth key file {path!r} is empty")
+        return key
+    val = os.environ.get(env, "").strip()
+    return val.encode("utf-8") if val else None
 
 # (verb, kind, payload) — the Store.apply_events op shape
 Op = Tuple[str, str, object]
@@ -95,11 +143,16 @@ class FencedError(RuntimeError):
 
 def send_frame(
     sock: socket.socket, send_lock, mtype: str, rid: int, body,
-    epoch: int = 0, faults=None,
+    epoch: int = 0, faults=None, key: AuthKey = None,
 ) -> None:
     """Pickle and send one frame. ``faults`` arms the framing-layer
-    ``net.*`` sites (same seeded plan drives socketpair and TCP)."""
+    ``net.*`` sites (same seeded plan drives socketpair and TCP).
+    ``key`` prepends an HMAC-SHA256 of the payload (cross-host mode:
+    the peer verifies it before unpickling a byte)."""
     payload = pickle.dumps((mtype, rid, body, epoch), protocol=PICKLE_PROTO)
+    kb = _as_key_bytes(key)
+    if kb is not None:
+        payload = hmac.new(kb, payload, hashlib.sha256).digest() + payload
     frame = _LEN.pack(len(payload)) + payload
     if faults is not None:
         fault = faults.check("net.partition")
@@ -116,9 +169,15 @@ def send_frame(
         sock.sendall(frame)
 
 
-def read_frame(rfile, faults=None) -> Optional[Tuple[str, int, object, int]]:
+def read_frame(
+    rfile, faults=None, key: AuthKey = None,
+) -> Optional[Tuple[str, int, object, int]]:
     """Read one frame from a buffered reader; None on EOF or a torn
-    (short) frame — a partial frame is never surfaced."""
+    (short) frame — a partial frame is never surfaced. With ``key`` the
+    leading HMAC is verified before ``pickle.loads`` ever runs: a frame
+    from a peer without the key (or tampered in flight) is dropped as a
+    torn stream, so an unauthenticated client can never reach the
+    deserializer."""
     if faults is not None:
         fault = faults.check("net.recv.stall")
         if fault is not None:
@@ -127,9 +186,23 @@ def read_frame(rfile, faults=None) -> Optional[Tuple[str, int, object, int]]:
     if not header or len(header) < _LEN.size:
         return None
     (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        # a misaligned tear (or garbage) parses as a length up to 4 GiB;
+        # reading toward it would stall the lane and spike memory — the
+        # framing is lost either way, so die as a torn stream
+        return None
     payload = rfile.read(n)
     if len(payload) < n:
         return None
+    kb = _as_key_bytes(key)
+    if kb is not None:
+        if n < _MAC_LEN:
+            return None
+        mac, payload = payload[:_MAC_LEN], payload[_MAC_LEN:]
+        if not hmac.compare_digest(
+            mac, hmac.new(kb, payload, hashlib.sha256).digest()
+        ):
+            return None  # unauthenticated peer / wrong key / tampered
     try:
         return pickle.loads(payload)
     except Exception:  # noqa: BLE001 — undecodable bytes = torn stream
@@ -486,6 +559,7 @@ class TcpShardClient:
         connect_timeout: float = 5.0,
         backoff_base: float = 0.05,
         backoff_cap: float = 2.0,
+        auth_key: AuthKey = None,
     ):
         from ..client.transport import Backoff  # PR 1 jittered exponential
 
@@ -496,6 +570,9 @@ class TcpShardClient:
         self.on_down = on_down
         self.on_up = on_up
         self.faults = faults
+        # cross-host frame auth (HMAC per frame, see module docstring);
+        # None = unauthenticated — loopback/test rigs only
+        self.auth_key = _as_key_bytes(auth_key)
         self.maxsize = maxsize or self.MAX_QUEUE
         self.pool_size = max(1, int(pool_size))
         self.default_deadline = float(default_deadline)
@@ -564,7 +641,8 @@ class TcpShardClient:
                 # client stays DOWN in backoff instead of flapping
                 # up-then-down once per establishment
                 send_frame(sock, conn.send_lock, "sub", 0, None,
-                           epoch=self.epoch, faults=self.faults)
+                           epoch=self.epoch, faults=self.faults,
+                           key=self.auth_key)
             if self.faults is not None:
                 fault = self.faults.check("net.reconnect.storm")
                 if fault is not None:
@@ -715,53 +793,72 @@ class TcpShardClient:
             self._qcond.notify()
 
     def _send_loop(self) -> None:
-        # top-level routing (threads checker): sender death = down shard
-        try:
-            while True:
+        # top-level routing (threads checker): sender death = down shard.
+        # Unlike ShardClient this handle SURVIVES link loss, so an
+        # unexpected sender error cannot just log-and-exit — events would
+        # queue/shed forever behind a dead thread while health read
+        # merely "degraded" and even a resync would re-enqueue into the
+        # same dead queue. Tear down the primary lane instead (on_down
+        # fires, the front degrades fail-safe, the reconnect's resync
+        # repairs the gap) and keep the sender alive.
+        while True:
+            try:
+                self._drain_until_closed()
+                return  # clean exit: closed and drained
+            except Exception:  # noqa: BLE001 — route the death, don't hide it
+                logger.exception("shard %d: tcp sender error", self.shard_id)
                 with self._qcond:
-                    while not self._queue and not self._closed:
-                        self._qcond.wait(0.2)
-                    if self._closed and not self._queue:
-                        return
-                conn = self._primary()
-                if conn is None:
                     if self._closed:
                         return
-                    # partitioned: hold the (bounded) queue; the shed +
-                    # dirty + resync-on-heal path repairs any overflow
-                    with self._ccond:
-                        if self._conns[0] is None and not self._closed:
-                            self._ccond.wait(0.2)
-                    continue
-                with self._qcond:
-                    batch = [
-                        self._queue.popleft()
-                        for _ in range(min(len(self._queue), self.EVT_BATCH))
-                    ]
-                if not batch:
-                    continue
-                try:
-                    if self.faults is not None:
-                        fault = self.faults.check("shard.ipc.send")
-                        if fault is not None:
-                            raise OSError(
-                                f"injected IPC send failure (hit {fault.hit})"
-                            )
-                    send_frame(conn.sock, conn.send_lock, "evt", 0, batch,
-                               epoch=self.epoch, faults=self.faults)
-                    self.events_sent += len(batch)
-                    self.frames_sent += 1
-                except OSError:
-                    # link gone mid-send: these events are lost — the
-                    # reconnect's resync (replay + prune) repairs the gap
-                    with self._qcond:
-                        self.dropped += len(batch)
-                        self.dirty = True
+                    self.dirty = True
+                conn = self._primary()
+                if conn is not None:
                     self._conn_dead(conn)
-        except Exception:  # noqa: BLE001 — route the death, don't hide it
-            logger.exception("shard %d: tcp sender died", self.shard_id)
+                time.sleep(0.05)  # a persistent bug must not spin-degrade
+
+    def _drain_until_closed(self) -> None:
+        while True:
             with self._qcond:
-                self.dirty = True
+                while not self._queue and not self._closed:
+                    self._qcond.wait(0.2)
+                if self._closed and not self._queue:
+                    return
+            conn = self._primary()
+            if conn is None:
+                if self._closed:
+                    return
+                # partitioned: hold the (bounded) queue; the shed +
+                # dirty + resync-on-heal path repairs any overflow
+                with self._ccond:
+                    if self._conns[0] is None and not self._closed:
+                        self._ccond.wait(0.2)
+                continue
+            with self._qcond:
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(len(self._queue), self.EVT_BATCH))
+                ]
+            if not batch:
+                continue
+            try:
+                if self.faults is not None:
+                    fault = self.faults.check("shard.ipc.send")
+                    if fault is not None:
+                        raise OSError(
+                            f"injected IPC send failure (hit {fault.hit})"
+                        )
+                send_frame(conn.sock, conn.send_lock, "evt", 0, batch,
+                           epoch=self.epoch, faults=self.faults,
+                           key=self.auth_key)
+                self.events_sent += len(batch)
+                self.frames_sent += 1
+            except OSError:
+                # link gone mid-send: these events are lost — the
+                # reconnect's resync (replay + prune) repairs the gap
+                with self._qcond:
+                    self.dropped += len(batch)
+                    self.dirty = True
+                self._conn_dead(conn)
 
     # ---------------------------------------------------------------- RPC
 
@@ -790,7 +887,8 @@ class TcpShardClient:
             self._pending[rid] = slot
         try:
             send_frame(conn.sock, conn.send_lock, "req", rid, (op, payload),
-                       epoch=self.epoch, faults=self.faults)
+                       epoch=self.epoch, faults=self.faults,
+                       key=self.auth_key)
         except OSError:
             with self._plock:
                 self._pending.pop(rid, None)
@@ -818,7 +916,7 @@ class TcpShardClient:
         rfile = conn.sock.makefile("rb")
         try:
             while True:
-                frame = read_frame(rfile, self.faults)
+                frame = read_frame(rfile, self.faults, key=self.auth_key)
                 if frame is None:
                     break
                 mtype, rid, body, epoch = frame

@@ -73,6 +73,7 @@ class ShardSupervisor:
         env: Optional[dict] = None,
         transport: str = "socketpair",
         remote_workers: Optional[Dict[int, str]] = None,
+        auth_key: Optional[bytes] = None,
     ):
         if transport not in ("socketpair", "tcp"):
             raise ValueError(f"unknown shard transport {transport!r}")
@@ -94,6 +95,11 @@ class ShardSupervisor:
         # shards somebody else runs (cross-host fleet): dialed, never
         # spawned, never restarted — their heal path is reconnect+resync
         self.remote_workers: Dict[int, str] = dict(remote_workers or {})
+        # fleet frame-auth PSK (HMAC per frame, ipc.py trust boundary):
+        # used by every TcpShardClient and exported to spawned TCP
+        # children via $KT_SHARD_AUTH_KEY so both ends of a local lane
+        # run the same keyed framing the remote workers require
+        self.auth_key = auth_key
         self._rendezvous_dir: Optional[str] = None
         self._port_seq = 0
         self._proc_lock = make_lock("shard.supervisor.procs")
@@ -138,6 +144,8 @@ class ShardSupervisor:
     def _child_env(self) -> dict:
         env = dict(os.environ if self.env is None else self.env)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if self.auth_key is not None:
+            env["KT_SHARD_AUTH_KEY"] = self.auth_key.decode("utf-8")
         return env
 
     def _tcp_client(self, shard_id: int, host: str, port: int) -> TcpShardClient:
@@ -151,6 +159,7 @@ class ShardSupervisor:
             faults=self.front.faults,
             default_deadline=self.front.rpc_deadline,
             deadlines=self.front.rpc_deadlines,
+            auth_key=self.auth_key,
         )
 
     def _attach_remote(self, shard_id: int) -> None:

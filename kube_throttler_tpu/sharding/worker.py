@@ -985,7 +985,10 @@ class ShardCore:
             self.journal.close()
 
 
-def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
+def serve(
+    core: ShardCore, sock: socket.socket, bind_push: bool = True,
+    auth_key: Optional[bytes] = None,
+) -> None:
     """The worker's IPC loop: read frames until EOF. Events apply via the
     ingest pipeline (non-blocking); RPCs answer from a small pool so a
     long batch call cannot park the event stream.
@@ -996,7 +999,13 @@ def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
     parallel RPC lanes. Responses and pushes are stamped with the max
     fencing epoch the core has seen; stale-epoch frames are fenced —
     ``evt`` batches dropped, ``req`` refused with a ``FencedError`` body
-    (the wire-level 409)."""
+    (the wire-level 409).
+
+    ``auth_key`` arms per-frame HMAC auth (cross-host mode): inbound
+    frames that fail the MAC die as a torn stream BEFORE the pickle
+    deserializer runs, outbound frames are stamped so the front's keyed
+    reader accepts them. Keyless is the trusted-local posture
+    (socketpair children, loopback test rigs)."""
     from concurrent.futures import ThreadPoolExecutor
 
     from .ipc import read_frame, send_frame
@@ -1005,7 +1014,8 @@ def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
 
     def push(items) -> None:
         send_frame(sock, send_lock, "push", 0, items,
-                   epoch=core.current_epoch(), faults=core.faults)
+                   epoch=core.current_epoch(), faults=core.faults,
+                   key=auth_key)
 
     if bind_push:
         core.push = push
@@ -1016,7 +1026,8 @@ def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
         result = core.rpc(op, payload)
         try:
             send_frame(sock, send_lock, "res", rid, result,
-                       epoch=core.current_epoch(), faults=core.faults)
+                       epoch=core.current_epoch(), faults=core.faults,
+                       key=auth_key)
         except OSError:
             pass  # front went away; the supervisor restarts us if needed
 
@@ -1027,13 +1038,14 @@ def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
         )
         try:
             send_frame(sock, send_lock, "res", rid, body,
-                       epoch=core.current_epoch(), faults=core.faults)
+                       epoch=core.current_epoch(), faults=core.faults,
+                       key=auth_key)
         except OSError:
             pass
 
     try:
         while True:
-            frame = read_frame(rfile, core.faults)
+            frame = read_frame(rfile, core.faults, key=auth_key)
             if frame is None:
                 return
             mtype, rid, body, epoch = frame
@@ -1048,8 +1060,13 @@ def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
                 op, payload = body
                 pool.submit(answer, rid, op, payload)
             elif mtype == "sub":
-                core.observe_epoch(epoch, "sub")
-                core.push = push
+                if core.observe_epoch(epoch, "sub"):
+                    core.push = push
+                # a STALE sub is counted fenced and must not rebind the
+                # push stream: a partitioned-then-healed (not yet
+                # resynced) peer's subscribe would otherwise steal the
+                # lane from the current primary and route every flip to
+                # a connection the fencing contract says not to trust
     except OSError:
         return
     finally:
@@ -1057,14 +1074,16 @@ def serve(core: ShardCore, sock: socket.socket, bind_push: bool = True) -> None:
         rfile.close()
 
 
-def serve_tcp(core: ShardCore, srv: socket.socket) -> None:
+def serve_tcp(
+    core: ShardCore, srv: socket.socket, auth_key: Optional[bytes] = None,
+) -> None:
     """The worker's TCP accept loop (``--listen``): each accepted
     connection is one front lane served by :func:`serve` against the
     shared core. Returns when the listener socket is closed."""
 
     def lane(conn: socket.socket, peer) -> None:
         try:
-            serve(core, conn, bind_push=False)
+            serve(core, conn, bind_push=False, auth_key=auth_key)
         except Exception:  # noqa: BLE001 — route the death, don't hide it
             logger.exception(
                 "shard %d: connection from %s died", core.shard_id, peer
@@ -1085,6 +1104,18 @@ def serve_tcp(core: ShardCore, srv: socket.socket) -> None:
             target=lane, args=(conn, peer),
             name=f"shard{core.shard_id}-conn", daemon=True,
         ).start()
+
+
+_LOOPBACK_HOSTS = frozenset({"", "localhost", "127.0.0.1", "::1"})
+
+
+def listen_requires_auth(host: str) -> bool:
+    """True when binding ``host`` exposes the framed-pickle protocol
+    beyond this machine: a non-loopback listener without frame auth
+    hands arbitrary-code-execution to anything that can reach the port
+    (see the ipc.py trust-boundary docstring), so :func:`main` refuses
+    that combination unless ``--insecure-no-auth`` is explicit."""
+    return host not in _LOOPBACK_HOSTS and not host.startswith("127.")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1110,6 +1141,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         "once listening (the spawner's rendezvous, race-free even with "
         "an ephemeral port)",
     )
+    parser.add_argument(
+        "--auth-key-file", default="",
+        help="file holding the fleet's frame-auth pre-shared key (a "
+        "mounted Secret); falls back to $KT_SHARD_AUTH_KEY. The frame "
+        "payload is pickle — over TCP every frame is HMAC-authenticated "
+        "with this key BEFORE deserialization, so only key holders can "
+        "speak to the worker. Required for a non-loopback --listen",
+    )
+    parser.add_argument(
+        "--insecure-no-auth", action="store_true",
+        help="allow a non-loopback --listen WITHOUT a frame-auth key. "
+        "DANGEROUS: any peer that can reach the port gets arbitrary "
+        "code execution via a crafted pickle frame — only for networks "
+        "where reachability is already locked down out-of-band",
+    )
     parser.add_argument("--name", default="kube-throttler")
     parser.add_argument("--target-scheduler-name", default="my-scheduler")
     parser.add_argument("--data-dir", default="")
@@ -1125,6 +1171,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     if bool(args.listen) == (args.ipc_fd is not None):
         parser.error("exactly one of --ipc-fd and --listen is required")
+    auth_key = None
+    if args.listen:
+        from .ipc import load_auth_key
+
+        auth_key = load_auth_key(args.auth_key_file)
+        listen_host = args.listen.rpartition(":")[0]
+        if auth_key is None and listen_requires_auth(listen_host):
+            if not args.insecure_no_auth:
+                parser.error(
+                    f"--listen {args.listen}: a non-loopback listener "
+                    "requires a frame-auth key (--auth-key-file or "
+                    "$KT_SHARD_AUTH_KEY) — the shard protocol is pickled "
+                    "Python, and without per-frame HMAC any peer that "
+                    "can reach the port gets arbitrary code execution. "
+                    "Pass --insecure-no-auth only if reachability is "
+                    "locked down out-of-band (NetworkPolicy, private "
+                    "network)"
+                )
 
     logging.basicConfig(
         level=logging.INFO,
@@ -1157,6 +1221,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     if args.listen:
         host, _, port = args.listen.rpartition(":")
+        if auth_key is None and listen_requires_auth(host):
+            logger.warning(
+                "listening on %s WITHOUT frame auth (--insecure-no-auth): "
+                "any peer that can reach this port can execute arbitrary "
+                "code via a crafted pickle frame", args.listen,
+            )
         srv = socket.create_server((host or "127.0.0.1", int(port)))
         bound_host, bound_port = srv.getsockname()[:2]
         if args.port_file:
@@ -1170,7 +1240,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             flush=True,
         )
         try:
-            serve_tcp(core, srv)
+            serve_tcp(core, srv, auth_key=auth_key)
         finally:
             core.stop()
             srv.close()

@@ -14,6 +14,8 @@ small ``tools/netchaostest.py`` case; the full matrix is
 
 from __future__ import annotations
 
+import io
+import pickle
 import socket
 import threading
 import time
@@ -27,6 +29,7 @@ from kube_throttler_tpu.faults.plan import FaultPlan
 from kube_throttler_tpu.sharding.front import AdmissionFront
 from kube_throttler_tpu.sharding.ipc import (
     _LEN,
+    MAX_FRAME,
     FencedError,
     ShardClient,
     ShardUnavailable,
@@ -292,6 +295,209 @@ class TestWireFencing:
         rig.core.push([("Throttle", "stale-view")])
         wait_until(lambda: client.fenced_pushes >= 1, msg="push fenced")
         assert pushes == []
+
+
+    def test_stale_sub_cannot_steal_the_push_stream(self, rig):
+        """A partitioned-then-healed (not yet resynced) peer's ``sub``
+        is a frame from the past: it must be counted fenced AND must not
+        rebind the worker's push stream — otherwise every flip would
+        stream to a connection the fencing contract says not to trust
+        until the next resync."""
+        pushes = []
+        client = rig.client(on_push=lambda sid, items: pushes.append(items))
+        wait_until(lambda: client.alive, msg="client up")
+        wait_until(lambda: rig.core.push is not None, msg="sub bound")
+        # the fleet moved on while some peer was partitioned away
+        assert rig.core.observe_epoch(4)
+        while client.epoch < 4:
+            client.bump_epoch()
+        stale = socket.create_connection(("127.0.0.1", rig.port), timeout=2.0)
+        try:
+            send_frame(stale, threading.Lock(), "sub", 0, None, epoch=2)
+            wait_until(lambda: rig.core._fenced_counts()["reqs"] >= 1,
+                       msg="stale sub fenced")
+            rig.core.push([("Throttle", "truth")])
+            wait_until(lambda: pushes, msg="push still rides the primary")
+            assert pushes[0] == [("Throttle", "truth")]
+        finally:
+            stale.close()
+
+
+# --------------------------------------------------------------------------
+# frame auth — the pickle trust boundary (cross-host mode)
+# --------------------------------------------------------------------------
+
+
+_EVIL_CALLS: list = []
+
+
+def _evil_sink(marker):
+    _EVIL_CALLS.append(marker)
+
+
+class _EvilPayload:
+    """The RCE shape: unpickling this executes attacker-chosen code
+    (a module-level callable, so it pickles by reference and fires in
+    the reader's process)."""
+
+    def __reduce__(self):
+        return (_evil_sink, ("executed",))
+
+
+class TestFrameAuth:
+    KEY = b"test-fleet-psk"
+
+    def test_authenticated_roundtrip(self):
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, threading.Lock(), "res", 7, (True, "pong"),
+                       epoch=3, key=self.KEY)
+            frame = read_frame(b.makefile("rb"), key=self.KEY)
+            assert frame == ("res", 7, (True, "pong"), 3)
+        finally:
+            a.close()
+            b.close()
+
+    def test_unauthenticated_frame_never_reaches_the_deserializer(self):
+        """A crafted pickle from a peer WITHOUT the key must die at the
+        MAC check — pickle.loads on it would be arbitrary code
+        execution in the worker."""
+        del _EVIL_CALLS[:]
+        payload = pickle.dumps(_EvilPayload(), protocol=5)
+        raw = _LEN.pack(len(payload)) + payload
+        assert read_frame(io.BytesIO(raw), key=self.KEY) is None
+        assert _EVIL_CALLS == []  # the deserializer never ran
+        # sanity check on the threat model: the SAME bytes execute on a
+        # keyless reader — which is exactly why a non-loopback --listen
+        # refuses to start without a key
+        read_frame(io.BytesIO(raw))
+        assert _EVIL_CALLS == ["executed"]
+
+    def test_wrong_key_is_a_torn_stream(self):
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, threading.Lock(), "req", 1, ("ping", None),
+                       key=b"some-other-key")
+            assert read_frame(b.makefile("rb"), key=self.KEY) is None
+        finally:
+            a.close()
+            b.close()
+
+    def test_keyed_frame_is_noise_to_a_keyless_reader(self):
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, threading.Lock(), "req", 1, ("ping", None),
+                       key=self.KEY)
+            assert read_frame(b.makefile("rb")) is None
+        finally:
+            a.close()
+            b.close()
+
+    def test_keyed_fleet_end_to_end(self):
+        """A keyed worker serves keyed clients; a keyless client can
+        connect but never speak — its frames fail the MAC before the
+        deserializer and the lane dies."""
+        core = ShardCore(0, 1, use_device=False)
+        srv = socket.create_server(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        threading.Thread(
+            target=serve_tcp, args=(core, srv),
+            kwargs={"auth_key": self.KEY},
+            name="test-keyed-accept", daemon=True,
+        ).start()
+        client = keyless = None
+        try:
+            pushes = []
+            client = TcpShardClient(
+                0, "127.0.0.1", port, auth_key=self.KEY,
+                connect_timeout=2.0,
+                on_push=lambda sid, items: pushes.append(items),
+            )
+            wait_until(lambda: client.alive, msg="keyed client up")
+            assert client.request("ping")["shard"] == 0
+            wait_until(lambda: core.push is not None, msg="sub bound")
+            core.push([("Throttle", "keyed")])
+            wait_until(lambda: pushes, msg="keyed push delivered")
+            keyless = TcpShardClient(
+                0, "127.0.0.1", port, connect_timeout=2.0,
+                default_deadline=0.5,
+            )
+            with pytest.raises(ShardUnavailable):
+                keyless.request("ping")
+        finally:
+            if client is not None:
+                client.close()
+            if keyless is not None:
+                keyless.close()
+            srv.close()
+            core.stop()
+
+    def test_worker_refuses_keyless_nonloopback_listen(self, monkeypatch):
+        monkeypatch.delenv("KT_SHARD_AUTH_KEY", raising=False)
+        from kube_throttler_tpu.sharding import worker as worker_mod
+
+        with pytest.raises(SystemExit):
+            worker_mod.main([
+                "--shard-id", "0", "--shards", "1",
+                "--listen", "0.0.0.0:0", "--no-device",
+            ])
+        assert worker_mod.listen_requires_auth("0.0.0.0")
+        assert worker_mod.listen_requires_auth("10.0.0.7")
+        assert not worker_mod.listen_requires_auth("127.0.0.1")
+        assert not worker_mod.listen_requires_auth("localhost")
+        assert not worker_mod.listen_requires_auth("")
+
+
+# --------------------------------------------------------------------------
+# framing hygiene + sender resilience
+# --------------------------------------------------------------------------
+
+
+class TestFramingHygiene:
+    def test_bogus_length_header_is_rejected_before_the_payload_read(self):
+        """A misaligned tear (or garbage) parses as a length up to
+        4 GiB; read_frame must reject it as a torn stream BEFORE
+        buffering toward it — no reader stall, no allocation spike."""
+        buf = io.BytesIO(_LEN.pack(MAX_FRAME + 1) + b"x" * 64)
+        assert read_frame(buf) is None
+        assert buf.tell() == _LEN.size  # not one payload byte was read
+
+    def test_max_frame_boundary_still_decodes(self):
+        payload = pickle.dumps(("evt", 0, ["ok"], 1), protocol=5)
+        buf = io.BytesIO(_LEN.pack(len(payload)) + payload)
+        assert read_frame(buf) == ("evt", 0, ["ok"], 1)
+
+    def test_sender_unexpected_error_degrades_fail_safe(self, rig, monkeypatch):
+        """A non-OSError escaping the TCP send path must tear down the
+        primary lane (on_down fires, the front degrades fail-safe, heal
+        resyncs) and the sender must SURVIVE to drain after the heal —
+        never a live-looking handle with events queued behind a dead
+        thread."""
+        import kube_throttler_tpu.sharding.ipc as ipc_mod
+
+        down, up = threading.Event(), threading.Event()
+        client = rig.client(pool_size=1, on_down=lambda sid: down.set(),
+                            on_up=lambda sid: up.set())
+        wait_until(lambda: client.alive, msg="client up")
+        real = ipc_mod.send_frame
+        fired = threading.Event()
+
+        def boom(sock, lock, mtype, rid, body, **kw):
+            if mtype == "evt" and not fired.is_set():
+                fired.set()
+                raise ValueError("injected non-OSError sender bug")
+            return real(sock, lock, mtype, rid, body, **kw)
+
+        monkeypatch.setattr(ipc_mod, "send_frame", boom)
+        pod = make_pod("p0", labels={"grp": "g"}, requests={"cpu": "1"})
+        client.enqueue_ops([("upsert", "Pod", pod)])
+        assert down.wait(5.0), "sender death never degraded the shard"
+        assert up.wait(10.0), "sender death was permanent (no heal)"
+        wait_until(lambda: client.alive, msg="reconnect after sender bug")
+        assert client.is_dirty()  # the lost batch is a resync's problem
+        client.enqueue_ops([("upsert", "Pod", pod)])
+        wait_until(lambda: client.events_sent >= 1,
+                   msg="sender survived and drains after the heal")
 
 
 # --------------------------------------------------------------------------

@@ -82,6 +82,10 @@ def build_fleet(n_shards=2, n_throttles=24, n_pods=160, n_reserved=8,
         use_device=False,
         restart_backoff=0.3,
         env={**os.environ, "KT_SHARD_QUIET": "1", "KT_LOCK_ASSERT": "0"},
+        # the matrix runs the KEYED framing (HMAC per frame) so every
+        # fault path is exercised through the cross-host trust boundary,
+        # not the loopback-only keyless shortcut
+        auth_key=b"netchaos-matrix-psk",
     )
     supervisor.start(ready_timeout=300.0)
     try:
