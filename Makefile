@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress race-test crash-test ha-test reshard-test net-chaos scenario-test shard-scenario reshard-scenario preempt-scenario partition-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress race-test crash-test ha-test reshard-test net-chaos scenario-test shard-scenario reshard-scenario preempt-scenario partition-scenario replica-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -28,12 +28,13 @@ ha-test:         ## kill-the-leader failover matrix: every ha.* site x 3 seeds +
 reshard-test:    ## kill-mid-handoff abort matrix: every reshard.* abort path x 3 seeds, zero orphan reservations
 	env JAX_PLATFORMS=cpu $(PY) tools/reshardtest.py matrix
 
-scenario-test:   ## trace-driven scenario corpus x 3 seeds, every SLO gate enforced (+ the sharded bad-day variant + the live-resharding chaos scenario + the preemption storm + the TCP-fleet partition bad day + hunt-promoted regression repros)
+scenario-test:   ## trace-driven scenario corpus x 3 seeds, every SLO gate enforced (+ the sharded bad-day variant + the live-resharding chaos scenario + the preemption storm + the TCP-fleet partition bad day + the replica serving tier + hunt-promoted regression repros)
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios matrix
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.sharded --shards 4 --seed 0
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.resharding --seed 0
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.preemption --seed 0
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.partition --seed 0
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.replica --seed 0
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios regressions
 
 preempt-scenario: ## preemption storm alone: gang waves vs low-priority residents, victim-churn SLO gate
@@ -47,6 +48,9 @@ reshard-scenario: ## live resharding alone: scale 2->4->3 under storm load with 
 
 partition-scenario: ## TCP-fleet partition bad day alone: asymmetric partition + heal mid-storm, zero wrong verdicts / zero lost flips / fencing gates
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.partition --seed 0
+
+replica-scenario: ## read-replica serving tier alone: storm + leader flip burst, verdict-oracle + lag-SLO + staleness/forwarding gates
+	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.replica --seed 0
 
 net-chaos:       ## network-fault matrix: every net.* site x 3 seeds through a live 2-worker TCP fleet; verdict-oracle + zero-orphan + zero-lost-flip gates
 	env JAX_PLATFORMS=cpu $(PY) tools/netchaostest.py matrix

@@ -157,6 +157,11 @@ def _serve_sharded(args, plugin_args, leader_elect: bool, stop) -> int:
     supervisor.start()
     if front.store.get_namespace("default") is None:
         front.store.create_namespace(Namespace("default"))
+    # front-side interned-verdict cache observability (the scatter tier
+    # keeps its own cache keyed on front epochs)
+    from .metrics import register_verdict_cache_metrics
+
+    register_verdict_cache_metrics(metrics_registry, front.verdict_cache)
     server = ThrottlerHTTPServer(front, host=args.host, port=args.port)
     server.start()
     print(
@@ -333,7 +338,7 @@ def main(argv: Optional[list] = None) -> int:
     )
     serve.add_argument(
         "--ha-role",
-        choices=("none", "leader", "standby"),
+        choices=("none", "leader", "standby", "replica"),
         default="none",
         help="active/standby HA for the standalone store (docs/robustness.md "
         "'High availability & fencing'): 'leader' acquires the lease, bumps "
@@ -341,14 +346,28 @@ def main(argv: Optional[list] = None) -> int:
         "standbys; 'standby' bootstraps from --replicate-from, streams the "
         "journal tail into its own --data-dir while /readyz reports "
         "standby, and promotes itself when the lease frees. Both imply "
-        "--leader-elect and require --data-dir",
+        "--leader-elect and require --data-dir. 'replica' is the stateless "
+        "read tier (docs/PERFORMANCE.md 'Verdict cache & read replicas'): "
+        "it bootstraps and streams like a standby but never competes for "
+        "the lease — it serves /v1/prefilter* locally (staleness-gated) "
+        "and forwards every write to the owner",
     )
     serve.add_argument(
         "--replicate-from",
         default="",
-        help="standby only: the leader's HTTP base URL (its --host:--port); "
-        "snapshot bootstrap + journal tail stream come from its "
-        "/v1/replication endpoints",
+        help="standby/replica only: the leader's HTTP base URL (its "
+        "--host:--port); snapshot bootstrap + journal tail stream come "
+        "from its /v1/replication endpoints (a replica also forwards "
+        "reserve/bind/object writes there)",
+    )
+    serve.add_argument(
+        "--replica-max-lag",
+        type=float,
+        default=5.0,
+        help="replica only: staleness bound in seconds — when the time "
+        "since the last successful replication poll exceeds this, the "
+        "replica refuses prefilter traffic with 503 instead of serving "
+        "possibly-stale verdicts (the flip SLO)",
     )
     serve.add_argument(
         "--lease-backend",
@@ -477,14 +496,20 @@ def main(argv: Optional[list] = None) -> int:
                 "mode the apiserver is the state of record and plain "
                 "--leader-elect active/standby already applies"
             )
-        leader_elect = True
-    if args.ha_role == "standby":
+        if args.ha_role != "replica":
+            # a replica never competes for the lease: it is a read tier,
+            # not a failover candidate
+            leader_elect = True
+    if args.ha_role in ("standby", "replica"):
         if not args.replicate_from:
-            parser.error("--ha-role standby requires --replicate-from "
-                         "(the leader's HTTP base URL)")
+            parser.error(f"--ha-role {args.ha_role} requires "
+                         "--replicate-from (the leader's HTTP base URL)")
         if args.nodes > 0:
-            parser.error("--nodes cannot run on a standby: the embedded "
-                         "scheduler would bind pods before promotion")
+            parser.error(f"--nodes cannot run on a {args.ha_role}: the "
+                         "embedded scheduler would bind pods locally")
+    if args.ha_role == "replica" and leader_elect:
+        parser.error("--leader-elect cannot be combined with --ha-role "
+                     "replica: a read replica never competes for the lease")
     if args.lease_backend == "http" and not plugin_args.kubeconfig:
         parser.error("--lease-backend http requires --kubeconfig (the "
                      "Lease object lives on that apiserver)")
@@ -674,7 +699,30 @@ def main(argv: Optional[list] = None) -> int:
             fence_hooks.append(lambda: epoch.fence("leadership lost"))
             journal.fencing = epoch
             snapshotter.fencing = epoch
-            if args.ha_role == "standby":
+            if args.ha_role == "replica":
+                # stateless read-replica tier: bootstrap + stream exactly
+                # like a standby, but no lease, no promotion path, no
+                # replication source of its own — it mirrors the owner's
+                # planes so the verdict cache can serve prefilter locally,
+                # and every write surface forwards to the owner
+                replicator = StandbyReplicator(
+                    store, journal, args.replicate_from, epoch=epoch
+                )
+                if not replicator.bootstrap(deadline_s=60.0):
+                    print(
+                        "replica bootstrap failed: owner unreachable at "
+                        f"{args.replicate_from}", file=sys.stderr, flush=True,
+                    )
+                    journal.close()
+                    return 1
+                replicator.start()
+                print(
+                    f"replica synced (offset={replicator.consumed_offset()}, "
+                    f"events={replicator.events_applied}) from "
+                    f"{args.replicate_from}",
+                    flush=True,
+                )
+            elif args.ha_role == "standby":
                 replicator = StandbyReplicator(
                     store, journal, args.replicate_from, epoch=epoch
                 )
@@ -738,9 +786,10 @@ def main(argv: Optional[list] = None) -> int:
                 register_ha_metrics(metrics_registry, ha)
                 ha.become_leader()
                 print(f"leading with fencing epoch {epoch.current()}", flush=True)
-            # either way this replica now leads: serve the replication
-            # endpoints so (new) standbys can bootstrap and stream
-            ha.source = ReplicationSource(args.data_dir, journal, epoch)
+            if ha is not None:
+                # leader or promoted standby: serve the replication
+                # endpoints so (new) standbys/replicas bootstrap and stream
+                ha.source = ReplicationSource(args.data_dir, journal, epoch)
         if store.get_namespace("default") is None:
             store.create_namespace(Namespace("default"))
         # standalone mode: the micro-batch ingest front-end over the local
@@ -853,6 +902,19 @@ def main(argv: Optional[list] = None) -> int:
         from .metrics import register_recovery_metrics
 
         register_recovery_metrics(metrics_registry, snapshotter, recovery)
+    replica_gate = None
+    if args.ha_role == "replica":
+        # the staleness gate fronts every locally served verdict: replica
+        # lag beyond the flip SLO flips prefilter to 503 (and /readyz to
+        # down) rather than serving verdicts the owner has outrun
+        from .engine.replication import ReplicaGate
+
+        replica_gate = ReplicaGate(replicator, max_lag_s=args.replica_max_lag)
+        plugin.health.register("replica", replica_gate.health_state)
+        plugin.health.register("replication", replicator.health_state)
+        from .metrics import register_replica_metrics
+
+        register_replica_metrics(metrics_registry, replica_gate)
     if ha is not None:
         # (HA metric families were registered at coordinator creation,
         # before the standby wait — only the health hook needs the plugin)
@@ -895,9 +957,13 @@ def main(argv: Optional[list] = None) -> int:
 
     # columnar arena observability (slots live/recycled, intern pool,
     # lazy-edge materializations) on the serving registry
-    from .metrics import register_store_metrics
+    from .metrics import register_store_metrics, register_verdict_cache_metrics
 
     register_store_metrics(metrics_registry, store)
+    # interned-verdict cache observability (hits/misses/entries/
+    # invalidations) — a no-op when the cache is disabled (KT_VERDICT_CACHE=0
+    # or no device manager)
+    register_verdict_cache_metrics(metrics_registry, plugin.verdict_cache)
 
     # last step before taking traffic: freeze the startup heap (store,
     # device mirror, kernel caches) so automatic full GCs never rescan it
@@ -924,6 +990,8 @@ def main(argv: Optional[list] = None) -> int:
         server = ThrottlerHTTPServer(
             plugin, host=args.host, port=args.port,
             remote=session is not None, ha=ha,
+            replica_gate=replica_gate,
+            owner_url=args.replicate_from if replica_gate is not None else None,
         )
         server.start()
     print(
@@ -957,6 +1025,10 @@ def main(argv: Optional[list] = None) -> int:
         session.stop()
     if ingest_pipeline is not None:
         ingest_pipeline.stop()  # drain queued ops before the final snapshot
+    if args.ha_role == "replica" and replicator is not None:
+        # stop streaming before the journal closes (the tail applier
+        # appends replicated events through it)
+        replicator.stop()
     plugin.stop()
     if snapshotter is not None:
         snapshotter.write(reason="shutdown")

@@ -298,6 +298,17 @@ class PodArena:
             self._req_ids[key] = sid
         return sid
 
+    def request_shape_id(self, spec: PodSpec) -> int:
+        """Intern ``spec``'s request shape and return its id — the public
+        entry for consumers holding a pod that was never absorbed (e.g. a
+        scheduler-fresh PreFilter pod): the verdict cache keys on the
+        shape id, and an unpickled/foreign pod object carries no stamped
+        ``_kt_req_sid``. Interning (not hashing) keeps the id space shared
+        with absorbed pods, so fresh and stored pods of the same shape
+        land on the same cache rows."""
+        with self.lock:
+            return self._req_shape_locked(spec)
+
     # -- absorb / free ----------------------------------------------------
 
     def absorb(self, key: str, pod: Pod) -> int:

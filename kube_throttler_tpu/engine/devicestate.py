@@ -366,6 +366,15 @@ class _KindState:
         self.st_req_throttled = zb(tcap, R)
         self.st_req_flag_present = zb(tcap, R)
         self.thr_valid = zb(tcap)
+        # verdict-epoch plane (engine/verdictcache.py): col_epoch[c] is
+        # bumped by every mutation that can change a verdict over col c
+        # (row encodes, removals, reservation writes); global_epoch covers
+        # mutations with no single-col footprint (namespace events re-route
+        # clusterthrottle matching wholesale). Monotonic, never reset —
+        # a cache key's epoch-sum can therefore only grow, so equality
+        # proves no covered mutation happened since the entry was computed.
+        self.col_epoch = z64(tcap)
+        self.global_epoch = 0
         self.tcap = tcap
 
     # -- growth -----------------------------------------------------------
@@ -407,6 +416,7 @@ class _KindState:
             for name in (
                 "thr_cnt", "thr_cnt_present", "used_cnt", "used_cnt_present",
                 "res_cnt", "res_cnt_present", "st_cnt_throttled", "thr_valid",
+                "col_epoch",
             ):
                 arr = getattr(self, name)
                 grown = np.zeros(tcap, dtype=arr.dtype)
@@ -556,6 +566,7 @@ class _KindState:
                 self.st_req_flag_present[col, j] = True
                 self.st_req_throttled[col, j] = flag
         self.thr_valid[col] = True
+        self.col_epoch[col] += 1
         self._note_thr_col(col, before)
         return col
 
@@ -569,6 +580,7 @@ class _KindState:
             self.res_cnt_present[col] = False
             self.res_req[col, :] = 0
             self.res_req_present[col, :] = False
+            self.col_epoch[col] += 1
             self._note_thr_col(col, (self.tcap, self.R))
         return col
 
@@ -578,6 +590,7 @@ class _KindState:
             return
         before = (self.tcap, self.R)
         self._amount_into_row(amount, "res_cnt", "res_cnt_present", "res_req", "res_req_present", col)
+        self.col_epoch[col] += 1
         self._note_thr_col(col, before)
 
     def pod_request_entries(self, pod: Pod) -> List[Tuple[int, int]]:
@@ -1387,6 +1400,11 @@ class DeviceStateManager:
         # finalizer evicts the entry when the pod is collected, and lookups
         # verify identity (`ref() is pod`) against id reuse
         self._encode_cache: Dict[int, tuple] = {}
+        # per-pod-object verdict-FINGERPRINT memo (see verdict_fingerprint):
+        # same id()+weakref discipline as _encode_cache, revalidated against
+        # both indexes' matching generation so a selector/namespace change
+        # can never serve a stale matched-cols set
+        self._fp_memo: Dict[int, tuple] = {}
 
         store.add_event_handler("Namespace", self._on_namespace)
         store.add_event_handler("Pod", self._on_pod)
@@ -1614,6 +1632,12 @@ class DeviceStateManager:
             # aggregate rebase
             self.clusterthrottle.refresh_mask()
             self.clusterthrottle.mark_full_rebase()
+            # ns add/edit/delete can re-route clusterthrottle matching for
+            # any pod (and flips the unknown-ns → ERROR contract), with no
+            # single-col footprint — invalidate every cached verdict whose
+            # key includes clusterthrottle cols (all keys include the
+            # kind's global epoch)
+            self.clusterthrottle.global_epoch += 1
 
     def _on_pod(self, event: Event) -> None:
         if self.store.in_batch_dispatch:
@@ -2361,6 +2385,97 @@ class DeviceStateManager:
         else:
             cache[key] = (ref, ks.R, row_req, row_present)
         return row_req, row_present
+
+    def verdict_fingerprint(self, pod: Pod) -> Optional[Tuple[tuple, int]]:
+        """``(key, epoch_sum)`` for the interned-verdict cache
+        (engine/verdictcache.py), or ``None`` when the pod is uncacheable.
+
+        A PreFilter verdict is a pure function of (request-shape id, accel
+        class, matched cols of both kinds, per-col state): the 4-step check
+        reads nothing else (api/types.py:535-558 — thresholds resolve from
+        WRITTEN status via effective_threshold, never the live clock, so
+        override windows reach verdicts only through status writes, which
+        bump ``col_epoch``). The key is the pure-function domain; the
+        epoch-sum is the state version. Per-col epochs are monotonic, so
+        for a FIXED cols set an equal sum proves elementwise equality —
+        no ABA.
+
+        Uncacheable: no arena (no interned shape ids), or the pod's
+        namespace is unknown to the clusterthrottle index (the oracle
+        answers ERROR there, and an unknown-ns pod would otherwise collide
+        with known-ns pods sharing its (shape, accel, empty-cols) key).
+
+        The (sid, accel, cols) half is memoized per pod OBJECT (scheduler
+        retries re-probe the same Pending pod) and revalidated against both
+        indexes' matching generation — ``_gen`` bumps on every column or
+        namespace mutation, exactly the set of events that can change a
+        pod's matched cols. Epoch reads happen under the main lock, where
+        every bump is performed, so the returned sum is a coherent point in
+        the mutation order."""
+        tks, cks = self.throttle, self.clusterthrottle
+        with self._lock:
+            memo = self._fp_memo.get(id(pod))
+            if memo is not None and memo[0]() is pod:
+                _, key, tcols, ccols, gt, gc = memo
+                if (
+                    gt == tks.index.generation()
+                    and gc == cks.index.generation()
+                ):
+                    esum = tks.global_epoch + cks.global_epoch
+                    if tcols.size:
+                        esum += int(tks.col_epoch[tcols].sum())
+                    if ccols.size:
+                        esum += int(cks.col_epoch[ccols].sum())
+                    return key, esum
+            return self._build_fingerprint_locked(pod)
+
+    def _build_fingerprint_locked(self, pod: Pod) -> Optional[Tuple[tuple, int]]:
+        from ..api.pod import accel_class_of
+
+        tks, cks = self.throttle, self.clusterthrottle
+        arena = tks.arena
+        if arena is None:
+            return None
+        # generations BEFORE the match reads: if a concurrent mutation
+        # lands between them, the memo is stamped with the older gen and
+        # simply rebuilds on the next probe — stale-toward-miss, never
+        # stale-toward-hit
+        gt = tks.index.generation()
+        gc = cks.index.generation()
+        if not cks.index.has_namespace(pod.namespace):
+            return None
+        if pod.__dict__.get("_kt_arena") is arena.token:
+            sid = pod.__dict__["_kt_req_sid"]
+        else:
+            sid = arena.request_shape_id(pod.spec)
+        accel = accel_class_of(pod)
+        cols_by_kind = []
+        esum = 0
+        for ks in (tks, cks):
+            ks.ensure_capacity()
+            prow = ks.index.pod_row(pod.key)
+            if prow is not None:
+                row = ks.index.mask_rows(np.array([prow]))[0]
+            else:
+                with ks.index._lock:  # noqa: SLF001 — same-package access
+                    row = ks.index.match_row_cached_locked(pod) & ks.index._thr_valid
+            n = min(row.shape[0], ks.tcap)
+            cols = np.nonzero(row[:n] & ks.thr_valid[:n])[0]
+            cols_by_kind.append(cols)
+            if cols.size:
+                esum += int(ks.col_epoch[cols].sum())
+            esum += ks.global_epoch
+        tcols, ccols = cols_by_kind
+        key = (sid, accel, tcols.tobytes(), ccols.tobytes())
+        mkey = id(pod)
+        memo_map = self._fp_memo
+        try:
+            ref = weakref.ref(pod, lambda _, k=mkey, c=memo_map: c.pop(k, None))
+        except TypeError:
+            pass  # non-weakref-able stand-ins: skip the memo
+        else:
+            memo_map[mkey] = (ref, key, tcols, ccols, gt, gc)
+        return key, esum
 
     def check_pod(self, pod: Pod, kind: str, on_equal: bool = False) -> Dict[str, str]:
         """Single-pod check → {throttle_key: status_name} over affected

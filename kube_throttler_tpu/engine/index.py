@@ -975,6 +975,24 @@ class SelectorIndex:
         with self._lock:
             return dict(self._thr_cols)
 
+    def generation(self) -> int:
+        """Monotonic matching generation: bumped by every column or
+        namespace mutation (exactly the probe-cache invalidation signal).
+        The verdict cache's per-pod fingerprint memo revalidates against
+        this — a stale memo would key verdicts on an outdated matched-cols
+        set, which is a correctness bug, not just a perf one."""
+        with self._lock:
+            return self._gen
+
+    def has_namespace(self, name: str) -> bool:
+        """Is the Namespace object known to this index? The clusterthrottle
+        oracle answers ERROR for pods of an unknown namespace
+        (clusterthrottle_controller.go:273-276) — the verdict cache must
+        refuse to fingerprint those pods, or they would collide with
+        known-ns pods sharing the same (shape, accel, cols) key."""
+        with self._lock:
+            return name in self._namespaces
+
     @property
     def capacities(self) -> Tuple[int, int]:
         with self._lock:

@@ -805,6 +805,66 @@ class StandbyReplicator:
         return "ok", detail
 
 
+class ReplicaGate:
+    """Staleness gate for the stateless read-replica admission tier.
+
+    A read replica serves ``pre_filter``/``pre_filter_batch`` from its
+    replicated mirror; every verdict is therefore as old as the last
+    journal-tail confirmation. This gate enforces the staleness bound
+    (replica verdict lag ≤ the flip SLO, ``max_lag_s``): a request that
+    arrives while the replica cannot prove it has heard from the leader
+    within the bound is REFUSED (the server answers 503 and the client
+    retries against the owner) instead of served from state that may
+    predate a flip.
+
+    Lag is measured as seconds since the replicator's last successful
+    tail poll (``last_contact_monotonic``): a successful poll drains the
+    leader's accounted tail, so fresh contact means the mirror is within
+    one poll interval of the leader's position. Divergence and
+    pre-bootstrap states count as infinite lag. Counters are
+    single-writer-per-request and read racily by metrics — the same
+    stance as the replicator's own probe stats."""
+
+    def __init__(self, replicator: "StandbyReplicator", max_lag_s: float = 5.0):
+        self.replicator = replicator
+        self.max_lag_s = float(max_lag_s)
+        self._monotonic = time.monotonic  # test injection point
+        self.served_total = 0
+        self.refused_total = 0
+        self.lag_events_total = 0
+
+    def current_lag(self) -> float:
+        """Seconds since the replica last confirmed the leader's tail;
+        +inf before bootstrap or while diverged."""
+        r = self.replicator
+        if r.diverged or not r.bootstrapped or r.last_contact_monotonic is None:
+            return float("inf")
+        return max(0.0, self._monotonic() - r.last_contact_monotonic)
+
+    def admit(self) -> bool:
+        """Gate one serving request: True ⇒ serve, False ⇒ refuse
+        (stale). Counts either way."""
+        if self.current_lag() > self.max_lag_s:
+            self.refused_total += 1
+            self.lag_events_total += 1
+            return False
+        self.served_total += 1
+        return True
+
+    def health_state(self) -> Tuple[str, dict]:
+        lag = self.current_lag()
+        detail = {
+            "role": "replica",
+            "maxLagSeconds": self.max_lag_s,
+            "lagSeconds": (round(lag, 3) if lag != float("inf") else None),
+            "served": self.served_total,
+            "refused": self.refused_total,
+        }
+        if lag > self.max_lag_s:
+            return "down", {**detail, "error": "staleness bound exceeded"}
+        return "ok", detail
+
+
 # --------------------------------------------------------------------------
 # the facade the server/CLI/metrics read
 # --------------------------------------------------------------------------

@@ -164,6 +164,21 @@ METRIC_NAMES = frozenset(
         "kube_throttler_net_rpc_deadline_exceeded_total",
         "kube_throttler_net_send_queue_depth",
         "kube_throttler_net_partition_seconds",
+        # interned-verdict cache (register_verdict_cache_metrics /
+        # engine/verdictcache.py): probe outcomes, live entry count, and
+        # explicit invalidation sweeps — hit-rate is the serving tier's
+        # primary health signal (docs/PERFORMANCE.md "Verdict cache")
+        "kube_throttler_verdict_cache_hits_total",
+        "kube_throttler_verdict_cache_misses_total",
+        "kube_throttler_verdict_cache_entries",
+        "kube_throttler_verdict_cache_invalidations_total",
+        # read-replica admission tier (register_replica_metrics /
+        # engine/replication.py ReplicaGate): verdicts served by role and
+        # requests refused for breaching the staleness bound — the
+        # replica-lag SLO's two signals
+        "kube_throttler_replica_verdicts_total",
+        "kube_throttler_replica_lag_events_total",
+        "kube_throttler_replica_lag_seconds",
     }
 )
 
@@ -1090,6 +1105,82 @@ def register_store_metrics(registry: Registry, store) -> None:
         recycled_c.set_key((), float(arena.recycled_total))
         intern_g.set_key((), float(len(arena.pool)))
         mat_c.set_key((), float(arena.materializations_total))
+
+    registry.register_pre_expose(flush)
+
+
+def register_verdict_cache_metrics(registry: Registry, cache) -> None:
+    """Interned-verdict cache observability (engine/verdictcache.py),
+    sampled from the cache's racy counters at scrape time. Hit-rate
+    (hits / (hits+misses)) is the serving tier's primary health signal:
+    a collapse under steady traffic means epoch churn is outrunning the
+    degenerate-shape assumption. Entries is bounded by the configured
+    capacity; invalidations counts explicit full drops (policy swaps),
+    not epoch-superseded entries (those die silently by construction)."""
+    if cache is None:
+        return
+    hits_c = registry.counter_vec(
+        "kube_throttler_verdict_cache_hits_total",
+        "pre_filter verdicts served straight from the interned-verdict cache",
+        [],
+    )
+    miss_c = registry.counter_vec(
+        "kube_throttler_verdict_cache_misses_total",
+        "cache probes that fell through to a full plane walk "
+        "(cold key, epoch-superseded entry, or uncacheable verdict)",
+        [],
+    )
+    entries_g = registry.gauge_vec(
+        "kube_throttler_verdict_cache_entries",
+        "live entries across both cache generations (bounded by capacity)",
+        [],
+    )
+    inval_c = registry.counter_vec(
+        "kube_throttler_verdict_cache_invalidations_total",
+        "explicit whole-cache invalidation sweeps (policy hot-swaps, "
+        "replica re-bootstraps) — epoch-superseded entries are not counted",
+        [],
+    )
+
+    def flush() -> None:
+        hits, misses, entries, invalidations, _ = cache.stats()
+        hits_c.set_key((), float(hits))
+        miss_c.set_key((), float(misses))
+        entries_g.set_key((), float(entries))
+        inval_c.set_key((), float(invalidations))
+
+    registry.register_pre_expose(flush)
+
+
+def register_replica_metrics(registry: Registry, gate) -> None:
+    """Read-replica serving observability (engine/replication.py
+    ReplicaGate), sampled at scrape time. Verdicts are labeled by outcome
+    ("served" vs "refused") so the SLO dashboard reads refusal-rate
+    directly; lag_events counts requests refused for breaching the
+    staleness bound; lag_seconds is the replica's current journal-tail
+    age (the quantity the bound is enforced against)."""
+    verdicts_c = registry.counter_vec(
+        "kube_throttler_replica_verdicts_total",
+        "pre_filter verdicts handled by this read replica",
+        ["outcome"],
+    )
+    lag_events_c = registry.counter_vec(
+        "kube_throttler_replica_lag_events_total",
+        "serving refusals because replication lag exceeded the staleness bound",
+        [],
+    )
+    lag_g = registry.gauge_vec(
+        "kube_throttler_replica_lag_seconds",
+        "seconds since the replica last confirmed it was caught up with "
+        "the leader's journal tail",
+        [],
+    )
+
+    def flush() -> None:
+        verdicts_c.set_key(("served",), float(gate.served_total))
+        verdicts_c.set_key(("refused",), float(gate.refused_total))
+        lag_events_c.set_key((), float(gate.lag_events_total))
+        lag_g.set_key((), float(gate.current_lag()))
 
     registry.register_pre_expose(flush)
 

@@ -9,6 +9,7 @@ reservations (217-257); EventsToRegister mirrors the requeue hints (263-279).
 from __future__ import annotations
 
 import logging
+import os
 from typing import List, Optional, Sequence
 
 from ..api.pod import Pod
@@ -246,6 +247,22 @@ class KubeThrottler:
             self.health.register("device", self._device_health)
         self.health.register("workqueues", self._workqueue_health)
         self._coalescer = None
+        # interned-verdict cache (engine/verdictcache.py): pre_filter /
+        # pre_filter_batch probe it before any plane walk. Requires the
+        # device manager — the fingerprint reads its epoch planes.
+        # KT_VERDICT_CACHE=0 disables; KT_VERDICT_CACHE_SIZE bounds it.
+        self.verdict_cache = None
+        if (
+            self.device_manager is not None
+            and os.environ.get("KT_VERDICT_CACHE", "1") != "0"
+        ):
+            from ..engine.verdictcache import VerdictCache
+
+            try:
+                capacity = int(os.environ.get("KT_VERDICT_CACHE_SIZE", "65536"))
+            except ValueError:
+                capacity = 65536  # malformed override must not kill serving
+            self.verdict_cache = VerdictCache(capacity=capacity)
         if start_workers:
             self.throttle_ctr.start()
             self.cluster_throttle_ctr.start()
@@ -296,6 +313,27 @@ class KubeThrottler:
             return self._pre_filter(pod)
 
     def _pre_filter(self, pod: Pod) -> Status:
+        cache = self.verdict_cache
+        if cache is None:
+            return self._pre_filter_uncached(pod)
+        fp = self.device_manager.verdict_fingerprint(pod)
+        if fp is None:  # no arena / unknown namespace — uncacheable
+            return self._pre_filter_uncached(pod)
+        key, esum = fp
+        hit = cache.get(key, esum)
+        if hit is not None:
+            return hit
+        status = self._pre_filter_uncached(pod)
+        if self._cacheable(status):
+            # validate-after-compute: re-read the fingerprint and insert
+            # only if no covered mutation landed while we computed — a
+            # racing flip/reservation then suppresses the insert instead
+            # of poisoning the cache (see engine/verdictcache.py)
+            if self.device_manager.verdict_fingerprint(pod) == fp:
+                cache.put(key, esum, status)
+        return status
+
+    def _pre_filter_uncached(self, pod: Pod, emit_events: bool = True) -> Status:
         try:
             thr4 = self.throttle_ctr.check_throttled(pod, False)
         except Exception as e:
@@ -306,9 +344,20 @@ class KubeThrottler:
         except Exception as e:
             return Status(StatusCode.ERROR, (str(e),))
 
-        return self._compose_prefilter_status(pod, thr4, clthr4)
+        return self._compose_prefilter_status(pod, thr4, clthr4, emit_events)
 
-    def _compose_prefilter_status(self, pod: Pod, thr4, clthr4) -> Status:
+    @staticmethod
+    def _cacheable(status: Status) -> bool:
+        """ERROR statuses carry transient causes; exceeds statuses emit a
+        Warning event per PreFilter call (plugin.go:191-201) — a cache hit
+        would swallow the emission. Neither may be interned."""
+        return status.code is not StatusCode.ERROR and not any(
+            "[pod-requests-exceeds-threshold]" in r for r in status.reasons
+        )
+
+    def _compose_prefilter_status(
+        self, pod: Pod, thr4, clthr4, emit_events: bool = True
+    ) -> Status:
         """Reason composition from both kinds' check_throttled 4-tuples —
         ordering mirrors plugin.go:182-214 exactly. Shared by the direct
         path and the micro-batching coalescer (which produces the tuples
@@ -334,7 +383,7 @@ class KubeThrottler:
             reasons.append(
                 f"throttle[pod-requests-exceeds-threshold]={','.join(throttle_names(thr_exceeds))}"
             )
-        if (clthr_exceeds or thr_exceeds) and self.event_recorder is not None:
+        if (clthr_exceeds or thr_exceeds) and emit_events and self.event_recorder is not None:
             names = cluster_throttle_names(clthr_exceeds) + throttle_names(thr_exceeds)
             self.event_recorder.eventf(
                 pod.key,
@@ -379,6 +428,18 @@ class KubeThrottler:
             schedulable: dict = {}
             errors: list = []
             dm = self.device_manager
+            if dm is not None and self.verdict_cache is not None:
+                # intra-batch dedupe: the degenerate mix collapses to a few
+                # hundred (shape, accel, cols) groups — one representative
+                # eval per group replaces the O(P) classification AND warms
+                # the verdict cache for the single-pod serving path in one
+                # pass. Returns None when the mix is NOT degenerate enough
+                # (or too large to fingerprint) — the fused device kernel
+                # is the better batch engine there.
+                with self.tracer.trace("batch_dedupe"):
+                    deduped = self._pre_filter_batch_dedupe(known_ns)
+                if deduped is not None:
+                    return deduped
             if dm is not None:
                 # one coherent device snapshot for BOTH kinds (a single
                 # lock hold inside check_batch_all) — the composed verdict
@@ -417,6 +478,75 @@ class KubeThrottler:
                     continue
                 schedulable[pod.key] = not (ta or ti or te or ca or ci or ce)
             return {"schedulable": schedulable, "errors": errors}
+
+    # dedupe is only attempted below this pod count: fingerprinting is
+    # O(P) host work, and past this scale the fused device kernel wins
+    # even against a perfectly degenerate mix
+    BATCH_DEDUPE_MAX_PODS = 50_000
+
+    def _pre_filter_batch_dedupe(self, known_ns: set) -> Optional[dict]:
+        """Grouped batch triage: pods sharing a verdict fingerprint —
+        (request-shape id, accel class, matched-cols of both kinds) — get
+        ONE side-effect-free representative evaluation (the verdict is a
+        pure function of the fingerprint, the same argument the cache
+        rests on), cache-probed first and inserted after under the
+        validate-after-compute protocol. Returns None to decline (caller
+        falls through to the fused device path): mix not degenerate
+        enough, or too many pods to fingerprint host-side.
+
+        Semantics mirror the host-oracle fallback exactly: side-effect-free
+        (no Warning events), unknown-namespace pods land in ``errors``,
+        ERROR evaluations route every group member to ``errors``."""
+        dm, cache = self.device_manager, self.verdict_cache
+        pods = self.listers.pods.list()
+        if len(pods) > self.BATCH_DEDUPE_MAX_PODS:
+            return None
+        groups: dict = {}
+        loners: list = []
+        for pod in pods:
+            fp = dm.verdict_fingerprint(pod)
+            if fp is None:
+                loners.append(pod)
+                continue
+            g = groups.get(fp[0])
+            if g is None:
+                groups[fp[0]] = g = (fp[1], [])
+            g[1].append(pod)
+        if len(pods) > 256 and len(groups) * 2 > len(pods):
+            return None  # not degenerate — grouping bought nothing
+        schedulable: dict = {}
+        errors: list = []
+        for key, (esum, members) in groups.items():
+            status = cache.get(key, esum)
+            if status is None:
+                rep = members[0]
+                status = self._pre_filter_uncached(rep, emit_events=False)
+                if self._cacheable(status) and dm.verdict_fingerprint(rep) == (
+                    key,
+                    esum,
+                ):
+                    cache.put(key, esum, status)
+            if status.code is StatusCode.ERROR:
+                errors.extend(p.key for p in members)
+            else:
+                ok = status.code is StatusCode.SUCCESS
+                for p in members:
+                    schedulable[p.key] = ok
+        for pod in loners:
+            # no fingerprint ⇒ no arena (shouldn't happen here — the route
+            # requires a device manager) or unknown namespace; mirror the
+            # key-derived routing of _merge_verdicts
+            if pod.namespace not in known_ns:
+                errors.append(pod.key)
+                continue
+            try:
+                ta, ti, te, _ = self.throttle_ctr.check_throttled(pod, False)
+                ca, ci, ce, _ = self.cluster_throttle_ctr.check_throttled(pod, False)
+            except Exception:
+                errors.append(pod.key)
+                continue
+            schedulable[pod.key] = not (ta or ti or te or ca or ci or ce)
+        return {"schedulable": schedulable, "errors": errors}
 
     @staticmethod
     def _merge_verdicts(per_kind: dict, known_ns: set):
@@ -723,7 +853,13 @@ class KubeThrottler:
             s if isinstance(s, PolicySpec) else policy_spec_from_dict(s)
             for s in specs
         ]
-        return self.policy.set_specs(decoded)
+        gen = self.policy.set_specs(decoded)
+        # policy swaps reach verdicts through reconcile status writes
+        # (epoch-covered), but drop everything eagerly anyway — a swap is
+        # rare and the repopulation cost is one miss per live key
+        if self.verdict_cache is not None:
+            self.verdict_cache.invalidate_all()
+        return gen
 
     def maybe_preempt_gang(self, group_key: str, pods: Sequence[Pod]) -> bool:
         """Gang-aware preemption entry (scheduler._schedule_gang calls

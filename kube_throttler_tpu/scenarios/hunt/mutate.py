@@ -372,6 +372,27 @@ def _mut_leader_kill(scn: Scenario, rng: random.Random):
     return replace(scn, leader_kill=not scn.leader_kill)
 
 
+def _mut_epoch_churn(scn: Scenario, rng: random.Random):
+    """Epoch-churn/cache axis (PR 17's interned-verdict cache): push the
+    op mix toward throttle-SPEC edits (every edit bumps the covered cols'
+    epochs, invalidating cached verdicts) while collapsing the group
+    count toward the degenerate-shape regime where the cache serves most
+    decisions. Jointly this is the adversarial shape for a stale-verdict
+    bug — maximal cache hit traffic under maximal invalidation pressure —
+    and the existing zero-wrong-verdicts sweep is the judge (the serving
+    plugin replays WITH its cache; the oracle rebuild recomputes)."""
+    lo, _hi = BOUNDS["groups"]
+    groups = max(lo, scn.topology.groups // rng.choice([4, 8, 16]))
+    spec_w = rng.choice([0.25, 0.4, 0.6])
+    rest = {k: w for k, w in scn.mix if k != "spec"}
+    total = sum(rest.values()) or 1.0
+    mix = tuple(
+        [(k, round(w / total * (1.0 - spec_w), 4)) for k, w in rest.items()]
+        + [("spec", round(spec_w, 4))]
+    )
+    return replace(scn, topology=replace(scn.topology, groups=groups), mix=mix)
+
+
 def _draw_fault(scn: Scenario, rng: random.Random) -> FaultSpec:
     site = sorted(MUTABLE_FAULT_SITES)[rng.randrange(len(MUTABLE_FAULT_SITES))]
     mode = rng.choice(MUTABLE_FAULT_SITES[site])
@@ -466,9 +487,15 @@ MUTATORS: List[Tuple[str, Callable[[Scenario, random.Random], Optional[Scenario]
     ("preempt_shape", _mut_preempt_shape),
     ("pattern", _mut_pattern),
     ("mix", _mut_mix),
+    ("epoch_churn", _mut_epoch_churn),
     ("leader_kill", _mut_leader_kill),
     ("fault_insert", _mut_fault_insert),
-    ("fault_insert2", _mut_fault_insert),  # double weight: faults are the point
+    # fault insertion carries triple weight: faults are the point, and the
+    # structural axes above (gang/accel/priority/epoch-churn) would
+    # otherwise dilute the draw below the discovery rate the seeded
+    # planted-bug search budget assumes
+    ("fault_insert2", _mut_fault_insert),
+    ("fault_insert3", _mut_fault_insert),
     ("fault_remove", _mut_fault_remove),
     ("fault_move", _mut_fault_move),
     ("fault_widen", _mut_fault_widen),

@@ -75,6 +75,8 @@ class ThrottlerHTTPServer:
         remote: bool = False,
         ha=None,
         metrics_registry=None,
+        replica_gate=None,
+        owner_url: Optional[str] = None,
     ):
         """``remote=True`` (daemon synced from a real apiserver via
         reflectors) disables the local object-mutation endpoints: a local
@@ -94,12 +96,24 @@ class ThrottlerHTTPServer:
         ``metrics_registry`` makes ``/metrics`` scrapeable BEFORE the
         plugin exists — a standby's replication lag is exactly the metric
         that only matters pre-promotion; falls back to the plugin's
-        registry when absent (they are the same object in the daemon)."""
+        registry when absent (they are the same object in the daemon).
+
+        ``replica_gate`` (an engine.replication.ReplicaGate) + ``owner_url``
+        is READ-REPLICA mode: /v1/prefilter and /v1/prefilter-batch are
+        served LOCALLY from the replicated mirror — gated on the staleness
+        bound (503 when the replica cannot prove freshness) — and every
+        write surface (/v1/objects, reserve/unreserve, bind, tick, DELETE)
+        is transparently forwarded to the owner, so a client can point at
+        either tier without caring which one it hit."""
         if plugin is None and ha is None:
             raise ValueError("plugin-less server requires an HA coordinator")
+        if replica_gate is not None and (plugin is None or not owner_url):
+            raise ValueError("replica mode requires a plugin and an owner URL")
         self.plugin = plugin
         self.remote = remote
         self.ha = ha
+        self.replica_gate = replica_gate
+        self.owner_url = owner_url
         self.metrics_registry = (
             metrics_registry
             if metrics_registry is not None
@@ -265,6 +279,11 @@ class ThrottlerHTTPServer:
             if self.ha is not None:
                 body["role"] = self.ha.role
                 body["epoch"] = self.ha.epoch.current()
+            if self.replica_gate is not None:
+                # the gate's component (registered on plugin.health by the
+                # CLI) already drives state: a stale replica reports down,
+                # so probes stop routing admission traffic here
+                body["role"] = "replica"
             h._send(200 if snap["state"] != "down" else 503, body)
         elif h.path == "/v1/throttles":
             h._send(200, [_throttle_to_dict(t) for t in self.listers.throttles.list()])
@@ -301,11 +320,66 @@ class ThrottlerHTTPServer:
         "objects on the cluster instead"
     )
 
+    _REPLICA_READ_PATHS = ("/v1/prefilter", "/v1/prefilter-batch")
+
+    def _forward_to_owner(self, h, method: str, body: Optional[dict]) -> None:
+        """Relay a write-surface request to the owner and stream its answer
+        back verbatim. The replica adds one hop of latency to writes — the
+        price of letting clients stay owner-oblivious; reads never forward."""
+        from http.client import HTTPConnection, HTTPException
+        from urllib.parse import urlsplit
+
+        split = urlsplit(self.owner_url)
+        conn = HTTPConnection(
+            split.hostname or "127.0.0.1", split.port or 80, timeout=10.0
+        )
+        try:
+            payload = json.dumps(body or {}).encode()
+            conn.request(
+                method,
+                h.path,
+                body=payload if method != "DELETE" else None,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+        except (OSError, HTTPException) as e:
+            h._send(502, {"error": f"owner unreachable: {e}"})
+            return
+        finally:
+            conn.close()
+        h.send_response(resp.status)
+        h.send_header(
+            "Content-Type", resp.getheader("Content-Type") or "application/json"
+        )
+        h.send_header("Content-Length", str(len(data)))
+        h.send_header("X-KT-Forwarded-By", "replica")
+        h.end_headers()
+        h.wfile.write(data)
+
     def _post(self, h) -> None:
         if self.plugin is None:
             h._send(503, {"error": "standby replica; not serving yet"})
             return
         body = h._body()
+        if self.replica_gate is not None:
+            if h.path not in self._REPLICA_READ_PATHS:
+                # every write surface belongs to the owner — forward
+                self._forward_to_owner(h, "POST", body)
+                return
+            if not self.replica_gate.admit():
+                # staleness bound breached: refusing beats serving a
+                # verdict that may predate a flip — the client retries
+                # against the owner (or another replica)
+                h._send(
+                    503,
+                    {
+                        "error": "replica stale: replication lag exceeds "
+                        "the staleness bound",
+                        "maxLagSeconds": self.replica_gate.max_lag_s,
+                    },
+                )
+                return
         if self.remote and h.path in ("/v1/objects", "/v1/bind"):
             h._send(409, {"error": self._REMOTE_REFUSAL})
             return
@@ -396,6 +470,9 @@ class ThrottlerHTTPServer:
     def _delete(self, h) -> None:
         if self.plugin is None:
             h._send(503, {"error": "standby replica; not serving yet"})
+            return
+        if self.replica_gate is not None:
+            self._forward_to_owner(h, "DELETE", None)
             return
         if self.remote:
             h._send(409, {"error": self._REMOTE_REFUSAL})

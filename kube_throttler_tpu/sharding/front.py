@@ -46,11 +46,12 @@ so no flip is lost.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
-from ..api.pod import Namespace, Pod
+from ..api.pod import Namespace, Pod, accel_class_of
 from ..engine.index import SelectorIndex
 from ..engine.store import Event, EventType, NotFoundError, Store, key_of
 from ..health import Health
@@ -94,6 +95,8 @@ class AdmissionFront:
         "_txn_seq": "self._txn_lock",
         "route_misses": "self._route_lock",
         "two_phase_aborts": "self._txn_lock",
+        "_epochs": "self._route_lock",
+        "_global_epoch": "self._route_lock",
     }
 
     def __init__(
@@ -145,6 +148,17 @@ class AdmissionFront:
         self._txn_seq = 0
         self.route_misses = 0  # events destined for a down shard
         self.two_phase_aborts = 0  # single-writer per call path; approximate
+        # front-side verdict epochs (the scatter-tier mirror of the
+        # engine's col_epoch plane, engine/verdictcache.py): one counter
+        # per routed throttle key, bumped by every event that can change
+        # that key's verdict — spec routes, status echoes/pushes, and the
+        # two-phase reservation ops (which mutate shard state without a
+        # throttle event). Namespace/reshard/resync mutations bump the
+        # global counter. Entries are never popped, even on delete: a
+        # re-created key restarting at zero could replay an old epoch sum
+        # and falsely validate a pre-delete cache entry (ABA)
+        self._epochs: Dict[Tuple[str, str], int] = {}
+        self._global_epoch = 0
         # routing index: one SelectorIndex per kind, front-side only. With
         # the columnar merged store the indexes share its intern pool and
         # retain NO pod objects (resolved through the arena below) — this
@@ -159,6 +173,19 @@ class AdmissionFront:
         if _arena is not None:
             for idx in self.index.values():
                 idx.pod_resolver = self.store.materialize_pod
+        # interned-verdict cache over the scatter path: a hit skips the
+        # whole fan-out (RPC round trips, not just a plane walk). Only
+        # available with the columnar store — the request-shape id that
+        # keys it lives in the arena's intern pool
+        self.verdict_cache = None
+        if _arena is not None and os.environ.get("KT_VERDICT_CACHE", "1") != "0":
+            from ..engine.verdictcache import VerdictCache
+
+            try:
+                capacity = int(os.environ.get("KT_VERDICT_CACHE_SIZE", "65536"))
+            except ValueError:
+                capacity = 65536  # malformed override must not kill serving
+            self.verdict_cache = VerdictCache(capacity=capacity)
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, self.n_shards), thread_name_prefix="front-scatter"
         )
@@ -212,6 +239,9 @@ class AdmissionFront:
         """Register (or replace, after a restart) a shard handle. With
         ``resync`` the shard is replayed its full keyspace slice first."""
         self.shards[shard_id] = handle
+        # a (re)attached shard serves from replayed state: cached verdicts
+        # computed against its predecessor must not validate
+        self._bump_global_epoch()
         if resync:
             self.resync_shard(shard_id)
 
@@ -246,6 +276,64 @@ class AdmissionFront:
         if down or any(v == "degraded" for v in detail.values()):
             return "degraded", detail
         return "ok", detail
+
+    # ------------------------------------------------- verdict-cache epochs
+
+    def _bump_key_epoch(self, kind: str, key: str) -> None:
+        with self._route_lock:
+            self._epochs[(kind, key)] = self._epochs.get((kind, key), 0) + 1
+
+    def _bump_global_epoch(self) -> None:
+        with self._route_lock:
+            self._global_epoch += 1
+
+    def _bump_pod_epochs(self, pod: Pod) -> None:
+        """Bump every throttle key the pod matches — the reservation ops
+        change shard-side reserved amounts without any throttle event
+        flowing through the Router, so they invalidate here explicitly."""
+        with self._route_lock:
+            for kind in _KINDS:
+                for key in self.index[kind].affected_throttle_keys_for(pod):
+                    self._epochs[(kind, key)] = self._epochs.get((kind, key), 0) + 1
+
+    def _verdict_fingerprint(self, pod: Pod):
+        """(cache key, epoch sum) for a pod, or None when uncacheable
+        (no arena, or a live reshard is re-pointing owners). The key is
+        (request-shape id, accel class, matched throttle keys); the sum
+        covers exactly those keys plus the global counter, so monotonic
+        bumps make equality prove nothing relevant changed (the same
+        argument as DeviceStateManager.verdict_fingerprint)."""
+        arena = getattr(self.store, "pod_arena", None)
+        if arena is None:
+            return None
+        if pod.__dict__.get("_kt_arena") is arena.token:
+            sid = pod.__dict__["_kt_req_sid"]
+        else:
+            sid = arena.request_shape_id(pod.spec)
+        accel = accel_class_of(pod)
+        matched: List[Tuple[str, str]] = []
+        with self._route_lock:
+            if self._transition is not None:
+                return None
+            esum = self._global_epoch
+            for kind in _KINDS:
+                for key in sorted(self.index[kind].affected_throttle_keys_for(pod)):
+                    matched.append((kind, key))
+                    esum += self._epochs.get((kind, key), 0)
+        return (sid, accel, tuple(matched)), esum
+
+    @staticmethod
+    def _front_cacheable(status: Status) -> bool:
+        """ERROR verdicts, fail-safe shard-down verdicts, and exceeds
+        verdicts (which emit a Warning event per call — a hit would
+        swallow the emission) never enter the cache."""
+        if status.code is StatusCode.ERROR:
+            return False
+        return not any(
+            "[pod-requests-exceeds-threshold]" in r
+            or r.startswith("shard[unavailable]")
+            for r in status.reasons
+        )
 
     # ------------------------------------------------------ routing (Router)
 
@@ -288,6 +376,9 @@ class AdmissionFront:
 
     def _route_namespace(self, event: Event, buffers) -> None:
         ns: Namespace = event.obj
+        # namespace changes alter selector matching (and the unknown-ns
+        # ERROR verdict) for arbitrary pods: global invalidation
+        self._bump_global_epoch()
         if event.type is EventType.DELETED:
             for idx in self.index.values():
                 idx.remove_namespace(ns.name)
@@ -307,6 +398,11 @@ class AdmissionFront:
         key = thr.key
         store_key = key_of(kind, thr)
         idx = self.index[kind]
+        # EVERY throttle event — spec route, delete, or a shard's status
+        # echo/push streaming back — can change this key's verdict
+        # (status flips carry the active/insufficient transitions), so
+        # every path through here bumps its epoch
+        self._bump_key_epoch(kind, key)
         if event.type is EventType.DELETED:
             with self._route_lock:
                 owner = self._owner.pop((kind, key), None)
@@ -501,6 +597,23 @@ class AdmissionFront:
         if not targets:
             vlog(5, "pod %s is not throttled by any throttle/clusterthrottle (0 shards)", pod.key)
             return Status(StatusCode.SUCCESS)
+        # interned-verdict probe: a hit skips the whole scatter. Gated on
+        # every target shard being alive and clean — a cached SUCCESS must
+        # not outlive the fail-safe discipline (shard death bumps no
+        # epoch), and a dirty shard's answers are stale until resync
+        cache = self.verdict_cache
+        fp = None
+        if cache is not None:
+            for s in targets:
+                handle = self._alive(s)
+                if handle is None or handle.is_dirty():
+                    break
+            else:
+                fp = self._verdict_fingerprint(pod)
+        if fp is not None:
+            hit = cache.get(fp[0], fp[1])
+            if hit is not None:
+                return hit
         results = self._scatter(targets, "pre_filter", pod)
         down = sorted(
             sid for sid, r in results.items() if isinstance(r, ShardUnavailable)
@@ -531,7 +644,17 @@ class AdmissionFront:
                     merged[kind][cat].update(keys)
         if errors:
             return Status(StatusCode.ERROR, tuple(sorted(set(errors))))
-        return self._compose_status(pod, merged)
+        status = self._compose_status(pod, merged)
+        if (
+            fp is not None
+            and self._front_cacheable(status)
+            # validate-after-compute: a mutation that raced the scatter
+            # bumped an epoch, so the re-read sum differs and the insert
+            # is suppressed instead of poisoning the cache
+            and self._verdict_fingerprint(pod) == fp
+        ):
+            cache.put(fp[0], fp[1], status)
+        return status
 
     def _compose_status(self, pod: Pod, merged) -> Status:
         """Reason composition in the exact plugin.go:182-214 order, from
@@ -681,6 +804,10 @@ class AdmissionFront:
                 with self._txn_lock:
                     self.two_phase_aborts += 1
                 self._m_aborts.inc({})
+                # the abort rolled prepared shards back, but bump anyway:
+                # invalidating a still-valid entry costs one recompute;
+                # missing a real change costs a wrong verdict
+                self._bump_pod_epochs(pod)
                 return Status(
                     StatusCode.ERROR,
                     tuple(
@@ -689,6 +816,7 @@ class AdmissionFront:
                     ),
                 )
             self._scatter(targets, "txn_commit", {"txn": txn})
+            self._bump_pod_epochs(pod)
             return Status(StatusCode.SUCCESS)
 
     def unreserve(self, pod: Pod, node: str = "") -> None:
@@ -701,6 +829,7 @@ class AdmissionFront:
                 if isinstance(r, Exception):
                     logger.warning("unreserve of %s on shard %d failed: %s",
                                    pod.key, sid, r)
+            self._bump_pod_epochs(pod)
 
     # -------------------------------------------------------- gang admission
 
@@ -802,6 +931,8 @@ class AdmissionFront:
                 with self._txn_lock:
                     self.two_phase_aborts += 1
                 self._m_aborts.inc({})
+                for p in pods:
+                    self._bump_pod_epochs(p)
                 return Status(
                     StatusCode.ERROR,
                     tuple(
@@ -812,6 +943,8 @@ class AdmissionFront:
             self._scatter(targets, "txn_commit", {"txn": txn})
             with self._txn_lock:
                 self._gang_routes[group_key] = tuple(targets)
+            for p in pods:
+                self._bump_pod_epochs(p)
             return Status(StatusCode.SUCCESS)
 
     def unreserve_gang(self, group_key: str) -> None:
@@ -824,6 +957,9 @@ class AdmissionFront:
                     if self._alive(sid) is not None
                 ]
             self._scatter(list(targets), "gang_rollback", {"group": group_key})
+            # the rolled-back members are unknown here (the ledger lives
+            # shard-side): global invalidation
+            self._bump_global_epoch()
 
     # ------------------------------------------------------ live resharding
     # (driven by sharding/reshard.ReshardCoordinator; every mutation of
@@ -836,6 +972,10 @@ class AdmissionFront:
         (old-ring owner until the covering range cuts over)."""
         with self._route_lock:
             self._transition = transition
+            # every reshard phase bumps the global verdict epoch inline
+            # (already under the route lock): cached verdicts predate the
+            # ownership moves and must not validate across them
+            self._global_epoch += 1
 
     def begin_range(self, move: RangeMove) -> int:
         """Turn double-routing ON for one moving range: every owned key
@@ -866,6 +1006,7 @@ class AdmissionFront:
                     self._owner[(kind, key)] = dst
                     del self._mirror[(kind, key)]
                     n += 1
+            self._global_epoch += 1
         return n
 
     def abort_range(self, move: RangeMove) -> int:
@@ -889,6 +1030,7 @@ class AdmissionFront:
             self.ring = new_ring
             self._transition = None
             self._mirror.clear()
+            self._global_epoch += 1
         self.n_shards = int(n_shards)
 
     def cancel_reshard(self) -> None:
@@ -897,6 +1039,7 @@ class AdmissionFront:
         with self._route_lock:
             self._transition = None
             self._mirror.clear()
+            self._global_epoch += 1
 
     def reshard_state(self) -> Optional[Dict[str, object]]:
         with self._route_lock:
@@ -939,7 +1082,11 @@ class AdmissionFront:
         # iterate sits BEFORE the (older) snapshot in the queue and the
         # worker keeps the stale object forever.
         with self.store.atomic():
-            return self._resync_locked(shard_id, handle)
+            n = self._resync_locked(shard_id, handle)
+        # the healed shard recomputes everything from the replay; cached
+        # verdicts from before the heal must not validate
+        self._bump_global_epoch()
+        return n
 
     def _resync_locked(self, shard_id: int, handle) -> int:
         ops: List[tuple] = []

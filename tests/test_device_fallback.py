@@ -60,6 +60,10 @@ def stack():
         )
     )
     plugin.run_pending_once()
+    # these tests count device dispatches to drive the circuit breaker —
+    # the interned-verdict cache would (correctly) serve repeats without
+    # dispatching at all, so it must sit out
+    plugin.verdict_cache = None
     return store, plugin
 
 
