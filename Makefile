@@ -13,9 +13,10 @@ test:            ## unit + kernel + integration tiers (8-device virtual CPU mesh
 test-stress:     ## only the stress/concurrency tier
 	$(PY) -m pytest tests/test_stress.py -q
 
-race-test:       ## lockset race detector gate: planted races MUST fire (file:line asserts) + detector-armed concurrency smoke + runtime retrace budget; the full suite runs armed anyway (conftest KT_RACE_DETECT=1)
-	env JAX_PLATFORMS=cpu KT_RACE_DETECT=1 KT_LOCK_ASSERT=1 $(PY) -m pytest \
+race-test:       ## runtime-detector gate: planted races + planted stale verdicts MUST fire (file:line asserts) + detector-armed concurrency smoke + runtime retrace budget; the full suite runs armed anyway (conftest KT_RACE_DETECT=1 KT_EPOCH_ASSERT=1)
+	env JAX_PLATFORMS=cpu KT_RACE_DETECT=1 KT_LOCK_ASSERT=1 KT_EPOCH_ASSERT=1 $(PY) -m pytest \
 		tests/test_racedetect.py tests/test_retrace.py \
+		tests/test_epochassert.py \
 		tests/test_lockorder.py tests/test_concurrent_check.py \
 		-q -p no:cacheprovider
 
@@ -75,7 +76,7 @@ scenario-hunt-nightly: ## nightly cadence (hack/ci.sh comments): the long tier a
 		--budget-s 7200 --iterations 30 --mega-pods 1000000 \
 		--report hunt-nightly-report.json
 
-lint:            ## 12-checker static analyzer (locks, purity, registries, blocking, threads, excsafety, protocol, dtype, donation, retrace, envguard) + syntax sanity
+lint:            ## 15-checker static analyzer (locks, purity, registries, blocking, threads, excsafety, protocol, dtype, donation, retrace, envguard, epochs, deadlines, taint) + syntax sanity
 	$(PY) -m compileall -q kube_throttler_tpu tools bench.py __graft_entry__.py
 	$(PY) -m kube_throttler_tpu.analysis
 

@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # CI gate: `make ci`. Static analysis failures fail CI, not review —
-# the analyzer (12 checkers + the stale-waiver gate) runs first, then a
+# the analyzer (15 checkers + the stale-waiver gate) runs first, then a
 # fast smoke tier that proves the analyzer, the runtime lock assassin,
-# and the gen-3 lockset race detector themselves work (planted races
+# the gen-3 lockset race detector, and the gen-4 verdict-coherence
+# assassin themselves work (planted races and planted stale verdicts
 # must fire). The full tier-1 suite stays `make test` (race-armed via
 # conftest); this script is the cheap always-on gate (<~2 min).
 #
@@ -22,7 +23,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint: compileall + 12-checker static analysis + stale-waiver gate =="
+echo "== lint: compileall + 15-checker static analysis + stale-waiver gate =="
 make lint
 
 echo "== smoke: analyzer fixtures, lock assassin + hold budgets, journal =="

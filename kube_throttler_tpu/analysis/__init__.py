@@ -1,7 +1,9 @@
 """Repo-native static analyzer: lock discipline, JAX trace purity,
 string-keyed registry consistency, (second generation) blocking-
 under-lock, thread-lifecycle, exception-safety, cross-process protocol
-checking, and (third generation) device-kernel contract checking.
+checking, (third generation) device-kernel contract checking, and
+(fourth generation) verdict-epoch coherence, transport deadline
+discipline, and trust-boundary taint checking.
 
 Run as ``python -m kube_throttler_tpu.analysis`` (or ``make lint``).
 Checkers:
@@ -22,12 +24,22 @@ Checkers:
 - ``retrace``   — jit entries see only padded/static shapes (retrace.py)
 - ``envguard``  — numeric ``KT_*`` env parses need try/except guards
   (envguard.py)
+- ``epochs``    — (fourth generation) verdict-epoch coherence: every
+  write to a declared verdict-affecting plane is dominated by an epoch
+  bump (epochs.py)
+- ``deadlines`` — blocking socket/RPC ops reached from the
+  sharding/replication transports carry a timeout (deadlines.py)
+- ``taint``     — trust-boundary taint: network bytes pass the
+  ``hmac.compare_digest`` gate before ``pickle.loads``/``json.loads``
+  (taint.py)
 
 The runtime counterparts — the instrumented-lock assassin and hold-time
 budgets (``KT_LOCK_ASSERT=1``, ``utils/lockorder.py``), the Eraser-style
 lockset race detector (``KT_RACE_DETECT=1``, ``utils/racedetect.py``),
-and the per-entry XLA recompile budget (``KT_JIT_RETRACE_BUDGET``,
-``utils/retrace.py``). See docs/STATIC_ANALYSIS.md.
+the per-entry XLA recompile budget (``KT_JIT_RETRACE_BUDGET``,
+``utils/retrace.py``), and the verdict-coherence assassin
+(``KT_EPOCH_ASSERT=1``, ``utils/epochassert.py``). See
+docs/STATIC_ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -37,9 +49,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import (
     blocking,
+    deadlines,
     device,
     donation,
     envguard,
+    epochs,
     excsafety,
     guarded,
     lockgraph,
@@ -47,6 +61,7 @@ from . import (
     purity,
     registry,
     retrace,
+    taint,
     threads,
 )
 from .core import Finding, Module, apply_baseline, load_baseline, load_package
@@ -56,6 +71,10 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "lockorder_allow.txt")
 DEFAULT_BLOCKING_ALLOWLIST = os.path.join(
     os.path.dirname(__file__), "blocking_allow.txt"
+)
+DEFAULT_EPOCH_ALLOWLIST = os.path.join(os.path.dirname(__file__), "epoch_allow.txt")
+DEFAULT_DEADLINE_ALLOWLIST = os.path.join(
+    os.path.dirname(__file__), "deadline_allow.txt"
 )
 
 CHECKERS = (
@@ -71,6 +90,9 @@ CHECKERS = (
     "donation",
     "retrace",
     "envguard",
+    "epochs",
+    "deadlines",
+    "taint",
 )
 
 
@@ -79,6 +101,8 @@ def run_checks(
     checks: Sequence[str] = CHECKERS,
     allowlist_path: Optional[str] = DEFAULT_ALLOWLIST,
     blocking_allowlist_path: Optional[str] = DEFAULT_BLOCKING_ALLOWLIST,
+    epoch_allowlist_path: Optional[str] = DEFAULT_EPOCH_ALLOWLIST,
+    deadline_allowlist_path: Optional[str] = DEFAULT_DEADLINE_ALLOWLIST,
     stale_allow_out: Optional[Dict[str, List[Tuple[str, str]]]] = None,
 ) -> List[Finding]:
     """Run the selected checkers over ``modules``. ``stale_allow_out``
@@ -127,6 +151,28 @@ def run_checks(
         findings.extend(retrace.check(modules))
     if "envguard" in checks:
         findings.extend(envguard.check(modules))
+    if "epochs" in checks:
+        stale = (
+            stale_allow_out.setdefault("epochs", [])
+            if stale_allow_out is not None
+            else None
+        )
+        findings.extend(
+            epochs.check(modules, allowlist_path=epoch_allowlist_path, stale_out=stale)
+        )
+    if "deadlines" in checks:
+        stale = (
+            stale_allow_out.setdefault("deadlines", [])
+            if stale_allow_out is not None
+            else None
+        )
+        findings.extend(
+            deadlines.check(
+                modules, allowlist_path=deadline_allowlist_path, stale_out=stale
+            )
+        )
+    if "taint" in checks:
+        findings.extend(taint.check(modules))
     findings.sort(key=lambda f: (f.relpath or f.path, f.line, f.checker, f.message))
     return findings
 
@@ -137,6 +183,8 @@ def run_repo(
     baseline_path: Optional[str] = DEFAULT_BASELINE,
     allowlist_path: Optional[str] = DEFAULT_ALLOWLIST,
     blocking_allowlist_path: Optional[str] = DEFAULT_BLOCKING_ALLOWLIST,
+    epoch_allowlist_path: Optional[str] = DEFAULT_EPOCH_ALLOWLIST,
+    deadline_allowlist_path: Optional[str] = DEFAULT_DEADLINE_ALLOWLIST,
     stale_allow_out: Optional[Dict[str, List[Tuple[str, str]]]] = None,
 ):
     """(new, waived, stale) findings for the package at ``root``."""
@@ -146,6 +194,8 @@ def run_repo(
         checks,
         allowlist_path,
         blocking_allowlist_path=blocking_allowlist_path,
+        epoch_allowlist_path=epoch_allowlist_path,
+        deadline_allowlist_path=deadline_allowlist_path,
         stale_allow_out=stale_allow_out,
     )
     baseline = load_baseline(baseline_path) if baseline_path else {}

@@ -19,6 +19,8 @@ from . import (
     DEFAULT_ALLOWLIST,
     DEFAULT_BASELINE,
     DEFAULT_BLOCKING_ALLOWLIST,
+    DEFAULT_DEADLINE_ALLOWLIST,
+    DEFAULT_EPOCH_ALLOWLIST,
     PACKAGE_ROOT,
     run_checks,
 )
@@ -32,7 +34,7 @@ def main(argv=None) -> int:
         description=(
             "lock discipline / JAX purity / registry / blocking / thread / "
             "exception-safety / protocol / dtype / donation / retrace / "
-            "envguard static analyzer"
+            "envguard / epochs / deadlines / taint static analyzer"
         ),
     )
     ap.add_argument("--root", default=PACKAGE_ROOT, help="package root to analyze")
@@ -44,6 +46,8 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--allowlist", default=None)
     ap.add_argument("--blocking-allowlist", default=None)
+    ap.add_argument("--epoch-allowlist", default=None)
+    ap.add_argument("--deadline-allowlist", default=None)
     ap.add_argument(
         "--no-baseline",
         action="store_true",
@@ -90,12 +94,24 @@ def main(argv=None) -> int:
     enforce_stale = {
         "lockorder": root_is_default or args.allowlist is not None,
         "blocking": root_is_default or args.blocking_allowlist is not None,
+        "epochs": root_is_default or args.epoch_allowlist is not None,
+        "deadlines": root_is_default or args.deadline_allowlist is not None,
     }
     allowlist = args.allowlist if args.allowlist is not None else DEFAULT_ALLOWLIST
     blocking_allowlist = (
         args.blocking_allowlist
         if args.blocking_allowlist is not None
         else DEFAULT_BLOCKING_ALLOWLIST
+    )
+    epoch_allowlist = (
+        args.epoch_allowlist
+        if args.epoch_allowlist is not None
+        else DEFAULT_EPOCH_ALLOWLIST
+    )
+    deadline_allowlist = (
+        args.deadline_allowlist
+        if args.deadline_allowlist is not None
+        else DEFAULT_DEADLINE_ALLOWLIST
     )
 
     stale_allow: dict = {}
@@ -104,6 +120,8 @@ def main(argv=None) -> int:
         checks,
         allowlist_path=allowlist,
         blocking_allowlist_path=blocking_allowlist,
+        epoch_allowlist_path=epoch_allowlist,
+        deadline_allowlist_path=deadline_allowlist,
         stale_allow_out=stale_allow,
     )
     stale_allow = {
@@ -123,7 +141,12 @@ def main(argv=None) -> int:
         print(f"wrote {len(new)} new waiver(s) to {args.baseline}", file=sys.stderr)
         return 0
 
-    allow_paths = {"lockorder": allowlist, "blocking": blocking_allowlist}
+    allow_paths = {
+        "lockorder": allowlist,
+        "blocking": blocking_allowlist,
+        "epochs": epoch_allowlist,
+        "deadlines": deadline_allowlist,
+    }
     n_stale_allow = sum(len(v) for v in stale_allow.values())
     if args.prune_stale:
         pruned = 0

@@ -153,7 +153,25 @@ def _attr_base_chain(expr: ast.AST) -> Optional[str]:
     return None
 
 
+# (id(module), id(cls)) -> _ClassInfo. Module objects are themselves
+# memoized across checker runs (core._PARSE_CACHE), so identity is a
+# stable key within one process: five checkers (lockorder, blocking,
+# guarded, epochs, deadlines) walk the same class bodies — collecting
+# once keeps full-repo `make lint` inside its 15s budget.
+_CLASS_INFO_CACHE: dict = {}
+
+
 def _collect_class_info(module: Module, cls: ast.ClassDef) -> _ClassInfo:
+    key = (id(module), id(cls))
+    cached = _CLASS_INFO_CACHE.get(key)
+    if cached is not None and cached.module is module and cached.cls is cls:
+        return cached
+    info = _collect_class_info_uncached(module, cls)
+    _CLASS_INFO_CACHE[key] = info
+    return info
+
+
+def _collect_class_info_uncached(module: Module, cls: ast.ClassDef) -> _ClassInfo:
     info = _ClassInfo(module, cls)
     # class-level lock attributes
     for node in cls.body:

@@ -35,6 +35,27 @@ from .plugin.framework import RecordingEventRecorder
 from .server import ThrottlerHTTPServer
 
 
+def _positive_seconds(allow_inf: bool):
+    """argparse type for duration knobs: ``float`` alone accepts 'nan'
+    (which disables every `>` comparison downstream — the replica gate
+    would fail OPEN) and negatives. Reject both at the parse boundary so
+    the operator gets a usage error, not a silently-dead gate."""
+
+    def parse(text: str) -> float:
+        try:
+            v = float(text)
+        except ValueError:
+            raise argparse.ArgumentTypeError(f"not a number: {text!r}")
+        if v != v or v <= 0 or (not allow_inf and v == float("inf")):
+            raise argparse.ArgumentTypeError(
+                f"must be a positive{'' if allow_inf else ' finite'} "
+                f"number of seconds (got {text!r})"
+            )
+        return v
+
+    return parse
+
+
 def _load_config_file(path: str) -> Dict[str, Any]:
     import yaml
 
@@ -306,7 +327,7 @@ def main(argv: Optional[list] = None) -> int:
     )
     serve.add_argument(
         "--shard-rpc-deadline",
-        type=float,
+        type=_positive_seconds(allow_inf=False),
         default=30.0,
         help="per-op deadline budget (seconds) for front→shard RPCs; a "
         "scatter call that outruns it degrades fail-safe instead of "
@@ -362,7 +383,7 @@ def main(argv: Optional[list] = None) -> int:
     )
     serve.add_argument(
         "--replica-max-lag",
-        type=float,
+        type=_positive_seconds(allow_inf=True),
         default=5.0,
         help="replica only: staleness bound in seconds — when the time "
         "since the last successful replication poll exceeds this, the "

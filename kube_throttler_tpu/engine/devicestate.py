@@ -34,6 +34,7 @@ import numpy as np
 
 from ..utils.tracing import NoopTracer
 from ..utils.lockorder import make_lock, make_rlock
+from ..utils import epochassert as _epochassert
 from ..utils.retrace import on_tick as _retrace_on_tick
 from ..api.pod import Pod
 from ..api.types import ClusterThrottle, ResourceAmount, Throttle
@@ -56,6 +57,10 @@ from ..ops.check import (
 from ..ops.schema import DimRegistry, PodBatch, ThrottleState
 
 logger = logging.getLogger(__name__)
+
+# cached once at import: _note_thr_col is on the reconcile hot path, and
+# the assassin only needs mutation provenance when the suite arms it
+_EPOCH_ASSERT = _epochassert.enabled()
 
 AnyThrottle = Union[Throttle, ClusterThrottle]
 
@@ -474,6 +479,10 @@ class _KindState:
     def _note_thr_col(self, col: int, before: Tuple[int, int]) -> None:
         """Record a single-throttle change for the scatter path, or escalate
         to a full re-upload if capacity moved under us."""
+        if _EPOCH_ASSERT:
+            # depth=2: skip this helper so the recorded site is the mutator
+            # (set_throttle_row / remove_throttle_row / set_reserved_row)
+            _epochassert.note_mutation(depth=2)
         if (self.tcap, self.R) == before and not self.dirty_throttles:
             self._dirty_thr_cols.add(col)
         else:

@@ -828,6 +828,16 @@ class ReplicaGate:
     def __init__(self, replicator: "StandbyReplicator", max_lag_s: float = 5.0):
         self.replicator = replicator
         self.max_lag_s = float(max_lag_s)
+        # admit() refuses on `lag > max_lag_s`, and every float comparison
+        # against NaN is False — a NaN bound would therefore serve
+        # arbitrarily stale verdicts forever (fail-OPEN, silently). A
+        # non-positive bound is the opposite dead state. +inf is allowed:
+        # it is the explicit "never refuse on staleness" operator choice.
+        if self.max_lag_s != self.max_lag_s or self.max_lag_s <= 0:
+            raise ValueError(
+                f"replica max lag must be a positive number of seconds "
+                f"(got {max_lag_s!r})"
+            )
         self._monotonic = time.monotonic  # test injection point
         self.served_total = 0
         self.refused_total = 0

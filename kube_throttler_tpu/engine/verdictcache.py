@@ -41,11 +41,12 @@ from __future__ import annotations
 
 from typing import Any, Optional, Tuple
 
-from ..utils.lockorder import make_lock
+from ..utils.lockorder import guard_attrs, make_lock
 
 __all__ = ["VerdictCache"]
 
 
+@guard_attrs
 class VerdictCache:
     """Bounded (key → (epoch-sum, verdict)) map with lock-free probes.
 
@@ -61,7 +62,16 @@ class VerdictCache:
     # them lock-free by design — attribute loads and dict.get are atomic
     # under the GIL, and a probe that races a rotation at worst consults
     # the just-demoted segment (a benign extra miss/hit of valid data).
-    # Deliberately NOT in a GUARDED_BY table for that reason.
+    # Deliberately NOT in the GUARDED_BY table for that reason; likewise
+    # hits/misses, which the lock-free probe side bumps by contract (a
+    # torn increment loses a monitoring count, never a verdict). The
+    # insert-side counters below ARE lock-owned: stats() reads them
+    # racily at scrape (waived in baseline.txt / race_allow.txt).
+    GUARDED_BY = {
+        "insertions": "self._lock",
+        "rotations": "self._lock",
+        "invalidations": "self._lock",
+    }
 
     def __init__(self, capacity: int = 65536) -> None:
         self.capacity = max(2, int(capacity))

@@ -67,6 +67,47 @@ INT64_MILLI_PLANES = frozenset(
 )
 
 
+# The verdict-epoch coherence registry. Every attribute named here is a
+# verdict-affecting plane or ledger: a PreFilter verdict is a pure
+# function of (request-shape id, accel class, matched cols, per-col
+# state), and the interned-verdict cache (engine/verdictcache.py) proves
+# freshness by epoch sums — so any write to one of these planes that is
+# not dominated by a ``col_epoch``/``global_epoch`` bump (or a call into
+# a function that bumps) silently serves stale admission verdicts at
+# cache-hit speed. The ``epochs`` static checker (analysis/epochs.py)
+# reads this literal set from the AST (same registry idiom as
+# INT64_MILLI_PLANES above) and flags undominated writes; vetted
+# exceptions live in analysis/epoch_allow.txt with justifications.
+# Functions that provide the bump for their callers are marked with an
+# inline ``#: epoch-bumps:`` annotation at the def site.
+VERDICT_EPOCH_PLANES = frozenset(
+    {
+        # threshold/spec columns (effective_threshold inputs)
+        "thr_cnt",
+        "thr_cnt_present",
+        "thr_req",
+        "thr_req_present",
+        "thr_valid",
+        # usage ledgers
+        "used_cnt",
+        "used_cnt_present",
+        "used_req",
+        "used_req_present",
+        # reservation ledgers (gang reserve/bind writes land here)
+        "res_cnt",
+        "res_cnt_present",
+        "res_req",
+        "res_req_present",
+        # throttle-status planes (the st_* flip state)
+        "st_cnt_throttled",
+        "st_req_throttled",
+        "st_req_flag_present",
+        # per-accel-class threshold overrides
+        "accel_cols",
+    }
+)
+
+
 class DimRegistry:
     """Stable resource-name → column-index mapping.
 

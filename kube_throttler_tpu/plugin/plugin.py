@@ -263,6 +263,13 @@ class KubeThrottler:
             except ValueError:
                 capacity = 65536  # malformed override must not kill serving
             self.verdict_cache = VerdictCache(capacity=capacity)
+        # verdict-coherence assassin (utils/epochassert.py): when armed,
+        # sampled cache hits are shadow-recomputed through the uncached
+        # oracle route — a divergence at an unchanged epoch sum proves a
+        # missed bump and raises StaleVerdict at first observation
+        from ..utils import epochassert as _epochassert
+
+        self._epoch_assert = _epochassert.enabled()
         if start_workers:
             self.throttle_ctr.start()
             self.cluster_throttle_ctr.start()
@@ -322,6 +329,11 @@ class KubeThrottler:
         key, esum = fp
         hit = cache.get(key, esum)
         if hit is not None:
+            if self._epoch_assert:
+                from ..utils import epochassert
+
+                if epochassert.should_check():
+                    epochassert.check_hit(self, pod, key, esum, hit)
             return hit
         status = self._pre_filter_uncached(pod)
         if self._cacheable(status):

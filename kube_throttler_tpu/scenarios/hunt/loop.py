@@ -136,6 +136,10 @@ class SubprocessEvaluator:
             json.dump(scenario_to_dict(scn), f, indent=2)
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # the hunt DETECTS regressions by latency gates failing through
+        # the real stack; the slow-host advisory calibration in
+        # scenarios/slo.py would blind it, so evals always enforce
+        env.setdefault("KT_SCENARIO_ENFORCE_LATENCY", "1")
         try:
             proc = subprocess.run(
                 [
@@ -174,11 +178,19 @@ class InProcessEvaluator:
 
         self.evals += 1
         wd = os.path.join(self.workdir, f"eval-{self.evals:04d}-{scn.name}")
+        # same contract as SubprocessEvaluator: hunt evals enforce the
+        # latency gates (advisory mode would hide every planted stall)
+        had = os.environ.get("KT_SCENARIO_ENFORCE_LATENCY")
+        if had is None:
+            os.environ["KT_SCENARIO_ENFORCE_LATENCY"] = "1"
         try:
             return run_scenario(scn, seed, wd)
         except Exception:
             logger.warning("in-process hunt eval crashed", exc_info=True)
             return None
+        finally:
+            if had is None:
+                os.environ.pop("KT_SCENARIO_ENFORCE_LATENCY", None)
 
 
 # -- promotion ----------------------------------------------------------------

@@ -118,7 +118,12 @@ def _quiesce(tier, owner_store) -> bool:
 class _Tier:
     """Owner + replica pair, in-process: the cli.py wiring of both roles
     without the process boundary (the scenario times verdict propagation
-    at millisecond resolution — a subprocess would only add exec noise)."""
+    at millisecond resolution — a subprocess would only add exec noise).
+
+    No GUARDED_BY table: every attribute is assigned once during
+    construction on the scenario thread and treated as immutable wiring
+    thereafter — cross-thread safety lives inside the engine objects
+    (store locks, replicator state, the gate's own counters), not here."""
 
     def __init__(self, workdir: str, max_lag_s: float):
         from ..api.pod import Namespace
@@ -304,6 +309,13 @@ def run_replica_serving(
         # surface); reads hammer the replica plugin (the tier under test).
         stop = threading.Event()
         pause = threading.Event()  # set ⇒ churner idles (quiesced oracle cut)
+        # Concurrency contract for the shared tallies below (no locks, no
+        # GUARDED_BY — closure state, not class attrs): each cell has ONE
+        # writer (churn_done ← churner thread, served ← hammer thread;
+        # serve_errors is append-only from either, and list.append is
+        # GIL-atomic). The main thread only reads them after stop.set()
+        # + join(), which is the happens-before edge — mid-run reads
+        # don't exist, so torn counts can't either.
         churn_done = [0]
         served = [0]
         serve_errors: List[str] = []
@@ -465,16 +477,26 @@ def run_replica_serving(
             else None
         )
         lag_max = lags_sorted[-1] if lags_sorted else None
+        from .slo import _latency_gates_enforced
+
+        enforced = _latency_gates_enforced()
+        lag_ok = lag_p99 is not None and lag_p99 <= flip_slo_ms
+        # unmeasurable flips and timeouts stay enforced on any host —
+        # only the wall-clock p99 comparison degrades to advisory
         report["gates"]["lag"] = {
             "pass": bool(lags_sorted)
             and flip_timeouts == 0
-            and lag_p99 <= flip_slo_ms,
+            and (lag_ok or not enforced),
             "flips_measured": len(lags_sorted),
             "flip_timeouts": flip_timeouts,
             "lag_p99_ms": round(lag_p99, 1) if lag_p99 is not None else None,
             "lag_max_ms": round(lag_max, 1) if lag_max is not None else None,
             "bound_ms": flip_slo_ms,
         }
+        if not enforced and not lag_ok and lags_sorted:
+            report["gates"]["lag"]["note"] = (
+                "ADVISORY (host below latency core floor) — would FAIL"
+            )
 
         # ---- final convergence + full-population verdict sweep
         import tools.harness as H

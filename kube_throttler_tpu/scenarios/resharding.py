@@ -255,13 +255,22 @@ def run_resharding_storm(
         report["events"] = pipe_stats["events_applied"]
         report["fired_hz"] = round(len(ops) / t_fired, 1)
         report["sustained_hz"] = round(sustained, 1)
+        from .slo import _latency_gates_enforced
+
+        enforced = _latency_gates_enforced()
+        pace_ok = sustained >= pace_hz * min_pace_frac
+        # dropped events are a correctness failure on any host; only the
+        # sustained-rate comparison is host-speed-dependent
         report["gates"]["pace"] = {
-            "pass": sustained >= pace_hz * min_pace_frac
-            and pipe_stats["dropped"] == 0,
+            "pass": (pace_ok or not enforced) and pipe_stats["dropped"] == 0,
             "sustained_hz": round(sustained, 1),
             "target_hz": pace_hz,
             "min_frac": min_pace_frac,
         }
+        if not enforced and not pace_ok:
+            report["gates"]["pace"]["note"] = (
+                "ADVISORY (host below latency core floor) — would FAIL"
+            )
 
         aborts = sum(r.get("aborts", 0) for r in rescale_reports)
         restarts = supervisor.restart_counts()
@@ -305,14 +314,20 @@ def run_resharding_storm(
             p99 = float(np.percentile(np.asarray(samples), 99)) * 1e3
         else:
             p50 = p99 = 0.0
+        flip_ok = p99 <= flip_p99_ms
+        # unmeasurable (zero samples) stays enforced on any host
         report["gates"]["flip_p99"] = {
-            "pass": p99 <= flip_p99_ms and len(samples) > 0,
+            "pass": (flip_ok or not enforced) and len(samples) > 0,
             "p50_ms": round(p50, 1),
             "p99_ms": round(p99, 1),
             "bound_ms": flip_p99_ms,
             "samples": len(samples),
             "window_excluded": max(0, len(flip_lags) - len(samples)),
         }
+        if not enforced and not flip_ok:
+            report["gates"]["flip_p99"]["note"] = (
+                "ADVISORY (host below latency core floor) — would FAIL"
+            )
 
         # oracle: verdicts + zero lost flips (flags ≡ deterministic recompute)
         import tools.harness as H

@@ -232,12 +232,22 @@ def run_sharded_bad_day(
         report["fired_hz"] = round(len(ops) / t_fired, 1)
         report["sustained_hz"] = round(sustained, 1)
         report["dropped"] = pipe_stats["dropped"]
+        from .slo import _latency_gates_enforced
+
+        enforced = _latency_gates_enforced()
+        pace_ok = sustained >= pace_hz * min_pace_frac
+        # dropped events are a correctness failure on any host; only the
+        # sustained-rate comparison is host-speed-dependent
         report["gates"]["pace"] = {
-            "pass": sustained >= pace_hz * min_pace_frac and pipe_stats["dropped"] == 0,
+            "pass": (pace_ok or not enforced) and pipe_stats["dropped"] == 0,
             "sustained_hz": round(sustained, 1),
             "target_hz": pace_hz,
             "min_frac": min_pace_frac,
         }
+        if not enforced and not pace_ok:
+            report["gates"]["pace"]["note"] = (
+                "ADVISORY (host below latency core floor) — would FAIL"
+            )
         report["gates"]["recovery"] = {
             "pass": recovered,
             "bound_s": recovery_s,
@@ -263,14 +273,19 @@ def run_sharded_bad_day(
             p99 = float(np.percentile(np.asarray(samples), 99)) * 1e3
         else:
             p50 = p99 = 0.0
+        flip_ok = p99 <= flip_p99_ms
         report["gates"]["flip_p99"] = {
-            "pass": p99 <= flip_p99_ms,
+            "pass": flip_ok or not enforced,
             "p50_ms": round(p50, 1),
             "p99_ms": round(p99, 1),
             "bound_ms": flip_p99_ms,
             "samples": len(samples),
             "outage_excluded": max(0, len(flip_lags) - len(samples)),
         }
+        if not enforced and not flip_ok:
+            report["gates"]["flip_p99"]["note"] = (
+                "ADVISORY (host below latency core floor) — would FAIL"
+            )
 
         # zero wrong verdicts vs the rebuilt oracle (tools/harness.py)
         import tools.harness as H

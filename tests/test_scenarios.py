@@ -484,12 +484,15 @@ class TestScenarioEngineSmoke:
         assert r1["measurements"]["restarts"] == 1
         assert r1["measurements"]["wrong_verdicts"] == 0
 
-    def test_injected_regression_fails_its_gate(self, tmp_path):
+    def test_injected_regression_fails_its_gate(self, tmp_path, monkeypatch):
         """The gate-actually-gates check: a deliberate per-PUT stall must
         demonstrably fail the flip-p99 gate the clean run passes, and the
-        diff report must name it."""
+        diff report must name it. Enforcement is forced so the check is
+        deterministic on hosts below the latency core floor (where the
+        flip gates otherwise degrade to advisory — see slo.py)."""
         from kube_throttler_tpu.scenarios.slo import diff_reports
 
+        monkeypatch.setenv("KT_SCENARIO_ENFORCE_LATENCY", "1")
         clean = _run_smoke(0, tmp_path / "clean")
         regressed = _run_smoke(0, tmp_path / "reg", regression="flip_stall")
         assert clean["gates"]["flip_p99"]["pass"], clean["gates"]
@@ -497,6 +500,134 @@ class TestScenarioEngineSmoke:
         assert clean["all_pass"] and not regressed["all_pass"]
         diff = diff_reports(clean, regressed)
         assert "flip_p99" in diff and "flip_stall" in diff
+
+
+# -------------------------------------------- host-speed gate calibration
+
+
+class TestLatencyGateCalibration:
+    """Flip-lag gates degrade to advisory below the host core floor
+    (slo._latency_gates_enforced) — correctness gates never do."""
+
+    def _measurements(self, p99):
+        return {
+            "flip_lag_p99_ms": p99,
+            "flip_lag_p50_ms": p99 / 2,
+            "flip_samples": 50,
+            "flip_crossings": 10,
+            "pace_frac": 1.0,
+            "applied_frac": 1.0,
+            "converged": True,
+            "events_per_sec": 100.0,
+            "wrong_verdicts": 0,
+            "verdicts_checked": 10,
+        }
+
+    def test_slow_host_overshoot_is_advisory_not_enforced(self, monkeypatch):
+        from kube_throttler_tpu.scenarios.slo import evaluate_gates
+
+        monkeypatch.delenv("KT_SCENARIO_ENFORCE_LATENCY", raising=False)
+        monkeypatch.setenv("KT_SCENARIO_LATENCY_CORE_FLOOR", str(10**6))
+        scn = get_scenario("smoke")
+        gates = evaluate_gates(scn, self._measurements(scn.slo.flip_p99_ms * 5))
+        assert gates["flip_p99"]["pass"]  # advisory, not enforced
+        assert "ADVISORY" in gates["flip_p99"]["note"]
+        assert "would FAIL" in gates["flip_p99"]["note"]
+        # the measured value is still reported for calibration
+        assert gates["flip_p99"]["value"] == scn.slo.flip_p99_ms * 5
+        # correctness gates stay enforced on any host
+        assert gates["verdicts"]["pass"] and gates["ingest_sustain"]["pass"]
+
+    def test_enforce_env_overrides_core_floor(self, monkeypatch):
+        from kube_throttler_tpu.scenarios.slo import evaluate_gates
+
+        monkeypatch.setenv("KT_SCENARIO_ENFORCE_LATENCY", "1")
+        monkeypatch.setenv("KT_SCENARIO_LATENCY_CORE_FLOOR", str(10**6))
+        scn = get_scenario("smoke")
+        gates = evaluate_gates(scn, self._measurements(scn.slo.flip_p99_ms * 5))
+        assert not gates["flip_p99"]["pass"]
+
+    def test_fast_host_in_bound_has_no_advisory_marker(self, monkeypatch):
+        from kube_throttler_tpu.scenarios.slo import evaluate_gates
+
+        monkeypatch.delenv("KT_SCENARIO_ENFORCE_LATENCY", raising=False)
+        monkeypatch.setenv("KT_SCENARIO_LATENCY_CORE_FLOOR", "1")
+        scn = get_scenario("smoke")
+        gates = evaluate_gates(scn, self._measurements(scn.slo.flip_p99_ms / 2))
+        assert gates["flip_p99"]["pass"]
+        assert "ADVISORY" not in gates["flip_p99"].get("note", "")
+
+    def test_unmeasurable_still_fails_below_floor(self, monkeypatch):
+        """Too few flip samples is a trace-content defect, not host
+        speed — the unmeasurable branch never degrades to advisory."""
+        from kube_throttler_tpu.scenarios.slo import evaluate_gates
+
+        monkeypatch.delenv("KT_SCENARIO_ENFORCE_LATENCY", raising=False)
+        monkeypatch.setenv("KT_SCENARIO_LATENCY_CORE_FLOOR", str(10**6))
+        scn = get_scenario("smoke")
+        m = self._measurements(1.0)
+        m["flip_samples"] = 0
+        assert not evaluate_gates(scn, m)["flip_p99"]["pass"]
+
+    def test_malformed_floor_env_falls_back(self, monkeypatch):
+        from kube_throttler_tpu.scenarios.slo import _latency_gates_enforced
+
+        monkeypatch.delenv("KT_SCENARIO_ENFORCE_LATENCY", raising=False)
+        monkeypatch.setenv("KT_SCENARIO_LATENCY_CORE_FLOOR", "many")
+        assert _latency_gates_enforced() in (True, False)  # no raise
+        monkeypatch.setenv("KT_SCENARIO_LATENCY_CORE_FLOOR", "1")
+        assert _latency_gates_enforced()  # every host has ≥1 core
+
+    def test_hunt_inprocess_evaluator_forces_enforcement(
+        self, tmp_path, monkeypatch
+    ):
+        """The hunt DETECTS regressions by latency gates failing —
+        advisory mode would hide every planted stall, so the evaluator
+        enforces for the duration of the eval (and restores after)."""
+        import os as _os
+
+        from kube_throttler_tpu.scenarios.hunt.loop import (
+            InProcessEvaluator,
+            base_programs,
+        )
+
+        monkeypatch.delenv("KT_SCENARIO_ENFORCE_LATENCY", raising=False)
+        seen = {}
+
+        def fake_run(scn, seed, wd):
+            seen["enforce"] = _os.environ.get("KT_SCENARIO_ENFORCE_LATENCY")
+            return {"gates": {}}
+
+        monkeypatch.setattr(
+            "kube_throttler_tpu.scenarios.engine.run_scenario", fake_run
+        )
+        out = InProcessEvaluator(str(tmp_path))(base_programs()[0], 0)
+        assert out == {"gates": {}}
+        assert seen["enforce"] == "1"
+        assert "KT_SCENARIO_ENFORCE_LATENCY" not in _os.environ  # restored
+
+    def test_hunt_subprocess_evaluator_forces_enforcement(
+        self, tmp_path, monkeypatch
+    ):
+        from kube_throttler_tpu.scenarios.hunt import loop as hunt_loop
+
+        monkeypatch.delenv("KT_SCENARIO_ENFORCE_LATENCY", raising=False)
+        captured = {}
+
+        def fake_run(cmd, **kw):
+            captured["env"] = kw["env"]
+
+            class P:
+                returncode = 0
+                stdout = ""
+                stderr = ""
+
+            return P()
+
+        monkeypatch.setattr(hunt_loop.subprocess, "run", fake_run)
+        ev = hunt_loop.SubprocessEvaluator(str(tmp_path))
+        assert ev(hunt_loop.base_programs()[0], 0) is None  # no report file
+        assert captured["env"]["KT_SCENARIO_ENFORCE_LATENCY"] == "1"
 
 
 # ------------------------------------------------------- slow: the corpus
