@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress race-test crash-test ha-test reshard-test net-chaos scenario-test shard-scenario reshard-scenario preempt-scenario partition-scenario replica-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress race-test crash-test ha-test reshard-test net-chaos upgrade-test scenario-test shard-scenario reshard-scenario preempt-scenario partition-scenario replica-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -55,6 +55,9 @@ replica-scenario: ## read-replica serving tier alone: storm + leader flip burst,
 
 net-chaos:       ## network-fault matrix: every net.* site x 3 seeds through a live 2-worker TCP fleet; verdict-oracle + zero-orphan + zero-lost-flip gates
 	env JAX_PLATFORMS=cpu $(PY) tools/netchaostest.py matrix
+
+upgrade-test:    ## rolling-upgrade chaos matrix: front-first + worker-first rolls with capability skew, mid-roll SIGKILL, and the clean incompatible-major refusal, over a live 3-worker TCP fleet
+	env JAX_PLATFORMS=cpu $(PY) tools/upgradetest.py matrix
 
 scenario-regression: ## prove the gates gate: clean vs injected-regression diff report
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios regression --name smoke

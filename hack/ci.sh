@@ -50,4 +50,10 @@ env JAX_PLATFORMS=cpu python -m kube_throttler_tpu.scenarios.hunt smoke \
     --promote-dir "$HUNT_DIR/promoted"
 echo "hunt coverage artifact: $HUNT_DIR/hunt-coverage.json"
 
+echo "== upgrade: reduced-scale rolling-upgrade smoke (live TCP fleet roll) =="
+# one worker-first roll with a mid-roll SIGKILL plus the clean
+# incompatible-major refusal, at smoke scale; the full matrix (both roll
+# orders x seeds) stays `make upgrade-test`
+env JAX_PLATFORMS=cpu python tools/upgradetest.py smoke
+
 echo "ci gate: OK"

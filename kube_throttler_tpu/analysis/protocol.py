@@ -38,6 +38,19 @@ registry's emit and dispatch sides against each other:
    must check for itself). ``__init__``/``close`` are exempt
    (construction pre-dates leadership; shutdown flush must work fenced
    or not).
+
+4. **Format registry coverage.** ``version.FORMAT_REGISTRY`` is the
+   single source of truth mapping every durable/wire format to the
+   minimum reader version that understands it — the rolling-upgrade
+   contract. The registry must be a PURE dict literal (a computed
+   registry cannot be audited at review time), and it must cover the
+   code: every IPC frame mtype sent or dispatched needs a
+   ``frame:<mtype>`` row, every emitted journal control type needs a
+   ``journal:<TYPE>`` row, every entry of
+   ``snapshot.SUPPORTED_SNAPSHOT_VERSIONS`` needs a ``snapshot:<v>``
+   row. Stale rows (a registry entry whose referent no longer exists in
+   the code) are findings too — a dead row misstates the compatibility
+   surface to operators planning a roll.
 """
 
 from __future__ import annotations
@@ -295,10 +308,215 @@ def _check_fencing(modules: Sequence[Module], findings: List[Finding]) -> None:
                     )
 
 
+def _emitted_control_types(
+    modules: Sequence[Module],
+) -> Dict[str, Tuple[str, int]]:
+    """Journal control types emitted anywhere: ``{"type": "X"}`` dict
+    literals with an uppercase non-watch-event type and no ``object``
+    key (the scan _check_control_lines pins dispatch against)."""
+    emitted: Dict[str, Tuple[str, int]] = {}
+    for m in modules:
+        for node in m.walk():
+            if not isinstance(node, ast.Dict):
+                continue
+            ctype = None
+            has_object = False
+            for k, v in zip(node.keys, node.values):
+                ks = literal_str(k) if k is not None else None
+                if ks == "type":
+                    vs = literal_str(v)
+                    if vs and vs.isupper() and vs not in _EVENT_TYPES:
+                        ctype = vs
+                if ks == "object":
+                    has_object = True
+            if ctype and not has_object:
+                emitted.setdefault(ctype, (m.relpath, node.lineno))
+    return emitted
+
+
+def _check_format_registry(modules: Sequence[Module], findings: List[Finding]) -> None:
+    reg: Optional[Tuple[Module, ast.Assign]] = None
+    for m in modules:
+        if not _norm(m.relpath).endswith("version.py"):
+            continue
+        for node in m.walk():
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "FORMAT_REGISTRY"
+                for t in node.targets
+            ):
+                reg = (m, node)
+                break
+    if reg is None:
+        return  # fixture trees without version.py have no contract to pin
+    vm, vnode = reg
+    if not isinstance(vnode.value, ast.Dict):
+        findings.append(
+            Finding(
+                checker="protocol",
+                path=vm.relpath,
+                relpath=vm.relpath,
+                line=vnode.lineno,
+                message=(
+                    "FORMAT_REGISTRY must be a pure dict literal — a "
+                    "computed registry cannot be audited at review time"
+                ),
+            )
+        )
+        return
+    rows: Set[str] = set()
+    for k in vnode.value.keys:
+        ks = literal_str(k) if k is not None else None
+        if ks is None:
+            findings.append(
+                Finding(
+                    checker="protocol",
+                    path=vm.relpath,
+                    relpath=vm.relpath,
+                    line=vnode.lineno,
+                    message=(
+                        "FORMAT_REGISTRY key is not a string literal — "
+                        "the registry must be pure so the min-reader "
+                        "contract is readable without executing code"
+                    ),
+                )
+            )
+            continue
+        rows.add(ks)
+
+    # frames: every mtype sent (send_frame literal) or dispatched
+    # (`mtype == "..."`) on either side needs a frame:<mtype> row
+    frame_uses: Dict[str, Tuple[str, int]] = {}
+    have_sharding = False
+    for m in modules:
+        rel = _norm(m.relpath)
+        if not rel.endswith(_FRONT_FILES + _WORKER_FILES):
+            continue
+        have_sharding = True
+        for node in m.walk():
+            if isinstance(node, ast.Call):
+                fname = (
+                    node.func.id
+                    if isinstance(node.func, ast.Name)
+                    else getattr(node.func, "attr", None)
+                )
+                if fname == "send_frame" and len(node.args) >= 3:
+                    mtype = literal_str(node.args[2])
+                    if mtype is not None:
+                        frame_uses.setdefault(mtype, (m.relpath, node.lineno))
+            elif isinstance(node, ast.Compare):
+                if isinstance(node.left, ast.Name) and node.left.id == "mtype":
+                    for comp in node.comparators:
+                        s = literal_str(comp)
+                        if s is not None:
+                            frame_uses.setdefault(s, (m.relpath, node.lineno))
+    for mtype, (relpath, line) in sorted(frame_uses.items()):
+        if f"frame:{mtype}" not in rows:
+            findings.append(
+                Finding(
+                    checker="protocol",
+                    path=relpath,
+                    relpath=relpath,
+                    line=line,
+                    message=(
+                        f"IPC frame type '{mtype}' has no 'frame:{mtype}' "
+                        "row in version.FORMAT_REGISTRY — its min-reader "
+                        "contract is undeclared for rolling upgrades"
+                    ),
+                )
+            )
+
+    # journal control lines: every emitted type needs a journal:<TYPE> row
+    emitted = _emitted_control_types(modules)
+    have_journal = any(
+        _norm(m.relpath).endswith("engine/journal.py") for m in modules
+    )
+    for ctype, (relpath, line) in sorted(emitted.items()):
+        if f"journal:{ctype}" not in rows:
+            findings.append(
+                Finding(
+                    checker="protocol",
+                    path=relpath,
+                    relpath=relpath,
+                    line=line,
+                    message=(
+                        f"journal control type '{ctype}' has no "
+                        f"'journal:{ctype}' row in version.FORMAT_REGISTRY "
+                        "— replay cannot name the reader it requires"
+                    ),
+                )
+            )
+
+    # snapshot versions: every supported version needs a snapshot:<v> row
+    snap_versions: Dict[str, Tuple[str, int]] = {}
+    have_snapshot = False
+    for m in modules:
+        if not _norm(m.relpath).endswith("engine/snapshot.py"):
+            continue
+        have_snapshot = True
+        for node in m.walk():
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SUPPORTED_SNAPSHOT_VERSIONS"
+                for t in node.targets
+            ):
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, int
+                        ):
+                            snap_versions.setdefault(
+                                str(elt.value), (m.relpath, node.lineno)
+                            )
+    for ver, (relpath, line) in sorted(snap_versions.items()):
+        if f"snapshot:{ver}" not in rows:
+            findings.append(
+                Finding(
+                    checker="protocol",
+                    path=relpath,
+                    relpath=relpath,
+                    line=line,
+                    message=(
+                        f"supported snapshot version {ver} has no "
+                        f"'snapshot:{ver}' row in version.FORMAT_REGISTRY "
+                        "— its min-reader contract is undeclared"
+                    ),
+                )
+            )
+
+    # stale rows: a registry entry whose referent no longer exists
+    # misstates the compatibility surface (only judged for domains whose
+    # source of truth is present in the tree)
+    for row in sorted(rows):
+        domain, _, name = row.partition(":")
+        stale = (
+            (domain == "frame" and have_sharding and name not in frame_uses)
+            or (domain == "journal" and have_journal and name not in emitted)
+            or (domain == "snapshot" and have_snapshot and name not in snap_versions)
+        )
+        unknown = domain not in ("frame", "journal", "snapshot")
+        if stale or unknown:
+            findings.append(
+                Finding(
+                    checker="protocol",
+                    path=vm.relpath,
+                    relpath=vm.relpath,
+                    line=vnode.lineno,
+                    message=(
+                        f"FORMAT_REGISTRY row '{row}' is "
+                        + (
+                            "in an unknown domain (expected frame:/journal:/snapshot:)"
+                            if unknown
+                            else "stale — nothing in the code emits or supports it"
+                        )
+                    ),
+                )
+            )
+
+
 def check(modules: Sequence[Module]) -> List[Finding]:
     findings: List[Finding] = []
     _check_control_lines(modules, findings)
     _check_ipc_frames(modules, findings)
     _check_fencing(modules, findings)
+    _check_format_registry(modules, findings)
     findings.sort(key=lambda f: (f.relpath, f.line, f.message))
     return findings

@@ -180,9 +180,13 @@ def _serve_sharded(args, plugin_args, leader_elect: bool, stop) -> int:
         front.store.create_namespace(Namespace("default"))
     # front-side interned-verdict cache observability (the scatter tier
     # keeps its own cache keyed on front epochs)
-    from .metrics import register_verdict_cache_metrics
+    from .metrics import register_build_metrics, register_verdict_cache_metrics
 
     register_verdict_cache_metrics(metrics_registry, front.verdict_cache)
+    # build/version exposition (rolling upgrades): own build_info row plus
+    # one row per shard with its NEGOTIATED proto/caps, so a fleet scrape
+    # shows exactly which pairings are running during a roll
+    register_build_metrics(metrics_registry, role="front", front=front)
     server = ThrottlerHTTPServer(front, host=args.host, port=args.port)
     server.start()
     print(
@@ -657,6 +661,14 @@ def main(argv: Optional[list] = None) -> int:
     from .metrics import Registry
 
     metrics_registry = Registry()  # shared: reflector metrics + the 16 families
+    from .metrics import register_build_metrics
+
+    _role = getattr(args, "ha_role", "none") or "none"
+    # build/version exposition (rolling upgrades): every role exports
+    # kube_throttler_build_info so a fleet scrape names each build
+    register_build_metrics(
+        metrics_registry, role=("standalone" if _role == "none" else _role)
+    )
     if rest_config is not None:
         from .client.transport import RemoteSession
 
@@ -730,9 +742,12 @@ def main(argv: Optional[list] = None) -> int:
                     store, journal, args.replicate_from, epoch=epoch
                 )
                 if not replicator.bootstrap(deadline_s=60.0):
+                    reason = replicator.format_refused_reason or (
+                        f"owner unreachable at {args.replicate_from}"
+                    )
                     print(
-                        "replica bootstrap failed: owner unreachable at "
-                        f"{args.replicate_from}", file=sys.stderr, flush=True,
+                        f"replica bootstrap failed: {reason}",
+                        file=sys.stderr, flush=True,
                     )
                     journal.close()
                     return 1
@@ -771,9 +786,12 @@ def main(argv: Optional[list] = None) -> int:
                     flush=True,
                 )
                 if not replicator.bootstrap(deadline_s=60.0):
+                    reason = replicator.format_refused_reason or (
+                        f"leader unreachable at {args.replicate_from}"
+                    )
                     print(
-                        "standby bootstrap failed: leader unreachable at "
-                        f"{args.replicate_from}", file=sys.stderr, flush=True,
+                        f"standby bootstrap failed: {reason}",
+                        file=sys.stderr, flush=True,
                     )
                     standby_server.stop()
                     journal.close()

@@ -68,6 +68,30 @@ logger = logging.getLogger(__name__)
 # replay creation order: namespaced objects need their namespaces first
 _KIND_ORDER = {"Namespace": 0, "Throttle": 1, "ClusterThrottle": 1, "Pod": 2}
 
+# every line type this reader understands: the three watch-event types
+# (store.EventType) plus the journal's own control lines. The format
+# registry (version.FORMAT_REGISTRY, ``journal:*`` rows) is the durable
+# contract these map to — the protocol checker (analysis/protocol.py)
+# cross-checks that every control type emitted here has a registry row.
+_KNOWN_LINE_TYPES = frozenset(
+    {"ADDED", "MODIFIED", "DELETED", "EPOCH", "GANG", "PREEMPT"}
+)
+
+
+class JournalFormatError(Exception):
+    """An UNKNOWN-BUT-VERSIONED control line: an uppercase ``type`` this
+    reader does not recognise and that carries no ``object`` payload —
+    the shape a NEWER writer's control line takes (rolling-upgrade skew:
+    the journal was written by a later build). Unlike torn/corrupt lines
+    (skip-and-count — losing one event beats losing the post-gap
+    history), this is a *format* boundary: silently skipping a control
+    line whose semantics we do not know (a future fencing or rollback
+    bracket, say) risks replaying into a state the writer never meant,
+    so replay STOPS deterministically, the refusal is counted and named
+    (``format_refused`` / ``format_refused_reason``), and the health
+    probe reports ``down`` until a reader of at least the line's
+    ``minReader`` version replays it."""
+
 
 def hash_prefix(path: str, length: int):
     """sha256 object over the first ``length`` bytes of ``path``, or None
@@ -164,6 +188,12 @@ class StoreJournal:
         self.stale_epoch_rejected = 0  # appends refused by the fencing gate
         self.preempts_rolled_back = 0  # uncommitted preemptions rolled back
         self.preempt_victims_restored = 0  # victim pods re-created by rollback
+        # rolling-upgrade format refusal (JournalFormatError): replay hit a
+        # control line from a newer writer and stopped. Single-writer,
+        # read by the health probe — the reason names the line type and
+        # the minimum reader version it demands.
+        self.format_refused = 0
+        self.format_refused_reason: Optional[str] = None
 
     # -- replay -------------------------------------------------------------
 
@@ -214,6 +244,32 @@ class StoreJournal:
                 try:
                     event = json.loads(line.decode("utf-8"))
                     self._apply(event)
+                except JournalFormatError as e:
+                    # a control line from a NEWER writer: refuse replay
+                    # deterministically — count, name the version demand,
+                    # and STOP (skip-and-continue here could replay into a
+                    # state the writer never meant). Pending bad lines are
+                    # still counted so the probe's detail stays honest. The
+                    # remainder of the file is hashed (not applied) so the
+                    # accounted position stays consistent with the bytes on
+                    # disk; health_state reports down until a new-enough
+                    # reader replays the log.
+                    for bad_lineno, err in bad_run:
+                        self.replay_skipped += 1
+                        logger.warning(
+                            "journal %s: skipping corrupted line %d (%s)",
+                            self.path, bad_lineno, err,
+                        )
+                    self.format_refused += 1
+                    self.format_refused_reason = str(e)
+                    logger.error(
+                        "journal %s: replay REFUSED at line %d: %s",
+                        self.path, lineno, e,
+                    )
+                    rest = f.read()
+                    h.update(rest)
+                    offset += len(rest)
+                    return applied, None, offset, h
                 except (
                     json.JSONDecodeError,
                     KeyError,
@@ -301,6 +357,24 @@ class StoreJournal:
                         entry[field] = prev[field]
                 self.preempt_ops[pid] = entry
             return
+        if (
+            isinstance(etype, str)
+            and etype.isupper()
+            and etype not in _KNOWN_LINE_TYPES
+            and "object" not in event
+        ):
+            # uppercase type, no object payload: the control-line shape,
+            # but a type this reader does not know — a newer writer's
+            # line, not bit rot. Refuse by name (the line may carry its
+            # own ``minReader`` stamp; otherwise the demand is unknown).
+            from ..version import local_proto_version
+
+            need = event.get("minReader", "unknown")
+            ours = "%d.%d" % local_proto_version()
+            raise JournalFormatError(
+                f"unknown control line type {etype!r} requires reader "
+                f">= {need} (this reader speaks {ours}); refusing replay"
+            )
         kind = event["kind"]
         obj = object_from_dict({**event["object"], "kind": kind})
         store = self.store
@@ -895,7 +969,15 @@ class StoreJournal:
             "compactFailures": self.compact_failures,
             "staleEpochRejected": self.stale_epoch_rejected,
             "epoch": self.last_epoch,
+            "formatRefused": self.format_refused,
         }
+        if self.format_refused:
+            # replay stopped at a newer writer's control line: the store
+            # behind this journal is an incomplete prefix — serving from
+            # it would hand out verdicts the missing tail may contradict.
+            # Down, with the version demand named for the operator.
+            detail["formatRefusedReason"] = self.format_refused_reason
+            return "down", detail
         if self.stale_epoch_rejected:
             # a fenced journal is not merely lossy — this replica must not
             # serve at all (a standby owns the keyspace now)

@@ -63,7 +63,8 @@ from urllib.parse import parse_qs, urlsplit
 
 from ..api.serialization import object_from_dict
 from ..utils.lockorder import guard_attrs, make_lock
-from .journal import StoreJournal
+from ..version import local_proto_version
+from .journal import JournalFormatError, StoreJournal
 from .snapshot import SnapshotError, find_snapshots, load_snapshot
 from .store import Store
 
@@ -71,6 +72,10 @@ logger = logging.getLogger(__name__)
 
 EPOCH_FILE = "epoch"
 EPOCH_HEADER = "X-Kube-Throttler-Epoch"
+# replication wire version stamp (version.py): every /v1/replication/*
+# response carries the leader's protocol version so a skewed standby can
+# refuse an incompatible major BY NAME instead of misparsing the stream
+PROTO_HEADER = "X-KT-Proto-Version"
 
 
 class ReplicationDiverged(Exception):
@@ -270,11 +275,14 @@ def handle_replication_get(handler, source: ReplicationSource, raw_path: str) ->
     if not path.startswith("/v1/replication/"):
         return False
 
+    proto = "%d.%d" % local_proto_version()
+
     def send_json(code: int, doc: dict) -> None:
         body = json.dumps(doc).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))
+        handler.send_header(PROTO_HEADER, proto)
         handler.end_headers()
         handler.wfile.write(body)
 
@@ -282,6 +290,7 @@ def handle_replication_get(handler, source: ReplicationSource, raw_path: str) ->
         handler.send_response(200)
         handler.send_header("Content-Type", "application/octet-stream")
         handler.send_header("Content-Length", str(len(body)))
+        handler.send_header(PROTO_HEADER, proto)
         for k, v in headers.items():
             handler.send_header(k, v)
         handler.end_headers()
@@ -441,6 +450,12 @@ class StandbyReplicator:
         self.last_contact_monotonic: Optional[float] = None
         self.diverged = False
         self.bootstrapped = False
+        # rolling-upgrade format refusal: the leader served a snapshot
+        # version, protocol major, or control line this build cannot read.
+        # Deterministic — retrying fetches the same bytes — so bootstrap
+        # fails fast (no retry-until-deadline) and health names the demand.
+        self.format_refused = 0
+        self.format_refused_reason: Optional[str] = None
 
     # -- wire ---------------------------------------------------------------
 
@@ -460,6 +475,27 @@ class StandbyReplicator:
             raise OSError(f"replication fetch failed: {e!r}") from e
         finally:
             conn.close()
+
+    def _proto_refusal(self, headers: Dict[str, str]) -> Optional[str]:
+        """Non-None when the leader's stamped protocol major (PROTO_HEADER)
+        is incompatible with ours. A missing or malformed stamp is treated
+        as the pre-versioning 1.x baseline — never a refusal (a rolling
+        upgrade must interoperate with the build that predates the
+        stamp)."""
+        raw = headers.get(PROTO_HEADER)
+        if not raw:
+            return None
+        try:
+            major = int(str(raw).split(".", 1)[0])
+        except ValueError:
+            return None
+        ours = local_proto_version()
+        if major != ours[0]:
+            return (
+                f"leader speaks replication protocol {raw}; this build "
+                f"speaks {ours[0]}.{ours[1]} (incompatible major)"
+            )
+        return None
 
     # -- bootstrap ----------------------------------------------------------
 
@@ -481,12 +517,33 @@ class StandbyReplicator:
                 self._stop.wait(0.1)
                 continue
             self.last_contact_monotonic = time.monotonic()
+            refusal = self._proto_refusal(headers)
+            if refusal:
+                self.format_refused += 1
+                self.format_refused_reason = refusal
+                logger.error("standby bootstrap REFUSED: %s", refusal)
+                return False
             if status == 404:
                 self._offset, self._sha_hex = 0, ""
             elif status == 200:
                 from .snapshot import parse_snapshot_bytes
 
-                payload = parse_snapshot_bytes(data)
+                try:
+                    payload = parse_snapshot_bytes(data)
+                except SnapshotError as e:
+                    # version/format refusal (rolling-upgrade skew): the
+                    # leader's snapshot is NEWER than this reader. This is
+                    # deterministic — every retry fetches the same bytes —
+                    # so retrying until the deadline would just burn it
+                    # and then report a generic timeout. Fail fast instead,
+                    # with the version named for the operator; health
+                    # reports down until this build is upgraded.
+                    self.format_refused += 1
+                    self.format_refused_reason = str(e)
+                    logger.error(
+                        "standby bootstrap REFUSED (no retry): %s", e
+                    )
+                    return False
                 self._apply_snapshot(payload)
                 self.bootstrap_snapshot = payload
                 jinfo = payload.get("journal") or {}
@@ -600,6 +657,17 @@ class StandbyReplicator:
         status, data, headers = self._get(f"/v1/replication/journal?{q}")
         self.polls += 1
         self.last_contact_monotonic = time.monotonic()
+        refusal = self._proto_refusal(headers)
+        if refusal:
+            # the leader was upgraded across a protocol major mid-stream:
+            # stop consuming BEFORE the offset advances. OSError keeps the
+            # run loop's quiet paced retry (no hot loop); health names the
+            # incompatibility via format_refused_reason.
+            if self.format_refused_reason != refusal:
+                logger.error("journal tail REFUSED: %s", refusal)
+            self.format_refused += 1
+            self.format_refused_reason = refusal
+            raise OSError(f"replication refused: {refusal}")
         if status == 409:
             self.diverged = True
             raise ReplicationDiverged(data.decode(errors="replace")[:200])
@@ -672,6 +740,27 @@ class StandbyReplicator:
                     # silently lose the crash-rollback payload
                     preempts.append(event)
                     continue
+                etype = event.get("type")
+                if (
+                    isinstance(etype, str)
+                    and etype.isupper()
+                    and etype not in ("ADDED", "MODIFIED", "DELETED")
+                    and "object" not in event
+                ):
+                    # unknown-but-versioned control line from a NEWER
+                    # leader build (journal.JournalFormatError stance):
+                    # refuse by name BEFORE any of this chunk applies or
+                    # the offset advances — counting it as corruption
+                    # would silently drop semantics we do not understand.
+                    need = event.get("minReader", "unknown")
+                    ours = "%d.%d" % local_proto_version()
+                    refusal = (
+                        f"unknown control line type {etype!r} requires "
+                        f"reader >= {need} (this reader speaks {ours})"
+                    )
+                    self.format_refused += 1
+                    self.format_refused_reason = refusal
+                    raise JournalFormatError(refusal)
                 kind = event["kind"]
                 obj = object_from_dict({**event["object"], "kind": kind})
                 if event["type"] == "DELETED":
@@ -737,6 +826,12 @@ class StandbyReplicator:
                 if self.bootstrap(deadline_s=30.0):
                     self.diverged = False
                     self.rebootstraps += 1
+            except JournalFormatError:
+                # format refusal: already counted and named in
+                # format_refused_reason (health reports down). Keep the
+                # paced poll — a leader rollback or our own upgrade is the
+                # only thing that clears it; no hot loop, no log storm.
+                pass
             except OSError:
                 # leader unreachable (crashed, restarting, network): keep
                 # polling — the lease decides when WE take over, not the
@@ -797,7 +892,16 @@ class StandbyReplicator:
             "lastContactAgeSeconds": age,
             "leaderEpoch": self.leader_epoch,
             "rebootstraps": self.rebootstraps,
+            "formatRefused": self.format_refused,
         }
+        if self.format_refused_reason:
+            # version skew, not an outage: the leader serves a format this
+            # build cannot read. Down with the demand NAMED, so the
+            # operator reads "upgrade me", not "network flake".
+            return "down", {
+                **detail,
+                "error": f"format refused: {self.format_refused_reason}",
+            }
         if self.diverged:
             return "down", {**detail, "error": "replication diverged"}
         if not self.bootstrapped:
@@ -920,6 +1024,9 @@ class SliceChunkSource:
             "endOffset": end,
             "endSha": hashlib.sha256(self.blob[:end]).hexdigest(),
             "position": len(self.blob),
+            # protocol stamp (version.py): the sink refuses a major it
+            # cannot read instead of misparsing the slice payload
+            "proto": list(local_proto_version()),
         }
 
 
@@ -946,6 +1053,22 @@ class SliceChunkSink:
         return self.position is not None and len(self._buf) >= self.position
 
     def feed(self, chunk: Dict[str, Any]) -> int:
+        proto = chunk.get("proto")
+        if proto:
+            # version stamp (SliceChunkSource): an incompatible major is
+            # the coordinator's abort-back-to-source trigger — authority
+            # stays with the source, nothing half-parsed is applied. An
+            # unstamped chunk is the pre-versioning baseline (accepted).
+            try:
+                major = int(proto[0])
+            except (TypeError, ValueError, IndexError, KeyError):
+                major = None
+            if major is not None and major != local_proto_version()[0]:
+                ours = "%d.%d" % local_proto_version()
+                raise ReplicationDiverged(
+                    f"slice stream speaks protocol {proto}; this sink "
+                    f"speaks {ours} (incompatible major)"
+                )
         data = chunk.get("data") or b""
         end = int(chunk.get("endOffset", 0))
         if end != len(self._buf) + len(data):

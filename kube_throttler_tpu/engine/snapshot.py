@@ -66,6 +66,9 @@ SNAPSHOT_FORMAT = "kube-throttler-snapshot"
 # write path. Readers accept both; writers emit v2 (with pods staying in
 # "objects" only when the store runs the frozen-dict reference mode).
 SNAPSHOT_VERSION = 2
+# every entry here needs a ``snapshot:<v>`` row in version.FORMAT_REGISTRY
+# (machine-checked by analysis/protocol.py): a version bump cannot land
+# without declaring the minimum reader that replays it.
 SUPPORTED_SNAPSHOT_VERSIONS = (1, 2)
 
 _NAME_RE = re.compile(r"^snapshot-(\d{12})\.ktsnap$")
@@ -110,7 +113,9 @@ def parse_snapshot_bytes(blob: bytes, origin: str = "<bytes>") -> dict:
         raise SnapshotError(f"{origin}: not a {SNAPSHOT_FORMAT} file")
     if header.get("version") not in SUPPORTED_SNAPSHOT_VERSIONS:
         raise SnapshotError(
-            f"{origin}: unsupported snapshot version {header.get('version')!r}"
+            f"{origin}: unsupported snapshot version {header.get('version')!r} "
+            f"(this reader supports {SUPPORTED_SNAPSHOT_VERSIONS}; upgrade "
+            f"the reader, the writer was newer)"
         )
     length = int(header.get("length", -1))
     payload = body.rstrip(b"\n")

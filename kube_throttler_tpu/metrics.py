@@ -179,6 +179,13 @@ METRIC_NAMES = frozenset(
         "kube_throttler_replica_verdicts_total",
         "kube_throttler_replica_lag_events_total",
         "kube_throttler_replica_lag_seconds",
+        # rolling-upgrade safety (register_build_metrics / version.py):
+        # this build's identity + per-shard negotiated protocol rows,
+        # the typed incompatible-major refusal counter, and the
+        # crash-loop guard's current per-shard restart backoff
+        "kube_throttler_build_info",
+        "kube_throttler_shard_version_mismatch_total",
+        "kube_throttler_shard_restart_backoff_seconds",
     }
 )
 
@@ -985,6 +992,74 @@ def register_net_metrics(registry: Registry, front) -> Dict[str, object]:
         "queue_depth": depth_g,
         "partition_seconds": partition_g,
     }
+
+
+def register_build_metrics(
+    registry: Registry, role: str = "front", front=None,
+) -> Dict[str, object]:
+    """Rolling-upgrade observability (kube_throttler_tpu/version.py).
+    ``kube_throttler_build_info`` is a constant-1 gauge whose labels are
+    the data — one row for this process (role, build id, protocol it
+    speaks) and, when ``front`` is given, one row per shard with the
+    hello-negotiated version + capability intersection, so a dashboard
+    shows exactly which fleet members still ride the old minor mid-roll.
+    The mismatch counter moves when a worker refuses an incompatible
+    MAJOR (typed ``VersionMismatch`` — degraded, never a crash loop);
+    the backoff gauge samples the supervisor's crash-loop guard (the
+    per-shard restart delay, 0 when healthy) via the ``supervisor_ref``
+    the supervisor pins on its front."""
+    from .version import BUILD_ID, local_proto_version
+
+    build_g = registry.gauge_vec(
+        "kube_throttler_build_info",
+        "build identity and negotiated wire protocol (value is always "
+        "1; the labels carry the data)",
+        ["role", "shard", "build", "proto", "caps"],
+    )
+    mismatch_c = registry.counter_vec(
+        "kube_throttler_shard_version_mismatch_total",
+        "handshakes the shard refused for an incompatible protocol "
+        "MAJOR (typed VersionMismatch refusals)",
+        ["shard"],
+    )
+    backoff_g = registry.gauge_vec(
+        "kube_throttler_shard_restart_backoff_seconds",
+        "the supervisor crash-loop guard's most recent restart delay "
+        "per shard (jittered-exponential; 0 when healthy)",
+        ["shard"],
+    )
+    own_proto = "%d.%d" % local_proto_version()
+
+    def flush() -> None:
+        build_g.set_key((role, "", BUILD_ID, own_proto, ""), 1.0)
+        if front is None:
+            return
+        for sid in range(front.n_shards):
+            handle = front.shards.get(sid)
+            if handle is None:
+                continue
+            proto = getattr(handle, "negotiated_proto", None)
+            caps = getattr(handle, "negotiated_caps", None) or ()
+            build_g.set_key(
+                (
+                    role,
+                    str(sid),
+                    getattr(handle, "peer_build", None) or "",
+                    "" if proto is None else "%d.%d" % tuple(proto),
+                    ",".join(sorted(caps)),
+                ),
+                1.0,
+            )
+            mismatch_c.set_key(
+                (str(sid),), float(getattr(handle, "version_mismatches", 0))
+            )
+        supervisor = getattr(front, "supervisor_ref", None)
+        if supervisor is not None:
+            for sid, delay in supervisor.backoff_seconds().items():
+                backoff_g.set_key((str(sid),), float(delay))
+
+    registry.register_pre_expose(flush)
+    return {"build": build_g, "mismatches": mismatch_c, "backoff": backoff_g}
 
 
 def register_reshard_metrics(registry: Registry, front) -> Dict[str, object]:

@@ -207,6 +207,32 @@ def _scenarios() -> List[Scenario]:
             slo=SloGates(flip_p99_ms=10_000.0, recovery_s=20.0),
         ),
         Scenario(
+            name="rolling_upgrade",
+            description=(
+                "a live fleet rolled one process at a time under the "
+                "composed bad-day storm: a 3-worker TCP shard fleet takes "
+                "diurnal churn while tools/upgradetest.py bounces every "
+                "worker front-first AND worker-first behind the resync "
+                "barrier (ShardSupervisor.rolling_restart), stages version "
+                "skew via KT_PROTO_CAPS_MASK (old-caps workers speak the "
+                "pickle fallback while new ones speak columnar frames), "
+                "SIGKILLs one non-bouncing shard mid-roll, and refuses an "
+                "incompatible KT_PROTO_MAJOR cleanly (typed VersionMismatch, "
+                "degraded health, paced restarts — no crash loop). Gates: "
+                "zero wrong verdicts, zero lost flips, zero orphan "
+                "reservations, bounded per-bounce recovery. Driven by "
+                "tools/upgradetest.py (`make upgrade-test`) — excluded from "
+                "the generic replay matrix (like partition_bad_day): it "
+                "needs the live fleet its runner builds"
+            ),
+            duration_s=7.0,
+            arrival=Arrival(kind="diurnal", rate_hz=700.0, trough_frac=0.3, cycles=1.5),
+            topology=Topology(pods=6000, throttles=300, groups=150, nodes=8),
+            # no flip SLO: bounces ARE the latency story; the runner gates
+            # per-bounce recovery + the zero-wrong/zero-lost invariants
+            slo=SloGates(flip_p99_ms=10_000.0, recovery_s=20.0),
+        ),
+        Scenario(
             name="preempt_storm",
             description=(
                 "preemption storm: waves of high-priority gangs land on "
@@ -279,10 +305,12 @@ def corpus(include_smoke: bool = False) -> List[Scenario]:
     # the scheduler+preemption stack its dedicated runner builds
     # (scenarios/preemption.py, its own `make scenario-test` line).
     # partition_bad_day likewise: it needs the TCP fleet its runner builds
-    # (scenarios/partition.py, its own `make scenario-test` line)
+    # (scenarios/partition.py, its own `make scenario-test` line).
+    # rolling_upgrade likewise: it needs the live fleet + process bounces
+    # its runner builds (tools/upgradetest.py, `make upgrade-test`)
     out = [
         s for s in _scenarios()
-        if s.name not in ("preempt_storm", "partition_bad_day")
+        if s.name not in ("preempt_storm", "partition_bad_day", "rolling_upgrade")
     ]
     return out if include_smoke else [s for s in out if s.name != "smoke"]
 

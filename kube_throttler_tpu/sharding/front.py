@@ -223,6 +223,12 @@ class AdmissionFront:
         # reshard_state() at scrape; the coordinator drives the counters
         # and the cutover histogram through this dict
         self.reshard_metrics = register_reshard_metrics(self.metrics_registry, self)
+        from ..metrics import register_build_metrics
+
+        # kube_throttler_build_info + version-mismatch counter: this
+        # build's identity plus the per-shard negotiated proto/caps,
+        # sampled from the handles at scrape (rolling-upgrade telemetry)
+        register_build_metrics(self.metrics_registry, role="front", front=self)
         self.health = Health()
         self.health.register("shards", self._shards_health)
         # the Router: batch listener + per-event handlers on the store
@@ -260,7 +266,18 @@ class AdmissionFront:
         for sid in range(self.n_shards):
             handle = self.shards.get(sid)
             state = "ok"
-            if handle is None or not handle.alive:
+            refused = (
+                getattr(handle, "version_refused", None)
+                if handle is not None else None
+            )
+            if refused:
+                # the worker refused our protocol MAJOR (version.py): a
+                # deliberate, typed condition an operator fixes by
+                # upgrading one side — named here so /healthz says WHY
+                # the shard is dark instead of looking like a partition
+                down += 1
+                state = f"version-mismatch: {refused}"
+            elif handle is None or not handle.alive:
                 down += 1
                 state = "down"
                 if handle is not None and getattr(handle, "transport", "") == "tcp":

@@ -20,10 +20,22 @@ Message types:
   controllers wrote a Throttle/ClusterThrottle status) streaming back
   so the front's store stays the merged read view — flips first, like
   the two-lane pipeline they came from.
-- ``"sub"``  front→shard, one-way (TCP only): subscribe THIS connection
-  to the shard's push stream. A socketpair carries exactly one
-  connection so the worker binds pushes at accept; a TCP client keeps a
-  small pool of connections and nominates its primary lane.
+- ``"sub"``  front→shard, one-way: subscribe THIS connection to the
+  shard's push stream. A socketpair carries exactly one connection so
+  the worker binds pushes at accept; a TCP client keeps a small pool of
+  connections and nominates its primary lane. The body is the front's
+  HELLO — ``{"proto": [major, minor], "caps": [...], "build": ...}``
+  (kube_throttler_tpu/version.py) — or ``None`` from a pre-handshake
+  build, which negotiates as the zero-capability 1.0 baseline.
+- ``"hello"`` shard→front: the worker's handshake answer. On agreement
+  it carries the negotiated ``(proto, caps)`` plus the worker's build
+  id; on an incompatible MAJOR it carries a typed refusal
+  (``{"error": "VersionMismatch: ..."}``) and the worker drops the
+  connection — the front surfaces :class:`VersionMismatch`, reports the
+  shard degraded, counts the refusal, and redials at the backoff CAP
+  (an operator fixes versions; the client must not hot-spin). Minor
+  capabilities negotiate down to the intersection, so an old worker and
+  a new front interoperate for the whole rolling upgrade.
 
 Epoch fencing (PR 6 ``FencingEpoch``, end to end over the wire): every
 frame carries the sender's view of the shard's fencing epoch. The front
@@ -91,6 +103,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..utils.lockorder import guard_attrs, make_lock
+from ..version import CAPABILITIES, PROTO_VERSION, local_hello
 
 logger = logging.getLogger(__name__)
 
@@ -139,6 +152,41 @@ class FencedError(RuntimeError):
     """The peer refused a stale-epoch frame — the wire-level 409. The
     holder of a stale epoch missed a resync/reshard/promotion while
     partitioned and must NOT be trusted until re-synced."""
+
+
+class VersionMismatch(RuntimeError):
+    """The peer refused the handshake: incompatible protocol MAJOR
+    (version.py compatibility rules). Deliberate and terminal until one
+    side is upgraded — the front degrades fail-safe and keeps redialing
+    slowly; nothing crash-loops."""
+
+
+# Capability-gated "evt" batch encodings. v1 (the only pre-handshake
+# form) is the plain op list; "evt-columnar" peers accept the
+# struct-of-arrays transpose — shared verb/kind strings collapse into
+# three homogeneous columns instead of riding every row's tuple. The
+# DECODER is shape-sniffing and always available; the negotiated
+# capability gates the SENDER, so an old worker only ever sees v1.
+_EVT_COLS_V2 = "__kt_evt_cols_v2__"
+
+
+def encode_evt_batch(ops: Sequence["Op"]) -> tuple:
+    return (
+        _EVT_COLS_V2,
+        [op[0] for op in ops],
+        [op[1] for op in ops],
+        [op[2] for op in ops],
+    )
+
+
+def decode_evt_batch(body) -> List["Op"]:
+    if (
+        isinstance(body, tuple)
+        and len(body) == 4
+        and body[0] == _EVT_COLS_V2
+    ):
+        return list(zip(body[1], body[2], body[3]))
+    return list(body)
 
 
 def send_frame(
@@ -218,12 +266,42 @@ def _raise_shard_error(shard_id: int, op: str, body) -> None:
     msg = str(body)
     if msg.startswith("FencedError"):
         raise FencedError(f"shard {shard_id} {op} fenced: {msg}")
+    if msg.startswith("VersionMismatch"):
+        raise VersionMismatch(f"shard {shard_id} {op} refused: {msg}")
     raise RuntimeError(f"shard {shard_id} {op} failed: {msg}")
 
 
 def _sheddable(op: Op) -> bool:
     verb, kind, _ = op
     return kind == "Pod" and verb != "delete"
+
+
+def _apply_hello(handle, body) -> None:
+    """Record a worker's ``hello`` answer on a client handle (runs on
+    the handle's reader thread — the negotiation fields are that
+    thread's single-writer state, read racily by metrics/health)."""
+    if isinstance(body, dict) and "error" in body:
+        handle.version_refused = str(body["error"])
+        handle.version_mismatches += 1
+        logger.warning(
+            "shard %d: handshake refused: %s",
+            handle.shard_id, handle.version_refused,
+        )
+        return
+    try:
+        proto = (int(body["proto"][0]), int(body["proto"][1]))
+        caps = frozenset(
+            c for c in body.get("caps", ()) if isinstance(c, str)
+        )
+    except (TypeError, KeyError, ValueError, IndexError):
+        logger.warning(
+            "shard %d: malformed hello %r", handle.shard_id, body
+        )
+        return
+    handle.negotiated_proto = proto
+    handle.negotiated_caps = caps
+    handle.peer_build = body.get("build")
+    handle.version_refused = None
 
 
 @guard_attrs
@@ -289,6 +367,13 @@ class ShardClient:
         self.dirty = False  # lost events/sends — needs resync
         self.deadline_exceeded = 0  # RPCs that outran their budget
         self.reconnects = 0  # a socketpair cannot reconnect (metrics parity)
+        # handshake outcome (reader-thread single-writer after the
+        # worker's hello lands; None until then = 1.0 baseline, no caps)
+        self.negotiated_proto: Optional[Tuple[int, int]] = None
+        self.negotiated_caps: Optional[frozenset] = None
+        self.peer_build: Optional[str] = None
+        self.version_refused: Optional[str] = None
+        self.version_mismatches = 0
         self._sender = threading.Thread(
             target=self._send_loop, name=f"shard{shard_id}-send", daemon=True
         )
@@ -297,6 +382,15 @@ class ShardClient:
         )
         self._sender.start()
         self._reader.start()
+        # version/capability handshake: a socketpair has exactly one
+        # "connection", so the hello rides a sub frame at construction
+        # (the worker re-binds the same push sink — idempotent). Sent
+        # without the fault plan: the handshake is not a chaos target.
+        try:
+            send_frame(self.sock, self._send_lock, "sub", 0, local_hello(),
+                       epoch=self.epoch)
+        except OSError:
+            pass  # a dead-at-birth child surfaces through the reader
 
     # ------------------------------------------------------------- events
 
@@ -364,7 +458,12 @@ class ShardClient:
                             raise OSError(
                                 f"injected IPC send failure (hit {fault.hit})"
                             )
-                    send_frame(self.sock, self._send_lock, "evt", 0, batch,
+                    body = (
+                        encode_evt_batch(batch)
+                        if self.has_cap("evt-columnar")
+                        else batch
+                    )
+                    send_frame(self.sock, self._send_lock, "evt", 0, body,
                                epoch=self.epoch, faults=self.faults)
                     self.events_sent += len(batch)
                     self.frames_sent += 1
@@ -438,6 +537,8 @@ class ShardClient:
                         slot[0].set()
                 elif mtype == "push" and self.on_push is not None:
                     self.on_push(self.shard_id, body)
+                elif mtype == "hello":
+                    _apply_hello(self, body)
         except (OSError, pickle.UnpicklingError, EOFError):
             pass
         finally:
@@ -449,6 +550,13 @@ class ShardClient:
     @property
     def alive(self) -> bool:
         return self._alive and not self._closed
+
+    def has_cap(self, name: str) -> bool:
+        """True iff the handshake negotiated this minor capability.
+        False before the worker's hello lands — pre-handshake traffic
+        uses the v1 baseline encodings by construction."""
+        caps = self.negotiated_caps
+        return caps is not None and name in caps
 
     def _mark_down(self) -> None:
         was = self._alive
@@ -626,6 +734,18 @@ class TcpShardClient:
         self.reconnects = 0  # primary-lane re-establishments after a drop
         self.partition_seconds = 0.0  # cumulative primary-lane downtime
         self.fenced_pushes = 0  # stale-epoch pushes dropped (reader thread)
+        # handshake outcome (reader-thread single-writer, like
+        # fenced_pushes): None until the worker's hello lands = the
+        # zero-capability 1.0 baseline. version_refused holds the
+        # worker's typed refusal while the majors disagree — the
+        # reconnector slows to the backoff CAP and request() fails fast
+        # with VersionMismatch instead of burning its deadline.
+        self.negotiated_proto: Optional[Tuple[int, int]] = None
+        self.negotiated_caps: Optional[frozenset] = None
+        self.peer_build: Optional[str] = None
+        self.version_refused: Optional[str] = None
+        self.version_mismatches = 0
+        self._refusal_delay = max(1.0, float(backoff_cap))
         self._down_since: Optional[float] = time.monotonic()
         self._sender = threading.Thread(
             target=self._send_loop, name=f"shard{shard_id}-tcp-send", daemon=True
@@ -657,11 +777,13 @@ class TcpShardClient:
             if idx == 0:
                 # nominate this lane as the push stream (and teach the
                 # worker our current epoch before any RPC rides it).
-                # Faults apply here too: under net.partition the sub
-                # frame blackholes like any other send, so a partitioned
-                # client stays DOWN in backoff instead of flapping
-                # up-then-down once per establishment
-                send_frame(sock, conn.send_lock, "sub", 0, None,
+                # The body is our HELLO: the worker answers with the
+                # negotiated version/caps (or a VersionMismatch refusal)
+                # on a "hello" frame. Faults apply here too: under
+                # net.partition the sub frame blackholes like any other
+                # send, so a partitioned client stays DOWN in backoff
+                # instead of flapping up-then-down once per establishment
+                send_frame(sock, conn.send_lock, "sub", 0, local_hello(),
                            epoch=self.epoch, faults=self.faults,
                            key=self.auth_key)
             if self.faults is not None:
@@ -719,6 +841,14 @@ class TcpShardClient:
                     with self._ccond:
                         if not self._closed:
                             self._ccond.wait(delay)
+                elif self.version_refused is not None and not self._closed:
+                    # the worker refused our major and dropped the lane:
+                    # redialing faster cannot help (an operator upgrades
+                    # one side), so pace at the cap — degraded, counted,
+                    # never a crash loop
+                    with self._ccond:
+                        if not self._closed:
+                            self._ccond.wait(self._refusal_delay)
         except Exception:  # noqa: BLE001 — route the death, don't hide it
             logger.exception("shard %d: tcp reconnector died", self.shard_id)
 
@@ -868,7 +998,12 @@ class TcpShardClient:
                         raise OSError(
                             f"injected IPC send failure (hit {fault.hit})"
                         )
-                send_frame(conn.sock, conn.send_lock, "evt", 0, batch,
+                body = (
+                    encode_evt_batch(batch)
+                    if self.has_cap("evt-columnar")
+                    else batch
+                )
+                send_frame(conn.sock, conn.send_lock, "evt", 0, body,
                            epoch=self.epoch, faults=self.faults,
                            key=self.auth_key)
                 self.events_sent += len(batch)
@@ -892,6 +1027,11 @@ class TcpShardClient:
         passes, :class:`FencedError` on a stale-epoch refusal."""
         if timeout is None:
             timeout = self.deadline_for(op)
+        refused = self.version_refused
+        if refused is not None:
+            raise VersionMismatch(
+                f"shard {self.shard_id} refused the handshake: {refused}"
+            )
         if not self.alive:
             raise ShardUnavailable(
                 f"shard {self.shard_id} is unreachable ({self.host}:{self.port})"
@@ -954,6 +1094,8 @@ class TcpShardClient:
                         self.fenced_pushes += 1
                     elif self.on_push is not None:
                         self.on_push(self.shard_id, body)
+                elif mtype == "hello":
+                    _apply_hello(self, body)
         except (OSError, pickle.UnpicklingError, EOFError):
             pass
         except Exception:  # noqa: BLE001 — route the death, don't hide it
@@ -970,6 +1112,13 @@ class TcpShardClient:
     @property
     def alive(self) -> bool:
         return self._alive and not self._closed
+
+    def has_cap(self, name: str) -> bool:
+        """True iff the handshake negotiated this minor capability.
+        False before the worker's hello lands — pre-handshake traffic
+        uses the v1 baseline encodings by construction."""
+        caps = self.negotiated_caps
+        return caps is not None and name in caps
 
     def bump_epoch(self) -> int:
         """Advance the fencing epoch (resync head): frames stamped with
@@ -1041,6 +1190,12 @@ class LocalShard:
         self.epoch = 0
         self.deadline_exceeded = 0
         self.reconnects = 0
+        # in-process "handshake": trivially the local build's identity
+        self.negotiated_proto = PROTO_VERSION
+        self.negotiated_caps = CAPABILITIES
+        self.peer_build = None
+        self.version_refused = None
+        self.version_mismatches = 0
         if on_push is not None:
             core.push = lambda items: on_push(shard_id, items)
 
@@ -1068,6 +1223,9 @@ class LocalShard:
     def deadline_for(self, op: str) -> float:
         return 30.0
 
+    def has_cap(self, name: str) -> bool:
+        return name in self.negotiated_caps
+
     def request(self, op: str, payload=None, timeout: Optional[float] = None):
         if not self.alive:
             raise ShardUnavailable(f"shard {self.shard_id} is down")
@@ -1086,8 +1244,11 @@ __all__ = [
     "TcpShardClient",
     "ShardUnavailable",
     "FencedError",
+    "VersionMismatch",
     "LocalShard",
     "send_frame",
     "read_frame",
+    "encode_evt_batch",
+    "decode_evt_batch",
     "PICKLE_PROTO",
 ]

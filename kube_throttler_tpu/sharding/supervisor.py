@@ -56,6 +56,9 @@ class ShardSupervisor:
         "procs": "self._proc_lock",
         "restarts": "self._proc_lock",
         "conn_lost": "self._proc_lock",
+        "_backoffs": "self._proc_lock",
+        "_last_backoff": "self._proc_lock",
+        "_suspended": "self._proc_lock",
     }
 
     def __init__(
@@ -67,6 +70,7 @@ class ShardSupervisor:
         data_dir: Optional[str] = None,
         ingest_batch="adaptive",
         restart_backoff: float = 0.5,
+        restart_backoff_cap: float = 30.0,
         max_restarts: int = 10,
         worker_args: Optional[List[str]] = None,
         per_shard_args: Optional[Dict[int, List[str]]] = None,
@@ -78,6 +82,10 @@ class ShardSupervisor:
         if transport not in ("socketpair", "tcp"):
             raise ValueError(f"unknown shard transport {transport!r}")
         self.front = front
+        # the build-metrics flush samples backoff_seconds() through this
+        # (register_build_metrics — the front registers the family before
+        # any supervisor exists, so the wiring is late-bound)
+        front.supervisor_ref = self
         self.n_shards = front.n_shards
         self.name = name
         self.target_scheduler = target_scheduler
@@ -85,6 +93,13 @@ class ShardSupervisor:
         self.data_dir = data_dir
         self.ingest_batch = ingest_batch
         self.restart_backoff = restart_backoff
+        # crash-loop guard ceiling: per-shard restart delays grow
+        # jittered-exponentially (PR 1 Backoff) from restart_backoff up
+        # to this cap, and reset once a restarted shard resyncs healthy —
+        # a worker dying on a version refusal or bad config paces out
+        # instead of hot-spinning through its restart budget
+        self.restart_backoff_cap = max(float(restart_backoff_cap),
+                                       float(restart_backoff))
         self.max_restarts = max_restarts
         self.worker_args = list(worker_args or [])
         # one-shot per-shard args for each shard's FIRST incarnation only
@@ -106,6 +121,12 @@ class ShardSupervisor:
         self.procs: Dict[int, subprocess.Popen] = {}
         self.restarts: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
         self.conn_lost: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
+        # per-shard restart pacing state (crash-loop guard) + the shards
+        # a rolling_restart() currently owns (the monitor must not race
+        # the roll's own bounce with a second restart)
+        self._backoffs: Dict[int, object] = {}
+        self._last_backoff: Dict[int, float] = {i: 0.0 for i in range(self.n_shards)}
+        self._suspended: set = set()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         # one rescale at a time: concurrent callers fail fast (two ring
@@ -146,6 +167,12 @@ class ShardSupervisor:
         env.setdefault("JAX_PLATFORMS", "cpu")
         if self.auth_key is not None:
             env["KT_SHARD_AUTH_KEY"] = self.auth_key.decode("utf-8")
+        # rolling-upgrade skew knobs (version.py): reach children even
+        # when a custom env snapshot predates the harness exporting them
+        # (tools/upgradetest.py re-masks capabilities between bounces)
+        for var in ("KT_PROTO_CAPS_MASK", "KT_PROTO_MAJOR"):
+            if var in os.environ:
+                env.setdefault(var, os.environ[var])
         return env
 
     def _tcp_client(self, shard_id: int, host: str, port: int) -> TcpShardClient:
@@ -353,6 +380,8 @@ class ShardSupervisor:
             sids = sorted(self.procs)
         for sid in sids:
             with self._proc_lock:
+                if sid in self._suspended:
+                    continue  # rolling_restart() owns this bounce
                 proc = self.procs.get(sid)
             if proc is None or proc.poll() is None:
                 continue
@@ -377,7 +406,9 @@ class ShardSupervisor:
             old = self.front.shards.get(sid)
             if old is not None:
                 old.close()
-            time.sleep(self.restart_backoff)
+            time.sleep(self._restart_delay(sid))
+            if self._stop.is_set():
+                return
             try:
                 fresh = self._spawn(sid)
                 # wait for readiness, then replay its keyspace slice
@@ -395,8 +426,40 @@ class ShardSupervisor:
                             raise
                         time.sleep(0.1)
                 self.front.resync_shard(sid)
+                self._reset_backoff(sid)
             except Exception:  # noqa: BLE001 — retried on the next tick
                 logger.exception("shard %d restart failed", sid)
+
+    def _restart_delay(self, sid: int) -> float:
+        """Next restart delay for a shard that just died: per-shard
+        jittered-exponential growth (PR 1 Backoff) from restart_backoff
+        to restart_backoff_cap. A shard whose restart resyncs healthy
+        resets to the base — only consecutive deaths pace out."""
+        from ..client.transport import Backoff
+
+        with self._proc_lock:
+            bo = self._backoffs.get(sid)
+            if bo is None:
+                bo = Backoff(base=self.restart_backoff,
+                             cap=self.restart_backoff_cap)
+                self._backoffs[sid] = bo
+            delay = bo.next()
+            self._last_backoff[sid] = delay
+        return delay
+
+    def _reset_backoff(self, sid: int) -> None:
+        with self._proc_lock:
+            bo = self._backoffs.get(sid)
+            if bo is not None:
+                bo.reset()
+            self._last_backoff[sid] = 0.0
+
+    def backoff_seconds(self) -> Dict[int, float]:
+        """Per-shard most-recent restart-backoff delay, 0.0 when healthy
+        (the kube_throttler_shard_restart_backoff_seconds gauge samples
+        this at scrape; tests pin growth-then-reset)."""
+        with self._proc_lock:
+            return dict(self._last_backoff)
 
     # ------------------------------------------------------ live resharding
 
@@ -431,6 +494,108 @@ class ShardSupervisor:
         the ``procs`` map itself is guarded."""
         with self._proc_lock:
             return self.procs.get(shard_id)
+
+    def rolling_restart(
+        self,
+        ready_timeout: float = 120.0,
+        settle_timeout: float = 60.0,
+        shard_ids: Optional[List[int]] = None,
+        gate=None,
+    ) -> Dict:
+        """Bounce every local worker ONE AT A TIME behind a resync
+        barrier — the orchestrated roll of a live upgrade (new binary,
+        new env, new capability mask). Each bounce: suspend the monitor's
+        restart policy for that shard, terminate the old incarnation (the
+        front degrades fail-safe for exactly that keyspace slice), spawn
+        the replacement, wait ready, resync (replay + prune + flip
+        re-publication), then hold at the barrier until the shard reports
+        healthy (alive + not dirty) before the next bounce begins — the
+        roll never darkens two keyspaces at once.
+
+        ``gate`` (optional, ``gate(shard_id) -> falsy | reason``) runs
+        after every bounce; a truthy reason ABORTS the roll with the rest
+        of the fleet still on its old incarnation. Remote workers are
+        skipped (somebody else's process; roll them from their own host).
+        Returns ``{"bounces": [...], "aborted": None | {...}}``."""
+        if not self._rescale_busy.acquire(blocking=False):
+            raise RuntimeError("a rescale or rolling restart is already in progress")
+        try:
+            return self._rolling_restart_locked(
+                ready_timeout, settle_timeout, shard_ids, gate
+            )
+        finally:
+            self._rescale_busy.release()
+
+    def _rolling_restart_locked(
+        self, ready_timeout, settle_timeout, shard_ids, gate
+    ) -> Dict:
+        sids = sorted(range(self.n_shards) if shard_ids is None else shard_ids)
+        report: Dict = {"bounces": [], "aborted": None}
+        for sid in sids:
+            if sid in self.remote_workers:
+                continue
+            t0 = time.monotonic()
+            with self._proc_lock:
+                self._suspended.add(sid)
+                proc = self.procs.get(sid)
+            try:
+                old = self.front.shards.get(sid)
+                if old is not None:
+                    old.close()
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10.0)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait(timeout=5.0)
+                fresh = self._spawn(sid)
+                self._wait_ready(sid, fresh, ready_timeout)
+                self.front.resync_shard(sid)
+                self._settle_shard(sid, settle_timeout)
+            except Exception as e:  # noqa: BLE001 — abort, don't cascade
+                # abort the roll: the rest of the fleet stays on its old
+                # incarnation, and the monitor resumes babysitting this
+                # shard once it leaves the suspended set below
+                logger.exception("rolling restart aborted at shard %d", sid)
+                report["aborted"] = {
+                    "shard": sid,
+                    "reason": f"{e.__class__.__name__}: {e}",
+                }
+                break
+            finally:
+                with self._proc_lock:
+                    self._suspended.discard(sid)
+            self._reset_backoff(sid)
+            bounce = {"shard": sid, "seconds": time.monotonic() - t0}
+            if gate is not None:
+                breach = gate(sid)
+                if breach:
+                    bounce["gate"] = str(breach)
+                    report["bounces"].append(bounce)
+                    report["aborted"] = {
+                        "shard": sid, "reason": f"gate breach: {breach}",
+                    }
+                    return report
+            report["bounces"].append(bounce)
+        return report
+
+    def _settle_shard(self, sid: int, settle_timeout: float) -> None:
+        """The resync barrier: a bounced shard must report healthy
+        (alive, resynced, not dirty) before the roll moves on — taking a
+        second worker down while the first still warms is the
+        double-failure the one-at-a-time discipline exists to avoid."""
+        deadline = time.monotonic() + settle_timeout
+        while time.monotonic() < deadline:
+            handle = self.front.shards.get(sid)
+            if handle is not None and handle.alive and not handle.is_dirty():
+                return
+            if self._stop.is_set():
+                raise RuntimeError("supervisor stopping mid-roll")
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"shard {sid} did not settle within {settle_timeout}s of its bounce"
+        )
 
     def rescale(
         self,

@@ -92,6 +92,10 @@ class ShardCore:
         "wire_epoch": "self._epoch_lock",
         "fenced_events": "self._epoch_lock",
         "fenced_reqs": "self._epoch_lock",
+        "negotiated_proto": "self._epoch_lock",
+        "negotiated_caps": "self._epoch_lock",
+        "peer_build": "self._epoch_lock",
+        "version_mismatches": "self._epoch_lock",
     }
 
     def __init__(
@@ -210,6 +214,14 @@ class ShardCore:
         self.wire_epoch = 0
         self.fenced_events = 0  # stale-epoch evt ops dropped
         self.fenced_reqs = 0  # stale-epoch RPCs refused (the wire 409)
+        # rolling-upgrade handshake outcome (version.py): the negotiated
+        # (major, minor) + capability intersection for the current
+        # primary lane, and the count of incompatible-major hellos this
+        # worker refused with a typed VersionMismatch frame
+        self.negotiated_proto: Optional[Tuple[int, int]] = None
+        self.negotiated_caps: frozenset = frozenset()
+        self.peer_build: Optional[str] = None
+        self.version_mismatches = 0
         self._stop = threading.Event()
         for kind in ("Throttle", "ClusterThrottle"):
             self.store.add_event_handler(kind, self._on_status_event, replay=False)
@@ -296,6 +308,37 @@ class ShardCore:
     def current_epoch(self) -> int:
         with self._epoch_lock:
             return self.wire_epoch
+
+    # ------------------------------------------------------------ handshake
+
+    def record_negotiation(self, proto, caps, build) -> None:
+        with self._epoch_lock:
+            self.negotiated_proto = (int(proto[0]), int(proto[1]))
+            self.negotiated_caps = frozenset(caps)
+            self.peer_build = build
+
+    def record_version_mismatch(self) -> None:
+        with self._epoch_lock:
+            self.version_mismatches += 1
+
+    def negotiated_state(self) -> Dict[str, object]:
+        """The build_info view: this build's identity plus the current
+        primary lane's negotiated version/caps (version.py contracts)."""
+        from ..version import BUILD_ID, local_proto_version
+
+        with self._epoch_lock:
+            proto = self.negotiated_proto
+            caps = self.negotiated_caps
+            build = self.peer_build
+            mismatches = self.version_mismatches
+        return {
+            "build": BUILD_ID,
+            "proto": list(local_proto_version()),
+            "negotiated_proto": None if proto is None else list(proto),
+            "negotiated_caps": sorted(caps),
+            "peer_build": build,
+            "version_mismatches": mismatches,
+        }
 
     # ---------------------------------------------------------------- events
 
@@ -532,6 +575,7 @@ class ShardCore:
             "epoch": self.epoch.current() if self.epoch is not None else 0,
             "wire_epoch": self.current_epoch(),
             "fenced_frames": self._fenced_counts(),
+            "version": self.negotiated_state(),
         }
 
     def _fenced_counts(self) -> Dict[str, int]:
@@ -1008,7 +1052,14 @@ def serve(
     (socketpair children, loopback test rigs)."""
     from concurrent.futures import ThreadPoolExecutor
 
-    from .ipc import read_frame, send_frame
+    from ..version import (
+        BUILD_ID,
+        NegotiationError,
+        advertised_capabilities,
+        local_proto_version,
+        negotiate,
+    )
+    from .ipc import decode_evt_batch, read_frame, send_frame
 
     send_lock = make_lock(f"shard.serve.{core.shard_id}")
 
@@ -1050,9 +1101,10 @@ def serve(
                 return
             mtype, rid, body, epoch = frame
             if mtype == "evt":
-                if not core.observe_epoch(epoch, "evt", len(body)):
+                ops = decode_evt_batch(body)
+                if not core.observe_epoch(epoch, "evt", len(ops)):
                     continue  # a stale peer's events must not touch state
-                core.handle_events(body)
+                core.handle_events(ops)
             elif mtype == "req":
                 if not core.observe_epoch(epoch):
                     pool.submit(refuse, rid, epoch)
@@ -1060,13 +1112,56 @@ def serve(
                 op, payload = body
                 pool.submit(answer, rid, op, payload)
             elif mtype == "sub":
-                if core.observe_epoch(epoch, "sub"):
+                if not core.observe_epoch(epoch, "sub"):
+                    # a STALE sub is counted fenced and must not rebind
+                    # the push stream: a partitioned-then-healed (not yet
+                    # resynced) peer's subscribe would otherwise steal
+                    # the lane from the current primary and route every
+                    # flip to a connection the fencing contract says not
+                    # to trust
+                    continue
+                # version/capability handshake (version.py): the sub body
+                # is the front's hello, or None from a pre-handshake
+                # build (negotiates as the zero-capability 1.0 baseline,
+                # no reply — it would not understand a hello frame).
+                if body is None:
+                    core.record_negotiation(
+                        (local_proto_version()[0], 0), frozenset(), None
+                    )
                     core.push = push
-                # a STALE sub is counted fenced and must not rebind the
-                # push stream: a partitioned-then-healed (not yet
-                # resynced) peer's subscribe would otherwise steal the
-                # lane from the current primary and route every flip to
-                # a connection the fencing contract says not to trust
+                    continue
+                try:
+                    proto, caps = negotiate(
+                        local_proto_version(), advertised_capabilities(),
+                        body.get("proto"), body.get("caps"),
+                    )
+                except NegotiationError as e:
+                    # typed refusal, then DROP this connection: redialing
+                    # cannot help until an operator upgrades one side.
+                    # Over TCP the process stays up (only this lane
+                    # dies); a socketpair child exits and the
+                    # supervisor's jittered backoff paces the restarts —
+                    # degraded and counted either way, never a hot loop
+                    core.record_version_mismatch()
+                    logger.warning(
+                        "shard %d: refusing handshake: %s", core.shard_id, e
+                    )
+                    try:
+                        send_frame(sock, send_lock, "hello", 0,
+                                   {"error": f"VersionMismatch: {e}"},
+                                   epoch=core.current_epoch(), key=auth_key)
+                    except OSError:
+                        pass
+                    return
+                core.record_negotiation(proto, caps, body.get("build"))
+                core.push = push
+                try:
+                    send_frame(sock, send_lock, "hello", 0,
+                               {"proto": list(proto), "caps": sorted(caps),
+                                "build": BUILD_ID},
+                               epoch=core.current_epoch(), key=auth_key)
+                except OSError:
+                    pass  # front gone; the reconnect re-handshakes
     except OSError:
         return
     finally:

@@ -1015,6 +1015,92 @@ class TestProtocol:
         assert "bad_append" in found[0].message
         assert "not dominated by a fencing-epoch check" in found[0].message
 
+    def test_format_registry_coverage_and_stale_rows(self, tmp_path):
+        """Planted rolling-upgrade bugs: a sent frame type, an emitted
+        journal control type, and a supported snapshot version each
+        missing their FORMAT_REGISTRY row, plus one stale row and one
+        unknown-domain row — all five must fire."""
+        root = write_tree(
+            tmp_path,
+            {
+                "version.py": '''\
+                FORMAT_REGISTRY = {
+                    "frame:evt": 1,
+                    "journal:EPOCH": 1,
+                    "snapshot:1": 1,
+                    "frame:ghost": 1,
+                    "weird:row": 1,
+                }
+                ''',
+                "sharding/ipc.py": '''\
+                def send_frame(sock, lock, mtype, rid, body):
+                    pass
+                ''',
+                "sharding/front.py": '''\
+                from .ipc import send_frame
+
+
+                class Front:
+                    def send(self, sock, lock):
+                        send_frame(sock, lock, "evt", 0, [])
+                        send_frame(sock, lock, "zap", 0, [])
+                ''',
+                "sharding/worker.py": '''\
+                def serve(rfile):
+                    while True:
+                        mtype = read(rfile)
+                        if mtype == "evt":
+                            pass
+                        elif mtype == "zap":
+                            pass
+                ''',
+                "engine/journal.py": '''\
+                import json
+
+
+                class StoreJournal:
+                    def _apply(self, event):
+                        if event["type"] == "EPOCH":
+                            return
+                        if event["type"] == "GANG":
+                            return
+
+                    def _compact_locked(self):
+                        self._file.write(json.dumps({"type": "EPOCH", "epoch": 1}))
+                        self._file.write(json.dumps({"type": "GANG", "op": "x"}))
+                ''',
+                "engine/snapshot.py": '''\
+                SUPPORTED_SNAPSHOT_VERSIONS = (1, 2)
+                ''',
+            },
+        )
+        found = findings_for(root, ("protocol",))
+        msgs = [f.message for f in found]
+        assert any("no 'frame:zap' row" in m for m in msgs), msgs
+        assert any("no 'journal:GANG' row" in m for m in msgs), msgs
+        assert any("no 'snapshot:2' row" in m for m in msgs), msgs
+        assert any("'frame:ghost'" in m and "stale" in m for m in msgs), msgs
+        assert any("'weird:row'" in m and "unknown domain" in m for m in msgs), msgs
+        # declared rows referenced by the code are NOT findings
+        assert not any("'frame:evt'" in m for m in msgs), msgs
+        assert not any("'journal:EPOCH'" in m for m in msgs), msgs
+        assert not any("'snapshot:1'" in m for m in msgs), msgs
+
+    def test_computed_format_registry_fires(self, tmp_path):
+        root = write_tree(
+            tmp_path,
+            {
+                "version.py": '''\
+                _ROWS = [("frame:evt", 1)]
+                FORMAT_REGISTRY = dict(_ROWS)
+                ''',
+            },
+        )
+        found = findings_for(root, ("protocol",))
+        assert any(
+            "pure dict literal" in f.message and f.line == 2 for f in found
+        ), [f.render() for f in found]
+
 
 # ------------------------------------------------------------- stale waivers
 
