@@ -1,7 +1,7 @@
 # Dev workflow targets (reference Makefile parity, minus Go/kind).
 PY ?= python
 
-.PHONY: test test-stress race-test crash-test ha-test reshard-test net-chaos upgrade-test scenario-test shard-scenario reshard-scenario preempt-scenario partition-scenario replica-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
+.PHONY: test test-stress race-test crash-test ha-test reshard-test net-chaos shm-chaos upgrade-test scenario-test shard-scenario reshard-scenario preempt-scenario partition-scenario replica-scenario scenario-regression scenario-hunt scenario-hunt-smoke scenario-hunt-long scenario-hunt-nightly lint ci gen bench bench-quick walkthrough smoke serve clean native image dev-cluster dev-run dev-teardown
 
 native:          ## build the C++ selector row-match engine (auto-built on import too)
 	$(PY) -c "from kube_throttler_tpu.native import load; import sys; \
@@ -53,8 +53,11 @@ partition-scenario: ## TCP-fleet partition bad day alone: asymmetric partition +
 replica-scenario: ## read-replica serving tier alone: storm + leader flip burst, verdict-oracle + lag-SLO + staleness/forwarding gates
 	env JAX_PLATFORMS=cpu $(PY) -m kube_throttler_tpu.scenarios.replica --seed 0
 
-net-chaos:       ## network-fault matrix: every net.* site x 3 seeds through a live 2-worker TCP fleet; verdict-oracle + zero-orphan + zero-lost-flip gates
+net-chaos:       ## transport-fault matrix: every net.* site x 3 seeds through a live 2-worker TCP fleet + every shm.* site through a live socketpair fleet; verdict-oracle + zero-orphan + zero-lost-flip gates
 	env JAX_PLATFORMS=cpu $(PY) tools/netchaostest.py matrix
+
+shm-chaos:       ## shared-memory event-plane faults only: every shm.* site through a live socketpair fleet with the ring asserted ACTIVE pre-fault; restart-delta + verdict-oracle + zero-leaked-segment gates
+	env JAX_PLATFORMS=cpu $(PY) tools/netchaostest.py matrix --only shm
 
 upgrade-test:    ## rolling-upgrade chaos matrix: front-first + worker-first rolls with capability skew, mid-roll SIGKILL, and the clean incompatible-major refusal, over a live 3-worker TCP fleet
 	env JAX_PLATFORMS=cpu $(PY) tools/upgradetest.py matrix

@@ -48,7 +48,9 @@ registry's emit and dispatch sides against each other:
    ``frame:<mtype>`` row, every emitted journal control type needs a
    ``journal:<TYPE>`` row, every entry of
    ``snapshot.SUPPORTED_SNAPSHOT_VERSIONS`` needs a ``snapshot:<v>``
-   row. Stale rows (a registry entry whose referent no longer exists in
+   row, and every entry of ``shmring.SHM_FORMATS`` (the shared-memory
+   event-ring layouts) needs an ``shm:<name>`` row. Stale rows (a
+   registry entry whose referent no longer exists in
    the code) are findings too — a dead row misstates the compatibility
    surface to operators planning a roll.
 """
@@ -482,6 +484,44 @@ def _check_format_registry(modules: Sequence[Module], findings: List[Finding]) -
                 )
             )
 
+    # shm ring layouts: every entry of shmring.SHM_FORMATS needs an
+    # shm:<name> row (same contract shape as snapshot versions — the
+    # reader must be able to name the layout it requires)
+    shm_formats: Dict[str, Tuple[str, int]] = {}
+    have_shmring = False
+    for m in modules:
+        if not _norm(m.relpath).endswith("sharding/shmring.py"):
+            continue
+        have_shmring = True
+        for node in m.walk():
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SHM_FORMATS"
+                for t in node.targets
+            ):
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            shm_formats.setdefault(
+                                elt.value, (m.relpath, node.lineno)
+                            )
+    for name, (relpath, line) in sorted(shm_formats.items()):
+        if f"shm:{name}" not in rows:
+            findings.append(
+                Finding(
+                    checker="protocol",
+                    path=relpath,
+                    relpath=relpath,
+                    line=line,
+                    message=(
+                        f"shm ring format '{name}' has no "
+                        f"'shm:{name}' row in version.FORMAT_REGISTRY "
+                        "— its min-reader contract is undeclared"
+                    ),
+                )
+            )
+
     # stale rows: a registry entry whose referent no longer exists
     # misstates the compatibility surface (only judged for domains whose
     # source of truth is present in the tree)
@@ -491,8 +531,9 @@ def _check_format_registry(modules: Sequence[Module], findings: List[Finding]) -
             (domain == "frame" and have_sharding and name not in frame_uses)
             or (domain == "journal" and have_journal and name not in emitted)
             or (domain == "snapshot" and have_snapshot and name not in snap_versions)
+            or (domain == "shm" and have_shmring and name not in shm_formats)
         )
-        unknown = domain not in ("frame", "journal", "snapshot")
+        unknown = domain not in ("frame", "journal", "snapshot", "shm")
         if stale or unknown:
             findings.append(
                 Finding(
@@ -503,7 +544,8 @@ def _check_format_registry(modules: Sequence[Module], findings: List[Finding]) -
                     message=(
                         f"FORMAT_REGISTRY row '{row}' is "
                         + (
-                            "in an unknown domain (expected frame:/journal:/snapshot:)"
+                            "in an unknown domain "
+                        "(expected frame:/journal:/snapshot:/shm:)"
                             if unknown
                             else "stale — nothing in the code emits or supports it"
                         )

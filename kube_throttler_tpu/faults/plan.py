@@ -157,6 +157,30 @@ reshard.front.crash         the coordinator dies between prepare and
                             other mode abandons the handoff WITHOUT
                             cleanup — both sides' two-phase reapers must
                             TTL the orphan (zero orphan reservations)
+shm.ring.full               the shared-memory event ring reports itself
+                            saturated: mode "delay" makes the writer
+                            backpressure (counted) for the rule's delay,
+                            any other mode fails the push — the lane dies
+                            and the supervisor restart + resync repairs
+                            it (sharding/shmring.py ShmRingWriter.push)
+shm.slot.torn_commit        the writer commits a slot with a garbage
+                            commit word — exactly what dying mid-commit
+                            leaves behind. The reader MUST detect it
+                            (TornSlotError), never consume the slot, and
+                            route its own death so restart + resync
+                            repairs (shmring ShmRingReader._check)
+shm.doorbell.lost           the post-commit doorbell byte is dropped: the
+                            reader's bounded poll must still find the
+                            frame — latency, never loss (ShmRingWriter)
+shm.reader.stall            the worker's ring pump stalls for the rule's
+                            ``delay`` before polling — the writer must
+                            backpressure, counted, without dropping a
+                            committed frame (ShmRingReader.peek)
+shm.segment.unlink          the creator loses the segment-unlink race at
+                            close: the name is left behind and the
+                            supervisor's sweep_segments backstop must
+                            remove it — no leaked /dev/shm segments
+                            (ShmRingWriter.close / supervisor.stop)
 ==========================  ==================================================
 
 Virtual-time rules (the scenario engine's vocabulary): a rule may carry
@@ -244,6 +268,11 @@ KNOWN_SITES = frozenset(
         "net.recv.stall",
         "net.partition",
         "net.reconnect.storm",
+        "shm.ring.full",
+        "shm.slot.torn_commit",
+        "shm.doorbell.lost",
+        "shm.reader.stall",
+        "shm.segment.unlink",
     }
 )
 

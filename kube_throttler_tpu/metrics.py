@@ -164,6 +164,19 @@ METRIC_NAMES = frozenset(
         "kube_throttler_net_rpc_deadline_exceeded_total",
         "kube_throttler_net_send_queue_depth",
         "kube_throttler_net_partition_seconds",
+        # zero-copy shm event plane (register_shm_metrics /
+        # sharding/shmring.py): per-shard ring occupancy, wrap and
+        # counted-backpressure totals, frames pushed, and how many
+        # batches fell back to the pickle socketpair — plus the worker
+        # side's ingest counters (docs/PERFORMANCE.md "Zero-copy event
+        # plane")
+        "kube_throttler_shm_ring_depth",
+        "kube_throttler_shm_ring_wraps_total",
+        "kube_throttler_shm_backpressure_waits_total",
+        "kube_throttler_shm_frames_total",
+        "kube_throttler_shm_fallback_batches_total",
+        "kube_throttler_shm_ingest_frames_total",
+        "kube_throttler_shm_ingest_events_total",
         # interned-verdict cache (register_verdict_cache_metrics /
         # engine/verdictcache.py): probe outcomes, live entry count, and
         # explicit invalidation sweeps — hit-rate is the serving tier's
@@ -992,6 +1005,106 @@ def register_net_metrics(registry: Registry, front) -> Dict[str, object]:
         "queue_depth": depth_g,
         "partition_seconds": partition_g,
     }
+
+
+def register_shm_metrics(registry: Registry, front) -> Dict[str, object]:
+    """Zero-copy event-plane observability (sharding/shmring.py),
+    sampled at scrape time from each shard handle's ``shm_lane``.
+    Handles without a lane (TCP fleets, ``KT_SHM_RING=0``, masked
+    ``evt-shm`` capability) report zeros, so one dashboard covers mixed
+    fleets. The signals the ring runbook watches: occupancy (a reader
+    that stopped draining), counted backpressure (the writer waited for
+    slots — never a silent drop), wraps (normal steady-state churn),
+    and fallback batches (events that rode the pickle socketpair
+    instead — nonzero means the fast path is off for that shard)."""
+    depth_g = registry.gauge_vec(
+        "kube_throttler_shm_ring_depth",
+        "event frames committed to the shard's shm ring, not yet "
+        "consumed by the worker",
+        ["shard"],
+    )
+    wraps_c = registry.counter_vec(
+        "kube_throttler_shm_ring_wraps_total",
+        "arena wraparounds on the shard's shm ring",
+        ["shard"],
+    )
+    backpressure_c = registry.counter_vec(
+        "kube_throttler_shm_backpressure_waits_total",
+        "writer waits for ring capacity (counted backpressure; "
+        "non-sheddable ops are never silently dropped)",
+        ["shard"],
+    )
+    frames_c = registry.counter_vec(
+        "kube_throttler_shm_frames_total",
+        "columnar event frames pushed to the shard over shared memory",
+        ["shard"],
+    )
+    fallback_c = registry.counter_vec(
+        "kube_throttler_shm_fallback_batches_total",
+        "event batches sent over the pickle socketpair while an shm "
+        "lane existed (capability masked, barrier pending, or lane dead)",
+        ["shard"],
+    )
+
+    def flush() -> None:
+        for sid in range(front.n_shards):
+            handle = front.shards.get(sid)
+            if handle is None:
+                continue
+            key = (str(sid),)
+            lane = getattr(handle, "shm_lane", None)
+            stats = lane.stats() if lane is not None else {}
+            depth_g.set_key(key, float(stats.get("depth", 0)))
+            wraps_c.set_key(key, float(stats.get("wraps", 0)))
+            backpressure_c.set_key(key, float(stats.get("backpressure", 0)))
+            frames_c.set_key(key, float(stats.get("frames", 0)))
+            fallback_c.set_key(
+                key, float(getattr(handle, "shm_fallback_batches", 0))
+            )
+
+    registry.register_pre_expose(flush)
+    return {
+        "depth": depth_g,
+        "wraps": wraps_c,
+        "backpressure": backpressure_c,
+        "frames": frames_c,
+        "fallback": fallback_c,
+    }
+
+
+def register_shm_worker_metrics(registry: Registry, core, shard_id: int) -> None:
+    """Worker-side half of the shm event plane: frames/events ingested
+    off the ring by this worker's pump thread, plus the ring depth as
+    the READER sees it (the two depth gauges disagreeing for long means
+    a stalled pump). Sampled from ``core.shm_pump`` at scrape; a worker
+    running plain pickle registers nothing."""
+    frames_c = registry.counter_vec(
+        "kube_throttler_shm_ingest_frames_total",
+        "columnar event frames this worker decoded off its shm ring",
+        ["shard"],
+    )
+    events_c = registry.counter_vec(
+        "kube_throttler_shm_ingest_events_total",
+        "events this worker applied from shm frames",
+        ["shard"],
+    )
+    depth_g = registry.gauge_vec(
+        "kube_throttler_shm_ring_depth",
+        "event frames committed to the shm ring, not yet consumed "
+        "(reader's view)",
+        ["shard"],
+    )
+
+    def flush() -> None:
+        pump = getattr(core, "shm_pump", None)
+        if pump is None:
+            return
+        key = (str(shard_id),)
+        frames_c.set_key(key, float(pump.frames))
+        events_c.set_key(key, float(pump.events))
+        depth_g.set_key(key, float(pump.depth()))
+
+    registry.register_pre_expose(flush)
 
 
 def register_build_metrics(

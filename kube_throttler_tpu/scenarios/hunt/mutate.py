@@ -79,13 +79,22 @@ MUTABLE_FAULT_SITES: Dict[str, Tuple[str, ...]] = {
     "net.recv.stall": ("delay",),
     "net.partition": ("error",),
     "net.reconnect.storm": ("error",),
+    # the shared-memory event-plane axis: shm.* sites live in the ring
+    # transport (sharding/shmring.py) — socketpair fleets with the ring
+    # enabled reach them; the sharded tier arms them supervisor-side
+    "shm.ring.full": ("delay", "error"),
+    "shm.slot.torn_commit": ("torn",),
+    "shm.doorbell.lost": ("error",),
+    "shm.reader.stall": ("delay",),
+    "shm.segment.unlink": ("error",),
 }
 
 # the sharded-tier families: a program arming any of these is evaluated
 # through the multiprocess replayer, not the single-process engine.
 # net.* rides the same tier (the sites live in the TCP framing layer —
-# a single-process replay could never reach them)
-SHARD_TIER_PREFIXES = ("shard.", "reshard.", "net.")
+# a single-process replay could never reach them); shm.* likewise (the
+# ring only exists between a real supervisor and a spawned worker)
+SHARD_TIER_PREFIXES = ("shard.", "reshard.", "net.", "shm.")
 
 
 def needs_shard_tier(scn: Scenario) -> bool:
@@ -394,7 +403,16 @@ def _mut_epoch_churn(scn: Scenario, rng: random.Random):
 
 
 def _draw_fault(scn: Scenario, rng: random.Random) -> FaultSpec:
-    site = sorted(MUTABLE_FAULT_SITES)[rng.randrange(len(MUTABLE_FAULT_SITES))]
+    # Stratified site draw: the shard-tier axis keeps growing (shard.*,
+    # reshard.*, net.*, now shm.*) and a flat draw would crowd out the
+    # single-process bug classes a little more with every transport PR
+    # — and convert that many more replays to the expensive sharded
+    # tier. Pick the tier first (bounded share), then uniform within.
+    ordered = sorted(MUTABLE_FAULT_SITES)
+    tier = [s for s in ordered if s.startswith(SHARD_TIER_PREFIXES)]
+    core = [s for s in ordered if not s.startswith(SHARD_TIER_PREFIXES)]
+    pool = tier if (tier and rng.random() < 1.0 / 3.0) else core
+    site = pool[rng.randrange(len(pool))]
     mode = rng.choice(MUTABLE_FAULT_SITES[site])
     delay = rng.choice([0.05, 0.1, 0.2, 0.3]) if mode == "delay" else (
         rng.choice([0.0, 0.2]) if site == "scenario.apiserver.restart" else 0.0

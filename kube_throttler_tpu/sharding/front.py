@@ -229,6 +229,12 @@ class AdmissionFront:
         # build's identity plus the per-shard negotiated proto/caps,
         # sampled from the handles at scrape (rolling-upgrade telemetry)
         register_build_metrics(self.metrics_registry, role="front", front=self)
+        from ..metrics import register_shm_metrics
+
+        # kube_throttler_shm_* families: zero-copy event-ring health per
+        # shard, sampled from each handle's lane at scrape (zeros when
+        # the fleet runs plain pickle)
+        register_shm_metrics(self.metrics_registry, self)
         self.health = Health()
         self.health.register("shards", self._shards_health)
         # the Router: batch listener + per-event handlers on the store
@@ -370,6 +376,8 @@ class AdmissionFront:
         self._flush_buffers(buffers)
 
     def _flush_buffers(self, buffers: Dict[int, list]) -> None:
+        if len(buffers) > 1:
+            self._dedup_fanout(buffers)
         for sid, ops in buffers.items():
             handle = self._alive(sid)
             if handle is None:
@@ -381,6 +389,41 @@ class AdmissionFront:
                     handle.mark_dirty()
                 continue
             handle.enqueue_ops(ops)
+
+    @staticmethod
+    def _dedup_fanout(buffers: Dict[int, list]) -> None:
+        """Fan-out dedup: an op payload routed to N shards used to be
+        pickled N times, once per shard batch. Wrap any payload object
+        that lands in two or more shard buffers in one shared
+        :class:`~.ipc.PrepickledPayload` so the pickle fallback
+        serializes it ONCE and splices the cached bytes into every
+        shard's frame (``__reduce__`` replays them; the shm encoder
+        just unwraps ``.obj`` and pays nothing)."""
+        from .ipc import PrepickledPayload
+
+        seen_in: Dict[int, set] = {}
+        first: Dict[int, object] = {}
+        for sid, ops in buffers.items():
+            for op in ops:
+                payload = op[2]
+                if isinstance(payload, str) or getattr(
+                    payload, "_kt_prepickled", False
+                ):
+                    continue
+                seen_in.setdefault(id(payload), set()).add(sid)
+                first[id(payload)] = payload
+        shared = {
+            pid: PrepickledPayload(first[pid])
+            for pid, sids in seen_in.items()
+            if len(sids) >= 2
+        }
+        if not shared:
+            return
+        for ops in buffers.values():
+            for i, op in enumerate(ops):
+                wrapped = shared.get(id(op[2]))
+                if wrapped is not None:
+                    ops[i] = (op[0], op[1], wrapped)
 
     def _route_event(self, event: Event, buffers: Dict[int, list]) -> None:
         kind = event.kind

@@ -189,6 +189,54 @@ def decode_evt_batch(body) -> List["Op"]:
     return list(body)
 
 
+# pickles PrepickledPayload performed (the fan-out dedup invariant:
+# one shared body fanned to N shards serializes exactly once)
+PREPICKLE_SERIALIZATIONS = 0
+
+
+class PrepickledPayload:
+    """One event body fanned out to MANY shard queues: pickle at most once.
+
+    The router regularly enqueues the *same* payload object to several
+    shards (namespace broadcasts, pod upserts to owner + mirror sets).
+    Without this wrapper each shard's sender re-pickles the identical
+    object inside its own ``evt`` frame. The wrapper pickles lazily on
+    first use and replays the cached bytes into every later frame via
+    ``__reduce__``, so the receiving side unpickles transparently back
+    to the original object — no capability gate, any peer decodes it.
+    The shm event lane reads ``.obj`` for pod rows and ``pickled()``
+    for blob rows. Two sender threads may race ``pickled()``; the worst
+    case is a duplicate serialization, never a wrong frame.
+    """
+
+    __slots__ = ("obj", "blob")
+    _kt_prepickled = True  # duck-type marker (shmring avoids the import)
+
+    def __init__(self, obj):
+        self.obj = obj
+        self.blob: Optional[bytes] = None
+
+    def pickled(self) -> bytes:
+        blob = self.blob
+        if blob is None:
+            global PREPICKLE_SERIALIZATIONS
+            PREPICKLE_SERIALIZATIONS += 1
+            blob = pickle.dumps(self.obj, protocol=PICKLE_PROTO)
+            self.blob = blob
+        return blob
+
+    def __reduce__(self):
+        return (pickle.loads, (self.pickled(),))
+
+
+def unwrap_op(op: "Op") -> "Op":
+    """The in-process form of an op: prepickled wrappers unwrapped."""
+    verb, kind, payload = op
+    if getattr(payload, "_kt_prepickled", False):
+        return (verb, kind, payload.obj)
+    return op
+
+
 def send_frame(
     sock: socket.socket, send_lock, mtype: str, rid: int, body,
     epoch: int = 0, faults=None, key: AuthKey = None,
@@ -327,6 +375,11 @@ class ShardClient:
         "dropped": "self._qlock",
         "dirty": "self._qlock",
     }
+    # NOT guarded by design: shm_lane (supervisor single-writer, bound
+    # once before any event flows; the lane's own lock covers close
+    # racing push), _shm_active (sender-thread single-writer),
+    # shm_fallback_batches (sender-thread single-writer, read by
+    # metrics at scrape like events_sent).
 
     def __init__(
         self,
@@ -374,6 +427,12 @@ class ShardClient:
         self.peer_build: Optional[str] = None
         self.version_refused: Optional[str] = None
         self.version_mismatches = 0
+        # shared-memory event lane (sharding/shmring.py): bound by the
+        # supervisor right after construction when the ring spawned with
+        # the worker; None ⇒ pickle frames on the socket, always
+        self.shm_lane = None
+        self._shm_active = False  # sender-thread: barrier completed
+        self.shm_fallback_batches = 0  # evt batches pickled despite a lane
         self._sender = threading.Thread(
             target=self._send_loop, name=f"shard{shard_id}-send", daemon=True
         )
@@ -432,8 +491,10 @@ class ShardClient:
             return self.dirty
 
     def pending_events(self) -> int:
+        lane = self.shm_lane
+        in_ring = lane.inflight() if (lane is not None and self._shm_active) else 0
         with self._qcond:
-            return len(self._queue)
+            return len(self._queue) + in_ring
 
     def _send_loop(self) -> None:
         # top-level routing (threads checker): ANY death of the sender —
@@ -451,6 +512,25 @@ class ShardClient:
                         self._queue.popleft()
                         for _ in range(min(len(self._queue), self.EVT_BATCH))
                     ]
+                lane = self.shm_lane
+                if (
+                    lane is not None
+                    and not self._shm_active
+                    and not lane.dead
+                    and self.has_cap("evt-shm")
+                ):
+                    # one-time ordering barrier before cutting over to
+                    # the ring: the socket is FIFO into the worker's
+                    # serve loop, so a completed RPC proves every
+                    # earlier socket evt frame was already ingested.
+                    # After this flips, evt NEVER rides the socket again
+                    # (a failed ring push kills the lane → shard down →
+                    # restart + resync, same repair as a dead socket).
+                    try:
+                        self.request("stats")
+                        self._shm_active = True
+                    except Exception:  # noqa: BLE001 — stay on the socket
+                        pass
                 try:
                     if self.faults is not None:
                         fault = self.faults.check("shard.ipc.send")
@@ -458,13 +538,21 @@ class ShardClient:
                             raise OSError(
                                 f"injected IPC send failure (hit {fault.hit})"
                             )
-                    body = (
-                        encode_evt_batch(batch)
-                        if self.has_cap("evt-columnar")
-                        else batch
-                    )
-                    send_frame(self.sock, self._send_lock, "evt", 0, body,
-                               epoch=self.epoch, faults=self.faults)
+                    if lane is not None and self._shm_active:
+                        if not lane.send(batch, epoch=self.epoch):
+                            raise OSError(
+                                "shm event lane dead (ring stalled or closed)"
+                            )
+                    else:
+                        if lane is not None:
+                            self.shm_fallback_batches += 1
+                        body = (
+                            encode_evt_batch(batch)
+                            if self.has_cap("evt-columnar")
+                            else batch
+                        )
+                        send_frame(self.sock, self._send_lock, "evt", 0, body,
+                                   epoch=self.epoch, faults=self.faults)
                     self.events_sent += len(batch)
                     self.frames_sent += 1
                 except OSError:
@@ -577,6 +665,9 @@ class ShardClient:
         self._closed = True
         with self._qcond:
             self._qcond.notify_all()
+        lane = self.shm_lane
+        if lane is not None:
+            lane.close()  # unlinks the segment — the creator owns the name
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -1204,7 +1295,7 @@ class LocalShard:
             self.dropped += len(ops)
             self.dirty = True
             return
-        self.core.handle_events(list(ops))
+        self.core.handle_events([unwrap_op(op) for op in ops])
         self.events_sent += len(ops)
         self.frames_sent += 1
 
@@ -1250,5 +1341,7 @@ __all__ = [
     "read_frame",
     "encode_evt_batch",
     "decode_evt_batch",
+    "PrepickledPayload",
+    "unwrap_op",
     "PICKLE_PROTO",
 ]

@@ -1,0 +1,856 @@
+"""Zero-copy shared-memory event plane — SPSC ring + columnar frame codec.
+
+The front↔worker **event** lane (``evt`` frames — ordered store-op
+batches) moves through a ``multiprocessing.shared_memory`` segment
+instead of pickle-over-socketpair. Per shard the supervisor maps one
+single-producer/single-consumer ring; the front's ShardClient sender
+thread is the only writer, the worker's pump thread the only reader.
+Everything else — req/res RPCs, two-phase reserve, reshard slices,
+status pushes, the hello handshake — stays on the HMAC-framed pickle
+socket unchanged: the ring is the hot lane, the socket is the control
+plane and the automatic fallback.
+
+Segment layout (``ring-v1``, offsets in bytes)::
+
+    header   64 B   <8Q>  magic, nslots, arena_bytes,
+                          widx (writer), ridx (reader),
+                          wraps (writer), backpressure (writer),
+                          torn (reader)
+    slots    nslots x 24 B  <3Q>  commit, arena offset, length
+    arena    arena_bytes    frame payload bytes (ring allocator)
+
+Seqlock-style commit protocol: the writer claims sequence ``seq``
+(slot ``seq % nslots``), copies the payload into the arena, writes the
+slot's offset/length, and only then stores the commit word
+``seq + 1``. The reader at ``ridx`` accepts a slot only when its
+commit word is exactly ``ridx + 1``; a commit word of 0 or of the
+previous lap (``ridx + 1 - nslots``) means "not written yet", anything
+else is a torn/corrupt commit → :class:`TornSlotError`, and the worker
+routes that into its own death so the supervisor's restart + resync
+repairs the shard (the same repair as a dead socket). The reader
+advances ``ridx`` only after the batch is handed to the ingest
+pipeline, so ``widx - ridx`` is an honest in-flight count (the front's
+``drain`` gate reads it) and the writer never reclaims arena bytes a
+frame might still reference.
+
+Backpressure, never silent drop: a full ring (slot exhaustion or arena
+exhaustion) makes ``push`` wait — counted in the header's
+``backpressure`` word — until the deadline, then *fail the lane* (the
+front marks the shard down; supervisor restart + resync repairs).
+Shedding of Pod-upsert events under overload stays where it always
+was, in ShardClient's bounded queue (same policy as MicroBatchIngest);
+the ring itself never drops a committed frame.
+
+Doorbell: a plain ``os.pipe`` — the writer drops one byte
+(non-blocking; a full pipe means the reader already has wakeups
+pending) after each commit, the reader spins briefly on the commit
+word and then blocks in ``select`` on the pipe with a bounded timeout,
+so a lost doorbell byte costs latency, never events.
+
+Trust domain / why this lane is exempt from the frame-HMAC rule: the
+segment is created by the supervisor and attached only by the worker
+it spawned — same host, same UID, same process tree, mode 0600 under
+``/dev/shm``. No byte in the ring ever came from a network peer; the
+TCP transport (sharding/ipc.py) never uses it and keeps its HMAC
+framing. The rare ``ROW_BLOB`` rows therefore ``pickle.loads`` bytes
+the *front wrote into local memory*, which is the same trust statement
+as the socketpair transport's pickle stream — the ``taint`` checker
+encodes this exemption explicitly for this module only.
+
+Frame codec (:class:`FrameEncoder`/:class:`FrameDecoder`): columns,
+not pickles. A frame is ``<QQII>`` (epoch, seq, n_ints, heap_len) +
+one packed ``<u32`` int stream + a byte heap. Verbs, kinds, delete
+keys, pod scalar fields and whole label/annotation/request *shapes*
+travel as ids into a persistent string table that grows frame-over-
+frame: SPSC FIFO ordering means the reader has seen every earlier
+frame, so each frame carries only the strings the reader does not
+already know (steady state: a pod row is 12 ints and zero string
+bytes). Shapes intern as canonical JSON renders (the snapshot-v2
+columnar idiom from engine/columnar.py — ``format_quantity`` out,
+``parse_quantity`` back, decoded once per shape and shared across
+pods). Payloads that are not canonical pods (Throttle/ClusterThrottle/
+Namespace upserts, resync prune maps) ride as embedded pickle blobs —
+off the pod hot path by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import select
+import struct
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.pod import Pod, PodSpec, PodStatus
+from ..engine.columnar import parse_request_shape, render_request_shape
+from ..utils.lockorder import guard_attrs, make_lock
+
+__all__ = [
+    "SHM_FORMATS",
+    "TornSlotError",
+    "ShmRingWriter",
+    "ShmRingReader",
+    "FrameEncoder",
+    "FrameDecoder",
+    "ShmEventLane",
+    "sweep_segments",
+    "shm_available",
+]
+
+# The shm: wire-format registry source of truth — version.py's
+# FORMAT_REGISTRY must carry one ``shm:<name>`` row per entry here
+# (machine-checked by analysis/protocol.py, like snapshot versions).
+SHM_FORMATS = ("ring-v1",)
+
+_PICKLE_PROTO = 5
+
+_MAGIC = 0x4B54_4D52_0001  # "KTMR" + layout version
+_HDR = struct.Struct("<8Q")
+_SLOT = struct.Struct("<3Q")
+_FRAME_HDR = struct.Struct("<QQII")
+
+_OFF_WIDX = 24
+_OFF_RIDX = 32
+_OFF_WRAPS = 40
+_OFF_BACKPRESSURE = 48
+_OFF_TORN = 56
+
+_NONE_SID = 0xFFFFFFFF  # string id sentinel for a None field
+
+ROW_POD = 0  # canonical pod upsert: 9 interned column ids
+ROW_KEY = 1  # string payload (deletes, prune markers): 1 id
+ROW_BLOB = 2  # anything else: embedded pickle blob (off the hot path)
+
+_U64 = struct.Struct("<Q")
+_U64X2 = struct.Struct("<QQ")  # slot (offset, length) pair
+
+
+class TornSlotError(RuntimeError):
+    """A slot's commit word is neither empty nor the expected sequence:
+    the writer died mid-commit or the mapping is corrupt. The reader
+    must treat the whole ring as lost (restart + resync repairs)."""
+
+
+def shm_available() -> bool:
+    """POSIX shared memory present on this host?"""
+    try:
+        from multiprocessing import shared_memory  # noqa: F401
+    except ImportError:  # pragma: no cover - stdlib on every target
+        return False
+    return os.path.isdir("/dev/shm")
+
+
+def _untrack(shm) -> None:
+    # An attaching (non-creating) process must not let resource_tracker
+    # adopt the segment: the tracker would unlink it when THIS process
+    # exits, racing the creator's own cleanup (Python 3.10 has no
+    # ``track=False``). Unregister is best-effort by design.
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+
+
+def sweep_segments(prefix: str) -> List[str]:
+    """Best-effort unlink of leftover ``/dev/shm`` segments with our
+    name prefix — the backstop for an unlink race (``shm.segment.unlink``)
+    or a creator killed before its cleanup ran. Idempotent; missing
+    names are fine."""
+    removed: List[str] = []
+    if not prefix or not os.path.isdir("/dev/shm"):
+        return removed
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return removed
+    for nm in names:
+        if nm.startswith(prefix):
+            try:
+                os.unlink(os.path.join("/dev/shm", nm))
+                removed.append(nm)
+            except OSError:
+                pass
+    return removed
+
+
+# --------------------------------------------------------------------- ring
+
+
+@guard_attrs
+class ShmRingWriter:
+    """Producer half of the SPSC ring. One thread pushes; ``close`` may
+    race from the supervisor, hence the lock. The ring-allocator state
+    (`_head`, `_inflight`, `_used`) is writer-local on purpose: a worker
+    restart always gets a *fresh* segment, so the writer's view of the
+    arena is authoritative for its lifetime."""
+
+    GUARDED_BY = {
+        "_widx": "self._lock",
+        "_head": "self._lock",
+        "_used": "self._lock",
+        "_inflight": "self._lock",
+        "_closed": "self._lock",
+        "wraps": "self._lock",
+        "backpressure_waits": "self._lock",
+        "frames": "self._lock",
+        "unlink_failed": "self._lock",
+    }
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        slots: int = 1024,
+        arena_bytes: int = 4 << 20,
+        doorbell_wfd: Optional[int] = None,
+        faults=None,
+    ):
+        from multiprocessing import shared_memory
+
+        if slots < 2 or arena_bytes < 4096:
+            raise ValueError("ring too small")
+        size = _HDR.size + slots * _SLOT.size + arena_bytes
+        self._shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        self.name = self._shm.name
+        self._buf = self._shm.buf
+        _HDR.pack_into(self._buf, 0, _MAGIC, slots, arena_bytes, 0, 0, 0, 0, 0)
+        self.nslots = slots
+        self.arena_bytes = arena_bytes
+        self._arena0 = _HDR.size + slots * _SLOT.size
+        self.doorbell_wfd = doorbell_wfd
+        if doorbell_wfd is not None:
+            os.set_blocking(doorbell_wfd, False)
+        self.faults = faults
+        self._lock = make_lock(f"shm.ring.writer.{self.name}")
+        self._widx = 0
+        self._head = 0
+        self._used = 0
+        self._inflight: deque = deque()  # (seq, offset, length)
+        self._closed = False
+        self.wraps = 0
+        self.backpressure_waits = 0
+        self.frames = 0
+        self.unlink_failed = False
+
+    # -- stats (sampled by metrics at scrape; plain u64 reads) ------------
+
+    def inflight(self) -> int:
+        with self._lock:
+            if self._closed:
+                return 0
+            return self._widx - _U64.unpack_from(self._buf, _OFF_RIDX)[0]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            if self._closed:
+                return {
+                    "depth": 0,
+                    "wraps": self.wraps,
+                    "backpressure": self.backpressure_waits,
+                    "frames": self.frames,
+                }
+            ridx = _U64.unpack_from(self._buf, _OFF_RIDX)[0]
+            return {
+                "depth": self._widx - ridx,
+                "wraps": self.wraps,
+                "backpressure": self.backpressure_waits,
+                "frames": self.frames,
+            }
+
+    # -- push --------------------------------------------------------------
+
+    def _try_alloc_locked(self, n: int) -> Optional[int]:
+        # reclaim everything the reader has consumed
+        ridx = _U64.unpack_from(self._buf, _OFF_RIDX)[0]
+        q = self._inflight
+        while q and q[0][0] < ridx:
+            self._used -= q.popleft()[2]
+        if self._widx - ridx >= self.nslots:
+            return None  # slot exhaustion
+        if self._used == 0:
+            if n > self.arena_bytes:
+                raise ValueError("frame larger than ring arena")
+            self._head = n
+            return 0
+        head = self._head
+        tail = q[0][1]
+        if head > tail:
+            if self.arena_bytes - head >= n:
+                self._head = head + n
+                return head
+            if n <= tail:  # wrap: skip the dead bytes at the end
+                self.wraps += 1
+                _U64.pack_into(self._buf, _OFF_WRAPS, self.wraps)
+                self._head = n
+                return 0
+            return None
+        if head < tail and tail - head >= n:
+            self._head = head + n
+            return head
+        return None  # head == tail with bytes in flight: arena full
+
+    def push(self, payload: bytes, timeout: float = 5.0) -> bool:
+        """Commit one frame. Blocks (counted backpressure) while the
+        ring is full; False once the deadline passes or the writer is
+        closed — the caller must treat False as a dead lane, never as a
+        droppable frame."""
+        torn_commit = False
+        if self.faults is not None:
+            fault = self.faults.check("shm.ring.full")
+            if fault is not None:
+                # a saturated ring: "delay" models a slow reader the
+                # backpressure wait absorbs; any other mode models a
+                # stuck reader — the push fails and the lane dies
+                if fault.mode == "delay":
+                    with self._lock:
+                        self.backpressure_waits += 1
+                        if not self._closed:
+                            _U64.pack_into(
+                                self._buf, _OFF_BACKPRESSURE, self.backpressure_waits
+                            )
+                    fault.sleep()
+                else:
+                    return False
+            fault = self.faults.check("shm.slot.torn_commit")
+            if fault is not None:
+                torn_commit = True
+        n = len(payload)
+        deadline = time.monotonic() + timeout
+        waited = False
+        while True:
+            with self._lock:
+                if self._closed:
+                    return False
+                off = self._try_alloc_locked(n)
+                if off is not None:
+                    seq = self._widx
+                    a0 = self._arena0 + off
+                    self._buf[a0 : a0 + n] = payload
+                    base = _HDR.size + (seq % self.nslots) * _SLOT.size
+                    _U64X2.pack_into(self._buf, base + 8, off, n)
+                    commit = seq + 1
+                    if torn_commit:
+                        # payload landed but the commit word is garbage:
+                        # exactly what a writer dying mid-commit leaves
+                        commit = (seq + 1) | (1 << 63)
+                    _U64.pack_into(self._buf, base, commit)
+                    self._widx = seq + 1
+                    _U64.pack_into(self._buf, _OFF_WIDX, self._widx)
+                    self._inflight.append((seq, off, n))
+                    self._used += n
+                    self.frames += 1
+                    # the doorbell (a syscall) is only for a reader that
+                    # may be BLOCKED in select: with older frames still
+                    # unconsumed the reader is awake (or has a wakeup
+                    # byte pending) and will find this commit in its
+                    # spin pass — skipping costs at most one bounded
+                    # 50 ms poll slice, the documented lost-byte deal
+                    ridx = _U64.unpack_from(self._buf, _OFF_RIDX)[0]
+                    ring_bell = self._widx - ridx <= 1
+                    break
+                if not waited:
+                    waited = True
+                    self.backpressure_waits += 1
+                    _U64.pack_into(
+                        self._buf, _OFF_BACKPRESSURE, self.backpressure_waits
+                    )
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.0005)  # off-lock: the reader owns the next move
+        if ring_bell:
+            self._ring_doorbell()
+        return True
+
+    def _ring_doorbell(self) -> None:
+        if self.doorbell_wfd is None:
+            return
+        if self.faults is not None:
+            fault = self.faults.check("shm.doorbell.lost")
+            if fault is not None:
+                return  # byte lost: the reader's bounded poll catches up
+        try:
+            os.write(self.doorbell_wfd, b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # pipe full (reader has wakeups pending) or closing
+
+    def close(self, unlink: bool = True) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self.doorbell_wfd is not None:
+            try:
+                os.close(self.doorbell_wfd)
+            except OSError:
+                pass
+        try:
+            self._shm.close()
+        except BufferError:  # a stale exported view; unmap on GC instead
+            pass
+        if unlink:
+            if self.faults is not None:
+                fault = self.faults.check("shm.segment.unlink")
+                if fault is not None:
+                    # lost the unlink race (peer/tracker got there first,
+                    # or we died before cleanup): leave the name behind —
+                    # the supervisor's sweep_segments backstop removes it.
+                    # Drop our tracker registration so the reclaim doesn't
+                    # double-report the name at interpreter shutdown.
+                    try:
+                        from multiprocessing import resource_tracker
+
+                        resource_tracker.unregister(
+                            self._shm._name, "shared_memory"
+                        )
+                    except Exception:
+                        pass
+                    with self._lock:
+                        self.unlink_failed = True
+                    return
+            try:
+                self._shm.unlink()
+            except OSError:
+                pass
+
+
+@guard_attrs
+class ShmRingReader:
+    """Consumer half. Exactly one pump thread calls ``peek``/``advance``;
+    ``_ridx`` is therefore reader-thread-local state (mirrored into the
+    header for the writer's reclaim and everyone's stats)."""
+
+    GUARDED_BY = {
+        "_closed": "self._lock",
+    }
+
+    def __init__(
+        self,
+        name: str,
+        doorbell_rfd: Optional[int] = None,
+        faults=None,
+        untrack: bool = False,
+    ):
+        from multiprocessing import shared_memory
+
+        self._shm = shared_memory.SharedMemory(name=name)
+        if untrack:
+            # a worker process attaching a supervisor-owned segment:
+            # keep OUR resource tracker's hands off the creator's name
+            _untrack(self._shm)
+        self._buf = self._shm.buf
+        magic, nslots, arena_bytes, widx, ridx, _, _, _ = _HDR.unpack_from(self._buf, 0)
+        if magic != _MAGIC:
+            self._shm.close()
+            raise ValueError(f"not a kt event ring: magic {magic:#x}")
+        self.nslots = int(nslots)
+        self.arena_bytes = int(arena_bytes)
+        self._arena0 = _HDR.size + self.nslots * _SLOT.size
+        self._ridx = int(ridx)
+        self.doorbell_rfd = doorbell_rfd
+        self.faults = faults
+        self.torn = 0
+        self._lock = make_lock(f"shm.ring.reader.{name}")
+        self._closed = False
+
+    def depth(self) -> int:
+        return int(_U64.unpack_from(self._buf, _OFF_WIDX)[0]) - self._ridx
+
+    def _check(self):
+        ridx = self._ridx
+        base = _HDR.size + (ridx % self.nslots) * _SLOT.size
+        try:
+            commit, off, n = _SLOT.unpack_from(self._buf, base)
+        except ValueError:
+            # close() released the buffer under a racing peek (teardown
+            # path): report empty forever, never a torn slot
+            return None
+        expected = ridx + 1
+        if commit == expected:
+            if off + n > self.arena_bytes:
+                self._count_torn()
+                raise TornSlotError(
+                    f"slot {ridx % self.nslots}: payload [{off}:{off + n}] "
+                    f"outside arena ({self.arena_bytes})"
+                )
+            a0 = self._arena0 + off
+            return self._buf[a0 : a0 + n]
+        if commit == 0 or (ridx >= self.nslots and commit == expected - self.nslots):
+            return None  # slot not (re)written yet
+        self._count_torn()
+        raise TornSlotError(
+            f"slot {ridx % self.nslots}: commit {commit:#x} != expected {expected}"
+        )
+
+    def _count_torn(self) -> None:
+        self.torn += 1
+        try:
+            _U64.pack_into(self._buf, _OFF_TORN, self.torn)
+        except (ValueError, TypeError):
+            pass
+
+    def peek(self, timeout: float = 0.2):
+        """Memoryview of the next committed frame (zero-copy into the
+        segment), or None on timeout. Spin briefly — an active writer
+        commits within microseconds — then block on the doorbell with a
+        bounded slice so a lost doorbell byte only costs latency."""
+        if self.faults is not None:
+            fault = self.faults.check("shm.reader.stall")
+            if fault is not None:
+                fault.sleep()  # slow consumer: the writer must backpressure
+        deadline = time.monotonic() + timeout
+        spins = 0
+        while True:
+            got = self._check()
+            if got is not None:
+                return got
+            spins += 1
+            if spins < 128:
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            if self.doorbell_rfd is not None:
+                ready, _, _ = select.select(
+                    [self.doorbell_rfd], [], [], min(remaining, 0.05)
+                )
+                if ready:
+                    try:
+                        os.read(self.doorbell_rfd, 4096)
+                    except OSError:
+                        pass
+            else:
+                time.sleep(0.0002)
+
+    def advance(self) -> None:
+        """Consume the frame ``peek`` returned. Call only after the
+        batch reached the ingest pipeline: the writer reclaims arena
+        bytes for every sequence below ``ridx``."""
+        self._ridx += 1
+        _U64.pack_into(self._buf, _OFF_RIDX, self._ridx)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self.doorbell_rfd is not None:
+            try:
+                os.close(self.doorbell_rfd)
+            except OSError:
+                pass
+        try:
+            self._shm.close()  # attach-side: never unlink, the creator owns the name
+        except BufferError:
+            pass
+
+
+# --------------------------------------------------------------------- codec
+
+
+class FrameEncoder:
+    """Stateful columnar encoder — front side, sender-thread-only (no
+    lock: strict SPSC). The string table persists across frames; ids it
+    has assigned are never re-sent. ``_pins`` keeps every object whose
+    ``id()`` keys a fast-path cache alive, so an id is never recycled
+    under a stale cache entry."""
+
+    # a pod OBJECT re-encoded (resync replay, repeated fan-out of the
+    # same materialized object) collapses to one cached 9-sid row: the
+    # string table is grow-only and frames are FIFO, so sids minted for
+    # an earlier frame are always decodable later. Bounded: past the cap
+    # the cache (and its pins) reset — churny fleets lose a cache, never
+    # memory.
+    _ROW_CACHE_CAP = 65536
+
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+        self._label_by_obj: Dict[int, int] = {}  # id(dict) -> shape string id
+        self._req_by_stamp: Dict[Tuple[int, int], int] = {}  # (id(arena token), rsid)
+        self._row_by_obj: Dict[int, tuple] = {}  # id(pod) -> 9 interned sids
+        self._pins: List[Any] = []
+        self._row_pins: List[Any] = []
+        self.frames = 0
+
+    def _sid(self, s: str, newstrs: List[bytes], lens: List[int]) -> int:
+        out = self._ids.get(s)
+        if out is None:
+            out = len(self._ids)
+            self._ids[s] = out
+            raw = s.encode("utf-8")
+            newstrs.append(raw)
+            lens.append(len(raw))
+        return out
+
+    def encode(self, ops, epoch: int, seq: int) -> bytes:
+        newstrs: List[bytes] = []
+        lens: List[int] = []
+        rows: List[int] = []
+        blobs: List[bytes] = []
+        sid = self._sid
+
+        def osid(v) -> int:
+            return _NONE_SID if v is None else sid(v, newstrs, lens)
+
+        ids_get = self._ids.get
+        row_cache = self._row_by_obj
+        rows_extend = rows.extend
+        n_ops = 0
+        for verb, kind, payload in ops:
+            obj = payload
+            prepickled = getattr(payload, "_kt_prepickled", False)
+            if prepickled:
+                obj = payload.obj
+            vs = ids_get(verb)
+            if vs is None:
+                vs = sid(verb, newstrs, lens)
+            ks = ids_get(kind)
+            if ks is None:
+                ks = sid(kind, newstrs, lens)
+            n_ops += 1
+            if (
+                kind == "Pod"
+                and verb != "delete"
+                and type(obj) is Pod
+                and obj.spec is not None
+                and obj.status is not None
+            ):
+                row = row_cache.get(id(obj))
+                if row is not None:
+                    rows_extend((vs, ks, ROW_POD))
+                    rows_extend(row)
+                    continue
+                spec = obj.spec
+                row = (
+                    sid(obj.name, newstrs, lens),
+                    sid(obj.namespace, newstrs, lens),
+                    osid(obj.uid),
+                    osid(spec.scheduler_name),
+                    osid(spec.node_name),
+                    osid(obj.status.phase),
+                    self._label_sid(obj.labels, newstrs, lens),
+                    self._label_sid(obj.annotations, newstrs, lens),
+                    self._req_sid(obj, spec, newstrs, lens),
+                )
+                if len(row_cache) >= self._ROW_CACHE_CAP:
+                    row_cache.clear()
+                    self._row_pins.clear()
+                row_cache[id(obj)] = row
+                self._row_pins.append(obj)
+                rows_extend((vs, ks, ROW_POD))
+                rows_extend(row)
+            elif isinstance(obj, str):
+                rows.extend((vs, ks, ROW_KEY, sid(obj, newstrs, lens)))
+            else:
+                blob = (
+                    payload.pickled()
+                    if prepickled
+                    else pickle.dumps(obj, protocol=_PICKLE_PROTO)
+                )
+                rows.extend((vs, ks, ROW_BLOB, len(blobs)))
+                blobs.append(blob)
+
+        ints: List[int] = [len(lens)]
+        ints.extend(lens)
+        ints.append(n_ops)
+        ints.extend(rows)
+        ints.append(len(blobs))
+        ints.extend(len(b) for b in blobs)
+        heap = b"".join(newstrs) + b"".join(blobs)
+        self.frames += 1
+        return (
+            _FRAME_HDR.pack(epoch, seq, len(ints), len(heap))
+            + struct.pack(f"<{len(ints)}I", *ints)
+            + heap
+        )
+
+    def _label_sid(self, d, newstrs, lens) -> int:
+        if d is None:
+            return _NONE_SID
+        out = self._label_by_obj.get(id(d))
+        if out is not None:
+            return out
+        rendered = json.dumps(
+            [[k, v] for k, v in sorted(d.items())], separators=(",", ":")
+        )
+        out = self._sid(rendered, newstrs, lens)
+        self._label_by_obj[id(d)] = out
+        self._pins.append(d)
+        return out
+
+    def _req_sid(self, pod, spec, newstrs, lens) -> int:
+        token = pod.__dict__.get("_kt_arena")
+        rsid = pod.__dict__.get("_kt_req_sid")
+        stamp = None
+        if token is not None and rsid is not None:
+            stamp = (id(token), rsid)
+            out = self._req_by_stamp.get(stamp)
+            if out is not None:
+                return out
+        rendered = json.dumps(
+            render_request_shape(
+                spec.containers or (), spec.init_containers or (), spec.overhead
+            ),
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+        out = self._sid(rendered, newstrs, lens)
+        if stamp is not None:
+            self._req_by_stamp[stamp] = out
+            self._pins.append(token)
+        return out
+
+
+class FrameDecoder:
+    """Stateful columnar decoder — worker side, pump-thread-only. The
+    string table mirrors the encoder's; label/annotation dicts and
+    container tuples decode once per shape id and are shared across
+    every pod that references them (the arena's shape-sharing property,
+    preserved over the wire)."""
+
+    def __init__(self):
+        self._strings: List[str] = []
+        self._labels: Dict[int, dict] = {}
+        self._reqs: Dict[int, tuple] = {}
+
+    def decode(self, buf) -> Tuple[int, int, List[tuple]]:
+        """``(epoch, seq, ops)`` from one frame view."""
+        epoch, seq, n_ints, heap_len = _FRAME_HDR.unpack_from(buf, 0)
+        ints = struct.unpack_from(f"<{n_ints}I", buf, _FRAME_HDR.size)
+        heap_base = _FRAME_HDR.size + 4 * n_ints
+        i = 0
+        n_new = ints[i]
+        i += 1
+        off = heap_base
+        strings = self._strings
+        for k in range(n_new):
+            ln = ints[i + k]
+            strings.append(bytes(buf[off : off + ln]).decode("utf-8"))
+            off += ln
+        i += n_new
+        blob_base = off
+
+        n_ops = ints[i]
+        i += 1
+        ops: List[Any] = []
+        blob_rows: List[Tuple[int, int]] = []  # (ops index, blob index)
+        for _ in range(n_ops):
+            verb = strings[ints[i]]
+            kind = strings[ints[i + 1]]
+            rowtype = ints[i + 2]
+            i += 3
+            if rowtype == ROW_POD:
+                ops.append((verb, kind, self._pod(ints[i : i + 9])))
+                i += 9
+            elif rowtype == ROW_KEY:
+                ops.append((verb, kind, strings[ints[i]]))
+                i += 1
+            elif rowtype == ROW_BLOB:
+                blob_rows.append((len(ops), ints[i]))
+                ops.append((verb, kind, None))
+                i += 1
+            else:
+                raise TornSlotError(f"unknown row type {rowtype}")
+
+        n_blobs = ints[i]
+        i += 1
+        starts = [blob_base]
+        for k in range(n_blobs):
+            starts.append(starts[-1] + ints[i + k])
+        for op_idx, bidx in blob_rows:
+            raw = bytes(buf[starts[bidx] : starts[bidx + 1]])
+            verb, kind, _ = ops[op_idx]
+            # local-memory bytes our own front wrote — see the module
+            # docstring's trust-domain note (taint-checker exemption)
+            ops[op_idx] = (verb, kind, pickle.loads(raw))
+        return int(epoch), int(seq), ops
+
+    def _str(self, sid: int):
+        return None if sid == _NONE_SID else self._strings[sid]
+
+    def _label(self, sid: int):
+        if sid == _NONE_SID:
+            return None
+        out = self._labels.get(sid)
+        if out is None:
+            out = dict(json.loads(self._strings[sid]))
+            self._labels[sid] = out
+        return out
+
+    def _req(self, sid: int) -> tuple:
+        out = self._reqs.get(sid)
+        if out is None:
+            out = parse_request_shape(json.loads(self._strings[sid]))
+            self._reqs[sid] = out
+        return out
+
+    def _pod(self, row) -> Pod:
+        containers, init, overhead = self._req(row[8])
+        return Pod(
+            name=self._strings[row[0]],
+            namespace=self._strings[row[1]],
+            labels=self._label(row[6]),
+            annotations=self._label(row[7]),
+            uid=self._str(row[2]),
+            spec=PodSpec(
+                scheduler_name=self._str(row[3]),
+                node_name=self._str(row[4]),
+                containers=list(containers),
+                init_containers=list(init),
+                overhead=overhead,
+            ),
+            status=PodStatus(phase=self._str(row[5])),
+        )
+
+
+# ---------------------------------------------------------------- event lane
+
+
+class ShmEventLane:
+    """Writer + persistent encoder + frame sequencing — the object the
+    supervisor hangs on a ShardClient. Sender-thread-only except
+    ``close``/``stats`` (the writer's lock covers those). A failed push
+    kills the lane for good: the encoder's string table may be ahead of
+    the reader, so the only safe continuation is the supervisor's
+    restart + resync with a fresh segment."""
+
+    # one frame must leave slack in the arena; bigger batches split
+    MAX_FRAME_FRACTION = 2
+
+    def __init__(self, writer: ShmRingWriter):
+        self.writer = writer
+        self.encoder = FrameEncoder()
+        self.seq = 0
+        self.dead = False
+
+    def send(self, ops, epoch: int, timeout: float = 5.0) -> bool:
+        if self.dead:
+            return False
+        # split *before* encoding — the encoder's string table advances
+        # at encode time, so an encoded frame must never be abandoned
+        limit = self.writer.arena_bytes // self.MAX_FRAME_FRACTION
+        if len(ops) > max(64, limit // 4096):
+            mid = len(ops) // 2
+            return self.send(ops[:mid], epoch, timeout) and self.send(
+                ops[mid:], epoch, timeout
+            )
+        payload = self.encoder.encode(ops, epoch, self.seq)
+        ok = self.writer.push(payload, timeout)
+        if ok:
+            self.seq += 1
+        else:
+            self.dead = True
+        return ok
+
+    def inflight(self) -> int:
+        return 0 if self.dead else self.writer.inflight()
+
+    def stats(self) -> Dict[str, int]:
+        return self.writer.stats()
+
+    def close(self) -> None:
+        self.dead = True
+        self.writer.close(unlink=True)

@@ -41,6 +41,7 @@ from typing import Dict, List, Optional
 from ..utils.lockorder import guard_attrs, make_lock
 from .front import AdmissionFront
 from .ipc import ShardClient, TcpShardClient
+from .shmring import ShmEventLane, ShmRingWriter, shm_available, sweep_segments
 
 logger = logging.getLogger(__name__)
 
@@ -59,6 +60,7 @@ class ShardSupervisor:
         "_backoffs": "self._proc_lock",
         "_last_backoff": "self._proc_lock",
         "_suspended": "self._proc_lock",
+        "_shm_seq": "self._proc_lock",
     }
 
     def __init__(
@@ -117,6 +119,10 @@ class ShardSupervisor:
         self.auth_key = auth_key
         self._rendezvous_dir: Optional[str] = None
         self._port_seq = 0
+        # per-incarnation shm ring generation: a respawned worker gets a
+        # FRESH segment (the crashed reader may have died mid-frame; a
+        # fresh ring + fresh encoder string table is the resync story)
+        self._shm_seq = 0
         self._proc_lock = make_lock("shard.supervisor.procs")
         self.procs: Dict[int, subprocess.Popen] = {}
         self.restarts: Dict[int, int] = {i: 0 for i in range(self.n_shards)}
@@ -259,21 +265,66 @@ class ShardSupervisor:
         if self.transport == "tcp":
             return self._spawn_tcp(shard_id, extra_args)
         parent_sock, child_sock = socket.socketpair()
+        ring_writer: Optional[ShmRingWriter] = None
+        door_rfd = -1
         try:
             argv = (
                 self._base_argv(shard_id)
                 + ["--ipc-fd", str(child_sock.fileno())]
                 + self._extra_argv(shard_id, extra_args)
             )
+            if os.environ.get("KT_SHM_RING", "1") != "0" and shm_available():
+                # zero-copy event lane: a per-incarnation SPSC ring the
+                # child attaches read-only by name, doorbelled over an
+                # inherited pipe. Any failure here degrades to the plain
+                # pickle socketpair — the ring is a fast path, never a
+                # spawn dependency.
+                with self._proc_lock:
+                    self._shm_seq += 1
+                    gen = self._shm_seq
+                door_wfd = -1
+                try:
+                    door_rfd, door_wfd = os.pipe()
+                    ring_writer = ShmRingWriter(
+                        f"kt_evt_{os.getpid()}_{shard_id}_{gen}",
+                        slots=int(os.environ.get("KT_SHM_RING_SLOTS", "1024")),
+                        arena_bytes=int(
+                            os.environ.get("KT_SHM_RING_ARENA", str(4 << 20))
+                        ),
+                        doorbell_wfd=door_wfd,
+                        faults=self.front.faults,
+                    )
+                except Exception:  # noqa: BLE001 — fall back to pickle
+                    logger.warning(
+                        "shard %d: shm ring unavailable, falling back to "
+                        "pickle socketpair", shard_id, exc_info=True,
+                    )
+                    if door_rfd >= 0:
+                        os.close(door_rfd)
+                    if door_wfd >= 0 and ring_writer is None:
+                        os.close(door_wfd)
+                    door_rfd = -1
+                    ring_writer = None
+            if ring_writer is not None:
+                argv += [
+                    "--shm-ring", ring_writer.name,
+                    "--shm-doorbell-fd", str(door_rfd),
+                ]
             env = self._child_env()
+            pass_fds = [child_sock.fileno()]
+            if door_rfd >= 0:
+                pass_fds.append(door_rfd)
             proc = subprocess.Popen(
                 argv,
-                pass_fds=[child_sock.fileno()],
+                pass_fds=pass_fds,
                 env=env,
                 stdout=subprocess.DEVNULL if env.get("KT_SHARD_QUIET") else None,
                 stderr=None,
             )
             child_sock.close()
+            if door_rfd >= 0:
+                os.close(door_rfd)  # child inherited its copy
+                door_rfd = -1
             client = ShardClient(
                 shard_id,
                 parent_sock,
@@ -283,13 +334,24 @@ class ShardSupervisor:
                 default_deadline=self.front.rpc_deadline,
                 deadlines=self.front.rpc_deadlines,
             )
+            if ring_writer is not None:
+                client.shm_lane = ShmEventLane(ring_writer)
         except BaseException:
             # a failed exec (or client construction) must not leak the
             # socketpair: each monitor-driven respawn retry would strand
             # two fds, and fd exhaustion then takes down the FRONT — the
-            # exact lease-elector leak class from the PR 6 review
+            # exact lease-elector leak class from the PR 6 review. Same
+            # rule for the ring: close(unlink=True) drops the /dev/shm
+            # segment and the doorbell write end.
             parent_sock.close()
             child_sock.close()
+            if ring_writer is not None:
+                try:
+                    ring_writer.close(unlink=True)
+                except Exception:  # noqa: BLE001
+                    pass
+            if door_rfd >= 0:
+                os.close(door_rfd)
             raise
         with self._proc_lock:
             self.procs[shard_id] = proc
@@ -689,6 +751,14 @@ class ShardSupervisor:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5.0)
+        # backstop: handle.close() unlinks each ring, but a handle that
+        # never attached (spawn raced stop) or a writer whose unlink was
+        # fault-injected away would strand a /dev/shm segment — sweep
+        # everything this supervisor process created
+        leaked = sweep_segments(f"kt_evt_{os.getpid()}_")
+        if leaked:
+            logger.warning("swept %d leaked shm segment(s): %s",
+                           len(leaked), ", ".join(leaked))
 
 
 __all__ = ["ShardSupervisor"]

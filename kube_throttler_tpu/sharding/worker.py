@@ -198,6 +198,9 @@ class ShardCore:
         self._handoffs_in: Dict[str, dict] = {}  # handoff → assembling sink
         self.range_fence = RangeFence()
         self.reshard_aborts = 0
+        # bound by worker main() when a shared-memory event ring is
+        # attached (ShmEventPump); stats/metrics sample it read-only
+        self.shm_pump = None
         self.reaped_handoffs = 0
         # status push plumbing: handlers append under the push lock (they
         # run inside the store lock and must stay informer-cheap); the
@@ -576,6 +579,15 @@ class ShardCore:
             "wire_epoch": self.current_epoch(),
             "fenced_frames": self._fenced_counts(),
             "version": self.negotiated_state(),
+            "shm": (
+                {
+                    "frames": self.shm_pump.frames,
+                    "events": self.shm_pump.events,
+                    "depth": self.shm_pump.depth(),
+                }
+                if self.shm_pump is not None
+                else None
+            ),
         }
 
     def _fenced_counts(self) -> Dict[str, int]:
@@ -1029,6 +1041,78 @@ class ShardCore:
             self.journal.close()
 
 
+class ShmEventPump:
+    """Worker-side consumer of the shared-memory event ring
+    (sharding/shmring.py): one thread pops columnar frames, decodes
+    them through a persistent :class:`~.shmring.FrameDecoder`, and
+    feeds the batches into the core's ingest path — the same
+    ``observe_epoch`` fence and ``handle_events`` entry the socket
+    ``evt`` frames use, so the two lanes are semantically identical.
+
+    The reader advances its cursor only AFTER the batch reached the
+    ingest pipeline: ``widx - ridx`` stays an honest in-flight count
+    for the front's drain gate, and the writer never reclaims arena
+    bytes under a frame still being decoded.
+
+    A torn slot commit (or any decode failure) is unrecoverable in
+    place — the ring's write cursor is beyond repair from this side —
+    so the pump routes it into the worker's own death (``on_fatal``
+    shuts the control socket): the supervisor's restart + resync with a
+    fresh segment is the repair, exactly like a dead socket.
+
+    ``frames``/``events`` are pump-thread single-writer stats, read at
+    scrape by the worker-side shm metrics and the stats RPC."""
+
+    def __init__(self, core: ShardCore, reader, on_fatal):
+        self.core = core
+        self.reader = reader
+        self.on_fatal = on_fatal
+        from .shmring import FrameDecoder
+
+        self.decoder = FrameDecoder()
+        self.frames = 0
+        self.events = 0
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def depth(self) -> int:
+        try:
+            return self.reader.depth()
+        except (ValueError, OSError):
+            return 0
+
+    def run(self) -> None:
+        from .shmring import TornSlotError
+
+        try:
+            while not self._stop:
+                try:
+                    view = self.reader.peek(timeout=0.2)
+                except TornSlotError as e:
+                    logger.error(
+                        "shard %d: shm ring torn, dying for restart+resync: %s",
+                        self.core.shard_id, e,
+                    )
+                    self.on_fatal()
+                    return
+                if view is None:
+                    continue
+                try:
+                    epoch, _seq, ops = self.decoder.decode(view)
+                finally:
+                    del view  # release the exported segment view
+                if self.core.observe_epoch(epoch, "evt", len(ops)):
+                    self.core.handle_events(ops)
+                self.reader.advance()
+                self.frames += 1
+                self.events += len(ops)
+        except Exception:  # noqa: BLE001 — route the death, don't hide it
+            logger.exception("shard %d: shm pump died", self.core.shard_id)
+            self.on_fatal()
+
+
 def serve(
     core: ShardCore, sock: socket.socket, bind_push: bool = True,
     auth_key: Optional[bytes] = None,
@@ -1251,6 +1335,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "code execution via a crafted pickle frame — only for networks "
         "where reachability is already locked down out-of-band",
     )
+    parser.add_argument(
+        "--shm-ring", default="",
+        help="name of the supervisor's shared-memory event ring segment "
+        "(socketpair child mode); attach failure falls back to pickle "
+        "evt frames on the socket and masks the evt-shm capability",
+    )
+    parser.add_argument(
+        "--shm-doorbell-fd", type=int, default=-1,
+        help="inherited read end of the ring's doorbell pipe",
+    )
     parser.add_argument("--name", default="kube-throttler")
     parser.add_argument("--target-scheduler-name", default="my-scheduler")
     parser.add_argument("--data-dir", default="")
@@ -1260,8 +1354,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--fault-seed", type=int, default=0)
     parser.add_argument(
         "--fault-site", default="",
-        help="arm one seeded fault rule (site[:mode[:after]]) — the chaos "
-        "harness's kill/err injection, e.g. shard.worker.kill:kill:25",
+        help="arm one seeded fault rule (site[:mode[:after[:delay]]]) — the "
+        "chaos harness's kill/err injection, e.g. shard.worker.kill:kill:25 "
+        "or shm.reader.stall:delay:2:0.5",
     )
     args = parser.parse_args(argv)
     if bool(args.listen) == (args.ipc_fd is not None):
@@ -1297,8 +1392,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         site = parts[0]
         mode = parts[1] if len(parts) > 1 else "error"
         after = int(parts[2]) if len(parts) > 2 else 0
+        delay = float(parts[3]) if len(parts) > 3 else 0.0
         faults = FaultPlan(seed=args.fault_seed).rule(
-            site, mode=mode, after=after, times=1
+            site, mode=mode, after=after, times=1, delay=delay
         )
     ingest_batch = args.ingest_batch
     if ingest_batch not in ("adaptive", "off", "none", ""):
@@ -1315,6 +1411,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         prepare_ttl=args.prepare_ttl,
     )
     if args.listen:
+        # TCP workers have no shared-memory ring with their front —
+        # never advertise the capability
+        from ..version import advertised_capabilities
+
+        os.environ["KT_PROTO_CAPS_MASK"] = ",".join(
+            sorted(advertised_capabilities() - {"evt-shm"})
+        )
         host, _, port = args.listen.rpartition(":")
         if auth_key is None and listen_requires_auth(host):
             logger.warning(
@@ -1341,10 +1444,72 @@ def main(argv: Optional[List[str]] = None) -> int:
             srv.close()
         return 0
     sock = socket.socket(fileno=args.ipc_fd)
-    print(f"shard {args.shard_id}/{args.shards} ready", flush=True)
+    pump = None
     try:
+        if args.shm_ring:
+            from .shmring import ShmRingReader
+
+            try:
+                reader = ShmRingReader(
+                    args.shm_ring,
+                    doorbell_rfd=(
+                        args.shm_doorbell_fd
+                        if args.shm_doorbell_fd >= 0
+                        else None
+                    ),
+                    faults=faults,
+                    untrack=True,  # the supervisor owns the segment name
+                )
+            except Exception:  # noqa: BLE001 — attach fail ⇒ pickle fallback
+                logger.exception(
+                    "shard %d: shm ring %r attach failed — pickle fallback",
+                    args.shard_id, args.shm_ring,
+                )
+                reader = None
+            if reader is not None:
+
+                def _ring_fatal() -> None:
+                    # die as a unit: the supervisor's restart + resync
+                    # with a fresh segment is the only repair for a
+                    # broken ring
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+                pump = ShmEventPump(core, reader, on_fatal=_ring_fatal)
+                pump_thread = threading.Thread(
+                    target=pump.run,
+                    name=f"shard{args.shard_id}-shm",
+                    daemon=True,
+                )
+                pump_thread.start()
+                pump.thread = pump_thread
+        if pump is None:
+            # no attached ring: never advertise the capability — the
+            # front must keep evt batches on the socket (pickle
+            # fallback)
+            from ..version import advertised_capabilities
+
+            os.environ["KT_PROTO_CAPS_MASK"] = ",".join(
+                sorted(advertised_capabilities() - {"evt-shm"})
+            )
+        core.shm_pump = pump  # stats RPC / worker metrics sample this
+        if pump is not None:
+            from ..metrics import register_shm_worker_metrics
+
+            register_shm_worker_metrics(
+                core.plugin.metrics_registry, core, args.shard_id
+            )
+        print(f"shard {args.shard_id}/{args.shards} ready", flush=True)
         serve(core, sock)
     finally:
+        if pump is not None:
+            pump.stop()
+            thread = getattr(pump, "thread", None)
+            if thread is not None:
+                thread.join(timeout=1.0)  # let a mid-peek pass finish
+            pump.reader.close()
         core.stop()
         sock.close()
     return 0

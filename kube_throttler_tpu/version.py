@@ -39,7 +39,7 @@ from . import __version__ as BUILD_VERSION
 # negotiable capability; bump MAJOR only for changes an old peer cannot
 # safely ignore (frame layout, handshake shape, fencing semantics).
 PROTO_MAJOR = 1
-PROTO_MINOR = 1
+PROTO_MINOR = 2
 PROTO_VERSION: Tuple[int, int] = (PROTO_MAJOR, PROTO_MINOR)
 
 # Human-debuggable build identity carried in the hello exchange and the
@@ -55,7 +55,14 @@ BUILD_ID = f"kube-throttler-tpu/{BUILD_VERSION}"
 #                  row-list pickle — same events, cheaper frames
 #   build-info     the peer answers stats RPCs with negotiated
 #                  version/caps/build fields (kube_throttler_build_info)
-CAPABILITIES: FrozenSet[str] = frozenset({"evt-columnar", "build-info"})
+#   evt-shm        the worker attached the supervisor's shared-memory
+#                  event ring (sharding/shmring.py): the front may move
+#                  "evt" batches through it as ring-v1 columnar frames
+#                  instead of pickle frames on the socket. A worker
+#                  only advertises this when its ring attach succeeded;
+#                  either side masking it falls back to pickle frames
+#                  byte-identically (mixed fleets / rolling upgrades)
+CAPABILITIES: FrozenSet[str] = frozenset({"evt-columnar", "build-info", "evt-shm"})
 
 # Durable/wire format registry: ``<domain>:<name> -> minimum reader
 # version`` (the oldest PROTO_MAJOR-series reader that understands the
@@ -80,6 +87,8 @@ FORMAT_REGISTRY: Dict[str, int] = {
     # snapshot payload versions (engine/snapshot.py)
     "snapshot:1": 1,
     "snapshot:2": 1,
+    # shared-memory event-ring layouts (sharding/shmring.py SHM_FORMATS)
+    "shm:ring-v1": 1,
 }
 
 

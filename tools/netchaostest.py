@@ -34,6 +34,31 @@ asserts the full recovery contract:
   a reserve whose prepare outran the deadline must have been aborted on
   every target, not stranded.
 
+An **SHM column** (``--only shm``) runs the zero-copy event-plane fault
+sites against a LIVE socketpair fleet with the per-shard shared-memory
+ring active (the default spawn path), x the same seeds:
+
+    shm.ring.full:delay         a saturated ring: the writer takes a
+                                counted backpressure wait — never a
+                                silent drop of a non-sheddable op
+    shm.slot.torn_commit:torn   a commit word dies mid-write; the reader
+                                detects the torn slot, the worker dies
+                                as a unit, and the supervisor's restart
+                                + resync brings a FRESH segment
+    shm.doorbell.lost:error     lost wakeup bytes; the reader's bounded
+                                poll slice turns them into latency only
+    shm.reader.stall:delay      a slow consumer (worker-side rule); the
+                                lane backpressures, nothing is lost
+    shm.segment.unlink:error    the restart-path unlink is lost; the
+                                supervisor's sweep backstop must leave
+                                /dev/shm clean at stop
+
+SHM cases assert the same zero-wrong-verdict / zero-lost-flip / zero-
+orphan gates, plus: the event plane is ACTIVE pre-fault (no silent
+pickle fallback masking the matrix), restarts happen exactly when the
+case expects them (torn commit: yes; everything else: no), and no
+``kt_evt_*`` segment survives the final stop.
+
 Run: ``python tools/netchaostest.py matrix`` (``make net-chaos``); the
 tier-1 smoke (tests/test_net_transport.py) runs one case small.
 """
@@ -67,25 +92,42 @@ CASES = (
 # frame so the established lane actually drops and the dial path runs
 _NEEDS_SEVER = ("net.connect.refused", "net.reconnect.storm")
 
+# shm column: (site, mode, front-side rule kwargs | None, worker
+# --fault-site arg | None, expect_restart). Front-side rules arm the
+# plan BEFORE spawn (the ring writer captures it at construction);
+# worker-side rules ride the worker CLI. shm.segment.unlink needs a
+# writer close to fire, so it's paired with one torn commit (the
+# restart path closes the old handle) and the sweep backstop carries
+# the cleanup contract.
+SHM_CASES = (
+    ("shm.ring.full", "delay", {"times": 3, "delay": 0.3}, None, False),
+    ("shm.slot.torn_commit", "torn", {"times": 1}, None, True),
+    ("shm.doorbell.lost", "error", {"times": 5}, None, False),
+    ("shm.reader.stall", "delay", None, "shm.reader.stall:delay:2:0.5", False),
+    ("shm.segment.unlink", "error", {"times": 1}, None, True),
+)
+
 
 def build_fleet(n_shards=2, n_throttles=24, n_pods=160, n_reserved=8,
-                rpc_deadline=10.0):
+                rpc_deadline=10.0, transport="tcp", faults=None,
+                worker_args=None):
     import tools.harness as H
     from kube_throttler_tpu.api.pod import Namespace, make_pod
     from kube_throttler_tpu.sharding.front import AdmissionFront
     from kube_throttler_tpu.sharding.supervisor import ShardSupervisor
 
-    front = AdmissionFront(n_shards, rpc_deadline=rpc_deadline)
+    front = AdmissionFront(n_shards, rpc_deadline=rpc_deadline, faults=faults)
     supervisor = ShardSupervisor(
         front,
-        transport="tcp",
+        transport=transport,
         use_device=False,
         restart_backoff=0.3,
         env={**os.environ, "KT_SHARD_QUIET": "1", "KT_LOCK_ASSERT": "0"},
+        worker_args=list(worker_args or []),
         # the matrix runs the KEYED framing (HMAC per frame) so every
         # fault path is exercised through the cross-host trust boundary,
         # not the loopback-only keyless shortcut
-        auth_key=b"netchaos-matrix-psk",
+        auth_key=b"netchaos-matrix-psk" if transport == "tcp" else None,
     )
     supervisor.start(ready_timeout=300.0)
     try:
@@ -250,12 +292,123 @@ def run_case(site, mode, seed, rule_kwargs=None, n_pods=160, rounds=6,
         front.stop()
 
 
+def _shm_leftovers():
+    try:
+        return [f for f in os.listdir("/dev/shm") if f.startswith("kt_evt_")]
+    except OSError:
+        return []
+
+
+def run_shm_case(site, mode, seed, rule_kwargs=None, worker_fault=None,
+                 expect_restart=False, n_pods=160, rounds=6, recovery_s=60.0):
+    from kube_throttler_tpu.faults.plan import FaultPlan
+
+    # Front-side plans are passed EMPTY at construction (the ring writer
+    # captures front.faults by reference at spawn) and armed only after
+    # seeding: a torn commit during build_fleet would kill the worker
+    # before the matrix even starts measuring.
+    plan = FaultPlan(seed=seed) if rule_kwargs is not None else None
+    worker_args = ["--fault-site", worker_fault] if worker_fault else None
+    front, supervisor, pods = build_fleet(
+        n_pods=n_pods, transport="socketpair", faults=plan,
+        worker_args=worker_args,
+    )
+    result = {"case": f"{site}:{mode}", "seed": seed}
+    try:
+        # the event plane must be LIVE before the fault window — a fleet
+        # that silently fell back to pickle would pass every gate while
+        # testing nothing
+        for sid in range(front.n_shards):
+            handle = front.shards[sid]
+            lane = getattr(handle, "shm_lane", None)
+            assert lane is not None and not lane.dead, (
+                f"shard {sid}: no live shm lane — matrix would be vacuous"
+            )
+            assert getattr(handle, "_shm_active", False), (
+                f"shard {sid}: shm lane never promoted past the barrier"
+            )
+
+        restarts_before = sum(supervisor.restart_counts().values())
+        if plan is not None:
+            plan.rule(site, mode=mode, **dict(rule_kwargs))
+            if site == "shm.segment.unlink":
+                # the unlink only runs when a writer closes: force one
+                # restart so the monitor closes the old handle mid-run
+                plan.rule("shm.slot.torn_commit", mode="torn", times=1)
+        churn(front, pods, rounds=rounds)
+
+        deadline = time.monotonic() + recovery_s
+        recovered = False
+        while time.monotonic() < deadline:
+            state, _ = front._shards_health()
+            if state == "ok":
+                recovered = True
+                break
+            time.sleep(0.1)
+        assert recovered, f"fleet never recovered: {front._shards_health()}"
+        assert front.drain(120.0)
+        time.sleep(0.5)
+
+        restarts_after = sum(supervisor.restart_counts().values())
+        if expect_restart:
+            assert restarts_after > restarts_before, (
+                f"{site}: expected a worker restart (torn ring ⇒ die as a "
+                f"unit ⇒ fresh segment), saw none"
+            )
+        else:
+            assert restarts_after == restarts_before, (
+                f"{site}: a latency/backpressure fault must not restart "
+                f"workers (restarts {restarts_before} -> {restarts_after})"
+            )
+        result["restarts"] = restarts_after - restarts_before
+
+        if plan is not None:
+            fired = plan.fired(site)
+            assert fired >= 1, f"{site} never fired (vacuous pass)"
+            result["fired"] = fired
+        else:
+            # worker-side rule: the plan lives in the worker process.
+            # Prove the faulted path ran by the pump having decoded
+            # frames through the very peek loop the site instruments
+            total_frames = 0
+            for sid in range(front.n_shards):
+                shm = front.shards[sid].request("stats", None, timeout=30.0)["shm"]
+                assert shm is not None, f"shard {sid}: pump gone after heal"
+                total_frames += shm["frames"]
+            assert total_frames > 0, "no frames crossed the ring"
+            result["fired"] = None
+            result["pump_frames"] = total_frames
+
+        # post-heal the plane must still (or again) be the live path
+        for sid in range(front.n_shards):
+            handle = front.shards[sid]
+            lane = getattr(handle, "shm_lane", None)
+            assert lane is not None and not lane.dead, (
+                f"shard {sid}: lane dead after heal — fallback is hiding"
+            )
+
+        wrong, stale = final_state(front)
+        assert not wrong, f"wrong verdicts after heal: {wrong[:3]}"
+        assert not stale, f"lost flips after heal: {stale[:3]}"
+        bad = audit_all(front)
+        assert not bad, f"orphan audit failed: {bad}"
+        result["ok"] = True
+    finally:
+        supervisor.stop()
+        front.stop()
+    leftovers = _shm_leftovers()
+    assert not leftovers, f"leaked shm segments after stop: {leftovers}"
+    return result
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="netchaostest")
     sub = parser.add_subparsers(dest="command", required=True)
-    m = sub.add_parser("matrix", help="every net.* site x 3 seeds")
+    m = sub.add_parser("matrix", help="every net.* + shm.* site x 3 seeds")
     m.add_argument("--seeds", default=",".join(str(s) for s in SEEDS))
     m.add_argument("--json", default="", help="write the matrix report here")
+    m.add_argument("--only", choices=("all", "net", "shm"), default="all",
+                   help="restrict the matrix to one transport column")
     one = sub.add_parser("one", help="a single case")
     one.add_argument("--site", required=True)
     one.add_argument("--mode", default="error")
@@ -267,33 +420,78 @@ def main(argv=None) -> int:
     honor_jax_platforms_env()
 
     if args.command == "one":
-        kwargs = next(
-            (kw for s, md, kw in CASES if s == args.site and md == args.mode),
-            None,
-        )
-        result = run_case(args.site, args.mode, args.seed, rule_kwargs=kwargs)
+        if args.site.startswith("shm."):
+            case = next(
+                (c for c in SHM_CASES
+                 if c[0] == args.site and c[1] == args.mode),
+                None,
+            )
+            if case is None:
+                parser.error(f"unknown shm case {args.site}:{args.mode}")
+            _, _, kwargs, worker_fault, expect_restart = case
+            result = run_shm_case(
+                args.site, args.mode, args.seed, rule_kwargs=kwargs,
+                worker_fault=worker_fault, expect_restart=expect_restart,
+            )
+        else:
+            kwargs = next(
+                (kw for s, md, kw in CASES
+                 if s == args.site and md == args.mode),
+                None,
+            )
+            result = run_case(args.site, args.mode, args.seed,
+                              rule_kwargs=kwargs)
         print(json.dumps(result, indent=2))
         return 0
 
     seeds = [int(s) for s in args.seeds.split(",") if s != ""]
     results, failures = [], 0
-    for site, mode, kwargs in CASES:
-        for seed in seeds:
-            label = f"{site}:{mode}"
-            t0 = time.monotonic()
-            try:
-                result = run_case(site, mode, seed, rule_kwargs=kwargs)
-                result["wall_s"] = round(time.monotonic() - t0, 1)
-                results.append(result)
-                print(f"PASS {label:<28} seed={seed} fired={result['fired']} "
-                      f"reconnects={result['reconnects']} "
-                      f"({result['wall_s']}s)")
-            except Exception as e:  # noqa: BLE001 — matrix reports, then fails
-                failures += 1
-                results.append({"case": label, "seed": seed, "error": repr(e)})
-                print(f"FAIL {label:<28} seed={seed}: {e!r}")
-    total = len(CASES) * len(seeds)
-    print(f"\n{total - failures}/{total} network-fault paths clean "
+    if args.only in ("all", "net"):
+        for site, mode, kwargs in CASES:
+            for seed in seeds:
+                label = f"{site}:{mode}"
+                t0 = time.monotonic()
+                try:
+                    result = run_case(site, mode, seed, rule_kwargs=kwargs)
+                    result["wall_s"] = round(time.monotonic() - t0, 1)
+                    results.append(result)
+                    print(f"PASS {label:<28} seed={seed} "
+                          f"fired={result['fired']} "
+                          f"reconnects={result['reconnects']} "
+                          f"({result['wall_s']}s)")
+                except Exception as e:  # noqa: BLE001 — matrix reports, then fails
+                    failures += 1
+                    results.append(
+                        {"case": label, "seed": seed, "error": repr(e)}
+                    )
+                    print(f"FAIL {label:<28} seed={seed}: {e!r}")
+    if args.only in ("all", "shm"):
+        for site, mode, kwargs, worker_fault, expect_restart in SHM_CASES:
+            for seed in seeds:
+                label = f"{site}:{mode}"
+                t0 = time.monotonic()
+                try:
+                    result = run_shm_case(
+                        site, mode, seed, rule_kwargs=kwargs,
+                        worker_fault=worker_fault,
+                        expect_restart=expect_restart,
+                    )
+                    result["wall_s"] = round(time.monotonic() - t0, 1)
+                    results.append(result)
+                    print(f"PASS {label:<28} seed={seed} "
+                          f"fired={result['fired']} "
+                          f"restarts={result['restarts']} "
+                          f"({result['wall_s']}s)")
+                except Exception as e:  # noqa: BLE001 — matrix reports, then fails
+                    failures += 1
+                    results.append(
+                        {"case": label, "seed": seed, "error": repr(e)}
+                    )
+                    print(f"FAIL {label:<28} seed={seed}: {e!r}")
+    n_net = len(CASES) * len(seeds) if args.only in ("all", "net") else 0
+    n_shm = len(SHM_CASES) * len(seeds) if args.only in ("all", "shm") else 0
+    total = n_net + n_shm
+    print(f"\n{total - failures}/{total} transport-fault paths clean "
           "(zero wrong verdicts, zero lost flips, zero orphan reservations)")
     if args.json:
         with open(args.json, "w") as f:
